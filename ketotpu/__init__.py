@@ -1,0 +1,20 @@
+"""keto-tpu: a TPU-native Zanzibar-style permission engine.
+
+A brand-new framework with the capabilities of Ory Keto (relationship-based
+access control): relation tuples, OPL namespaces with userset rewrites, and
+Check / Expand / Read / Write / Namespaces APIs over HTTP and gRPC — with the
+check and expand engines re-expressed as batched sparse graph-reachability
+over device-resident CSR blocks evaluated by JAX under jit/shard_map.
+
+Layering (outside-in), mirroring the reference's layer map (SURVEY.md §1):
+
+    cli         command line interface (serve, check, expand, relation-tuple, ...)
+    server      REST + gRPC serving shell
+    engine      check/expand engines: `oracle` (sequential parity oracle) and
+                `tpu` (batched frontier-expansion engine)
+    storage     relation-tuple store (manager, traverser, pagination, snapshots)
+    opl         Ory Permission Language lexer/parser/typechecker -> namespace AST
+    api         public wire types and codecs (tuple grammar, URL query, JSON)
+"""
+
+__version__ = "0.1.0"

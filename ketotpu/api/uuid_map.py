@@ -1,0 +1,49 @@
+"""Deterministic string⇄UUID mapping.
+
+The reference maps every API-facing object / subject-id string to a UUIDv5 in
+the namespace of the network id, and persists the reverse mapping
+(`internal/persistence/sql/uuid_mapping.go:35-74`).  Because UUIDv5 is a pure
+hash, the forward direction never needs storage; only the reverse direction
+does.  We keep the same scheme for wire parity (ids that round-trip through
+the reference's database would be identical), while the engines themselves use
+dense int32 ids from the snapshot vocabulary instead.
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from typing import Iterable, Optional
+
+
+class UUIDMapper:
+    """Bidirectional string⇄UUIDv5 mapper within one network (tenant).
+
+    Forward = hash (`uuid5(network_id, value)`); reverse = dict, populated on
+    every forward mapping (mirrors INSERT .. ON CONFLICT DO NOTHING).
+    ``read_only`` skips populating the reverse store, like the reference's
+    ReadOnly mapper used on the Check path (uuid_mapping.go:60-71).
+    """
+
+    def __init__(self, network_id: uuid.UUID, *, read_only: bool = False):
+        self.network_id = network_id
+        self.read_only = read_only
+        self._reverse: dict[uuid.UUID, str] = {}
+        self._lock = threading.Lock()
+
+    def to_uuid(self, value: str) -> uuid.UUID:
+        u = uuid.uuid5(self.network_id, value)
+        if not self.read_only:
+            with self._lock:
+                self._reverse.setdefault(u, value)
+        return u
+
+    def to_uuids(self, values: Iterable[str]) -> list:
+        return [self.to_uuid(v) for v in values]
+
+    def from_uuid(self, u: uuid.UUID) -> Optional[str]:
+        with self._lock:
+            return self._reverse.get(u)
+
+    def from_uuids(self, uuids: Iterable[uuid.UUID]) -> list:
+        return [self.from_uuid(u) for u in uuids]

@@ -1,0 +1,137 @@
+"""Tuple grammar / codec parity tests.
+
+Mirrors the reference's codec behaviors: ketoapi/enc_string.go (round-trips,
+optional parens, empty subject-set relation), enc_url_query.go (subject key
+errors), and JSON field layout.
+"""
+
+import pytest
+
+from ketotpu.api import (
+    RelationQuery,
+    RelationTuple,
+    SubjectID,
+    SubjectSet,
+)
+from ketotpu.api.types import BadRequestError, subject_from_string
+
+
+class TestTupleGrammar:
+    @pytest.mark.parametrize(
+        "s,expected",
+        [
+            (
+                "videos:/cats/1.mp4#view@alice",
+                RelationTuple("videos", "/cats/1.mp4", "view", SubjectID("alice")),
+            ),
+            (
+                "videos:/cats/1.mp4#view@videos:/cats#owner",
+                RelationTuple(
+                    "videos", "/cats/1.mp4", "view", SubjectSet("videos", "/cats", "owner")
+                ),
+            ),
+            (
+                "videos:/cats/1.mp4#view@(videos:/cats#owner)",
+                RelationTuple(
+                    "videos", "/cats/1.mp4", "view", SubjectSet("videos", "/cats", "owner")
+                ),
+            ),
+            # subject set without relation => empty relation
+            ("n:o#r@users:bob", RelationTuple("n", "o", "r", SubjectSet("users", "bob", ""))),
+            # '@' in subject id is fine (first '@' splits)
+            ("n:o#r@user@example.com", RelationTuple("n", "o", "r", SubjectID("user@example.com"))),
+            # object may contain '#'? no -- first '#' splits. but ':' in object is fine
+            ("n:o:with:colons#r@s", RelationTuple("n", "o:with:colons", "r", SubjectID("s"))),
+        ],
+    )
+    def test_parse(self, s, expected):
+        assert RelationTuple.from_string(s) == expected
+
+    @pytest.mark.parametrize("s", ["no-colon", "ns:obj-no-hash", "ns:obj#rel-no-at"])
+    def test_parse_errors(self, s):
+        with pytest.raises(BadRequestError):
+            RelationTuple.from_string(s)
+
+    def test_roundtrip(self):
+        for s in [
+            "videos:/cats/1.mp4#view@alice",
+            "videos:/cats/1.mp4#view@videos:/cats#owner",
+            "n:o#r@user@example.com",
+        ]:
+            assert str(RelationTuple.from_string(s)) == s
+
+    def test_subject_set_without_relation_str(self):
+        assert str(SubjectSet("users", "bob", "")) == "users:bob"
+        assert str(SubjectSet("users", "bob", "r")) == "users:bob#r"
+
+    def test_subject_from_string(self):
+        assert subject_from_string("alice") == SubjectID("alice")
+        assert subject_from_string("a:b#c") == SubjectSet("a", "b", "c")
+        assert subject_from_string("(a:b#c)") == SubjectSet("a", "b", "c")
+
+
+class TestURLQuery:
+    def test_dropped_subject_key(self):
+        with pytest.raises(BadRequestError):
+            RelationQuery.from_url_query({"subject": "x"})
+
+    def test_duplicate_subject(self):
+        with pytest.raises(BadRequestError):
+            RelationQuery.from_url_query(
+                {"subject_id": "x", "subject_set.namespace": "n"}
+            )
+
+    def test_incomplete_subject_set(self):
+        with pytest.raises(BadRequestError):
+            RelationQuery.from_url_query(
+                {"subject_set.namespace": "n", "subject_set.object": "o"}
+            )
+
+    def test_no_subject_ok(self):
+        q = RelationQuery.from_url_query({"namespace": "n", "object": "o"})
+        assert q.namespace == "n" and q.object == "o"
+        assert q.subject() is None
+
+    def test_full_roundtrip(self):
+        t = RelationTuple("n", "o", "r", SubjectSet("a", "b", "c"))
+        assert RelationTuple.from_url_query(t.to_url_query()) == t
+        t2 = RelationTuple("n", "o", "r", SubjectID("alice"))
+        assert RelationTuple.from_url_query(t2.to_url_query()) == t2
+
+
+class TestJSON:
+    def test_subject_id_layout(self):
+        t = RelationTuple("n", "o", "r", SubjectID("alice"))
+        assert t.to_json() == {
+            "namespace": "n",
+            "object": "o",
+            "relation": "r",
+            "subject_id": "alice",
+        }
+        assert RelationTuple.from_json(t.to_json()) == t
+
+    def test_subject_set_layout(self):
+        t = RelationTuple("n", "o", "r", SubjectSet("a", "b", "c"))
+        assert t.to_json() == {
+            "namespace": "n",
+            "object": "o",
+            "relation": "r",
+            "subject_set": {"namespace": "a", "object": "b", "relation": "c"},
+        }
+        assert RelationTuple.from_json(t.to_json()) == t
+
+
+class TestUUIDMapper:
+    def test_deterministic_and_reversible(self):
+        import uuid
+
+        from ketotpu.api.uuid_map import UUIDMapper
+
+        nid = uuid.uuid4()
+        m = UUIDMapper(nid)
+        u1 = m.to_uuid("alice")
+        assert m.to_uuid("alice") == u1
+        assert UUIDMapper(nid).to_uuid("alice") == u1
+        assert m.from_uuid(u1) == "alice"
+        # parity with Go's uuid.NewV5(nid, value) == RFC4122 SHA1 name-based
+        assert u1 == uuid.uuid5(nid, "alice")
