@@ -234,9 +234,12 @@ def _subject_from_json(d: Mapping) -> Optional[Subject]:
         return SubjectID(id=d["subject_id"])
     ss = d.get("subject_set")
     if ss is not None:
-        return SubjectSet(
-            namespace=ss["namespace"], object=ss["object"], relation=ss.get("relation", "")
-        )
+        try:
+            return SubjectSet(
+                namespace=ss["namespace"], object=ss["object"], relation=ss.get("relation", "")
+            )
+        except (KeyError, TypeError) as e:
+            raise ErrIncompleteSubject() from e
     return None
 
 
@@ -362,10 +365,13 @@ class RelationTupleDelta:
     def from_json(d: Mapping) -> "RelationTupleDelta":
         try:
             action = PatchAction(d["action"])
-        except ValueError as e:
-            raise BadRequestError(f"unknown action {d.get('action')!r}") from e
+            tuple_json = d["relation_tuple"]
+        except (ValueError, KeyError) as e:
+            raise BadRequestError(
+                f"patch delta needs a valid action and a relation_tuple, got {d!r}"
+            ) from e
         return RelationTupleDelta(
-            action=action, relation_tuple=RelationTuple.from_json(d["relation_tuple"])
+            action=action, relation_tuple=RelationTuple.from_json(tuple_json)
         )
 
 

@@ -16,6 +16,10 @@ import uuid
 from typing import Iterable, Optional
 
 
+_SHARED_REVERSE: dict = {}
+_SHARED_LOCK = threading.Lock()
+
+
 class UUIDMapper:
     """Bidirectional string⇄UUIDv5 mapper within one network (tenant).
 
@@ -25,10 +29,23 @@ class UUIDMapper:
     ReadOnly mapper used on the Check path (uuid_mapping.go:60-71).
     """
 
-    def __init__(self, network_id: uuid.UUID, *, read_only: bool = False):
+    def __init__(
+        self,
+        network_id: uuid.UUID,
+        *,
+        read_only: bool = False,
+        reverse_store: Optional[dict] = None,
+    ):
+        # The reverse store is shared storage in the reference (the
+        # keto_uuid_mappings table): a read-only mapper skips writes but still
+        # resolves reverse lookups from it.  Pass the same dict to every mapper
+        # of one network; by default a process-wide store per network is used.
         self.network_id = network_id
         self.read_only = read_only
-        self._reverse: dict[uuid.UUID, str] = {}
+        if reverse_store is None:
+            with _SHARED_LOCK:
+                reverse_store = _SHARED_REVERSE.setdefault(network_id, {})
+        self._reverse = reverse_store
         self._lock = threading.Lock()
 
     def to_uuid(self, value: str) -> uuid.UUID:

@@ -1,0 +1,25 @@
+"""Command line interface (work in progress).
+
+Will mirror the reference's `cmd/` surface: serve, check, expand,
+relation-tuple {parse,create,get,delete,delete-all}, namespace validate,
+status, version.
+"""
+
+from __future__ import annotations
+
+import sys
+
+import ketotpu
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if argv and argv[0] == "version":
+        print(ketotpu.__version__)
+        return 0
+    print("keto-tpu: CLI under construction; available: version", file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

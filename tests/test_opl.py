@@ -1,0 +1,362 @@
+"""OPL parser tests mirroring the reference's parser/lexer suites
+(internal/schema/parser_test.go, lexer_test.go) plus the shipped OPL fixtures.
+"""
+
+from pathlib import Path
+
+import pytest
+
+from ketotpu.opl import (
+    ComputedSubjectSet,
+    InvertResult,
+    Operator,
+    Relation,
+    RelationType,
+    SubjectSetRewrite,
+    TupleToSubjectSet,
+    parse,
+)
+from ketotpu.opl.parser import simplify_expression
+
+REFERENCE = Path("/root/reference")
+
+
+def parse_ok(src):
+    namespaces, errors = parse(src)
+    assert not errors, "\n".join(str(e) for e in errors)
+    return {n.name: n for n in namespaces}
+
+
+class TestFixtures:
+    def test_rewrites_example(self):
+        src = (REFERENCE / "contrib/rewrites-example/namespaces.keto.ts").read_text()
+        ns = parse_ok(src)
+        assert set(ns) == {"User", "Group", "Folder", "File"}
+
+        user = ns["User"]
+        assert user.relations == [Relation("manager", [RelationType("User")])]
+
+        group = ns["Group"]
+        assert group.relations == [
+            Relation("members", [RelationType("User"), RelationType("Group")])
+        ]
+
+        folder = ns["Folder"]
+        assert folder.relation("parents").types == [
+            RelationType("File"),
+            RelationType("Folder"),
+        ]
+        assert folder.relation("viewers").types == [RelationType("Group", "members")]
+        view = folder.relation("view").subject_set_rewrite
+        assert view.operation == Operator.OR
+        assert view.children == [
+            ComputedSubjectSet("viewers"),
+            TupleToSubjectSet("parents", "view"),
+        ]
+
+        file = ns["File"]
+        fview = file.relation("view").subject_set_rewrite
+        assert fview.children == [
+            TupleToSubjectSet("parents", "view"),
+            ComputedSubjectSet("viewers"),
+            ComputedSubjectSet("owners"),
+        ]
+        assert file.relation("edit").subject_set_rewrite.children == [
+            ComputedSubjectSet("owners")
+        ]
+
+    def test_project_opl_fixture(self):
+        src = (REFERENCE / "internal/check/testfixtures/project_opl.ts").read_text()
+        ns = parse_ok(src)
+        assert set(ns) == {"User", "Project"}
+        project = ns["Project"]
+        # permits compile to computed subject sets
+        assert project.relation("isOwner").subject_set_rewrite.children == [
+            ComputedSubjectSet("owner")
+        ]
+        assert project.relation("isOwnerOrDeveloper").subject_set_rewrite.children == [
+            ComputedSubjectSet("owner"),
+            ComputedSubjectSet("developer"),
+        ]
+        assert project.relation("readCollaborator").subject_set_rewrite.children == [
+            ComputedSubjectSet("isOwnerOrDeveloper")
+        ]
+
+
+class TestParserCases:
+    """Direct ports of parserTestCases (parser_test.go:60-171)."""
+
+    def test_full_example(self):
+        src = """
+  import { Namespace, SubjectSet, FooBar, Anything } from '@ory/keto-namespace-types'
+
+  class User implements Namespace {
+    related: {
+      manager: User[];
+    }
+  }
+
+  class Group implements Namespace {
+    related: {
+      members: (User | Group)[];
+    };
+  }
+
+  class Folder implements Namespace {
+    related: {
+      parents: Array<File>
+      viewers: Array<SubjectSet<Group, "members">>
+    }
+
+    permits = {
+      view: (ctx: Context): boolean => this.related.viewers.includes(ctx.subject),
+    }
+  }
+
+  class File implements Namespace {
+    related: {
+      parents: Array<File | Folder>
+      viewers: (User | SubjectSet<Group, "members">)[]
+      "owners": (User | SubjectSet<Group, "members">)[]
+      siblings: File[]
+    }
+
+    // Some comment
+    permits = {
+      view: (ctx: Context): boolean =>
+        (
+        this.related.parents.traverse((p) /* comment */ =>
+          p.related.viewers.includes(ctx.subject),
+        ) && // comment
+        this.related.parents.traverse(p => p.permits.view(ctx)) ) ||
+        (this.related.viewers.includes(ctx.subject) || // some comment
+        this.related.viewers.includes(ctx.subject) || /* another comment */
+        this.related.viewers.includes(ctx.subject) ) ||
+        this.related.owners.includes(ctx.subject),
+
+      'edit': (ctx: Context) => this.related.owners.includes(ctx.subject),
+
+      not: (ctx: Context) => !this.related.owners.includes(ctx.subject),
+
+      rename: (ctx: Context) =>
+        this.related.siblings.traverse(s => s.permits.edit(ctx)),
+    }
+  }
+"""
+        ns = parse_ok(src)
+        assert set(ns) == {"User", "Group", "Folder", "File"}
+        file = ns["File"]
+        assert file.relation("owners").types == [
+            RelationType("User"),
+            RelationType("Group", "members"),
+        ]
+        view = file.relation("view").subject_set_rewrite
+        # ((tts && tts) || (cs || cs || cs) || cs) -- outer OR is n-ary with
+        # the AND group kept nested
+        assert view.operation == Operator.OR
+        assert isinstance(view.children[0], SubjectSetRewrite)
+        assert view.children[0].operation == Operator.AND
+        assert len(view.children[0].children) == 2
+        not_rel = file.relation("not").subject_set_rewrite
+        assert isinstance(not_rel.children[0], InvertResult)
+        assert not_rel.children[0].child == ComputedSubjectSet("owners")
+
+    def test_advanced_typescript_syntax(self):
+        src = """
+import { Namespace, SubjectSet, Context } from '@ory/keto-namespace-types';
+
+class Role implements Namespace {
+  related: {
+    member: Role[]
+  }
+}
+
+class Resource implements Namespace {
+  related: {
+    admins: SubjectSet<Role, 'member'>[],
+    supervisors: SubjectSet<Role, 'member'>[],
+    annotators: SubjectSet<Role, 'member'>[],
+  };
+
+  permits = {
+    read: (ctx: Context) => this.related.admins.traverse((role) => role.related.member.includes(ctx.subject)) ||
+      this.related.annotators.traverse((role) => role.related.member.includes(ctx.subject)),
+
+    comment: (ctx: Context) => this.permits.read(ctx),
+  };
+}
+"""
+        ns = parse_ok(src)
+        res = ns["Resource"]
+        assert res.relation("admins").types == [RelationType("Role", "member")]
+        read = res.relation("read").subject_set_rewrite
+        assert read.children == [
+            TupleToSubjectSet("admins", "member"),
+            TupleToSubjectSet("annotators", "member"),
+        ]
+        assert res.relation("comment").subject_set_rewrite.children == [
+            ComputedSubjectSet("read")
+        ]
+
+    def test_quoted_property_names(self):
+        src = """
+class Resource implements Namespace {
+  related: {
+    "scope.relation": Resource[]
+  }
+  permits = {
+    "scope.action_0": (ctx: Context) => this.related["scope.relation"].traverse((r) => r.permits["scope.action_1"](ctx)),
+    "scope.action_1": (ctx: Context) => this.related["scope.relation"].traverse((r) => r.related["scope.relation"].includes(ctx.subject)),
+    "scope.action_2": (ctx: Context) => this.permits["scope.action_0"](ctx),
+  }
+}"""
+        ns = parse_ok(src)
+        res = ns["Resource"]
+        assert res.relation("scope.action_0").subject_set_rewrite.children == [
+            TupleToSubjectSet("scope.relation", "scope.action_1")
+        ]
+        assert res.relation("scope.action_2").subject_set_rewrite.children == [
+            ComputedSubjectSet("scope.action_0")
+        ]
+
+
+class TestParserErrors:
+    """Ports of parserErrorTestCases (parser_test.go:15-58): each yields
+    exactly one error."""
+
+    @pytest.mark.parametrize(
+        "name,src",
+        [
+            ("lexer error", "/* unclosed comment"),
+            (
+                "syntax error in class",
+                """
+class File implements Namespace {
+  related: {
+    owners: File[]
+  }
+
+  SYNTAX ERROR
+}
+""",
+            ),
+            (
+                "operator before first expression",
+                """
+class Resource implements Namespace {
+  permits = {
+    update: (ctx: Context) => ||
+      this.related.annotators.traverse((role) => role.related.member.includes(ctx.subject)),
+""",
+            ),
+        ],
+    )
+    def test_single_error(self, name, src):
+        _, errors = parse(src)
+        assert len(errors) == 1, [str(e) for e in errors]
+
+
+class TestTypeChecks:
+    def test_undeclared_namespace(self):
+        _, errors = parse("class A implements Namespace { related: { x: B[] } }")
+        assert len(errors) == 1
+        assert 'namespace "B" was not declared' in errors[0].msg
+
+    def test_undeclared_relation_in_subject_set(self):
+        src = """
+class B implements Namespace {}
+class A implements Namespace { related: { x: SubjectSet<B, "nope">[] } }
+"""
+        _, errors = parse(src)
+        assert len(errors) == 1
+        assert 'namespace "B" did not declare relation "nope"' in errors[0].msg
+
+    def test_permits_references_unknown_relation(self):
+        src = """
+class A implements Namespace {
+  permits = {
+    view: (ctx: Context) => this.related.viewers.includes(ctx.subject),
+  }
+}
+"""
+        _, errors = parse(src)
+        assert len(errors) == 1
+        assert 'did not declare relation "viewers"' in errors[0].msg
+
+    def test_traverse_target_missing_relation(self):
+        src = """
+class B implements Namespace { related: { p: B[] } }
+class A implements Namespace {
+  related: { parents: B[] }
+  permits = {
+    view: (ctx: Context) => this.related.parents.traverse((p) => p.permits.view(ctx)),
+  }
+}
+"""
+        _, errors = parse(src)
+        assert len(errors) == 1
+        assert 'relation "view" was not declared in namespace "B"' in errors[0].msg
+
+    def test_nesting_depth_cap(self):
+        expr = "this.related.o.includes(ctx.subject)"
+        for _ in range(11):
+            expr = f"({expr})"
+        src = f"""
+class A implements Namespace {{
+  related: {{ o: A[] }}
+  permits = {{ v: (ctx: Context) => {expr}, }}
+}}
+"""
+        _, errors = parse(src)
+        assert len(errors) == 1
+        assert "nested too deeply" in errors[0].msg
+
+
+class TestSimplify:
+    def test_merge_all_unions(self):
+        # parser_test.go:219-259
+        nested = SubjectSetRewrite(
+            Operator.OR,
+            [
+                SubjectSetRewrite(
+                    Operator.OR,
+                    [
+                        SubjectSetRewrite(
+                            Operator.OR,
+                            [ComputedSubjectSet("a"), ComputedSubjectSet("b")],
+                        ),
+                        ComputedSubjectSet("c"),
+                    ],
+                ),
+                ComputedSubjectSet("d"),
+            ],
+        )
+        assert simplify_expression(nested).children == [
+            ComputedSubjectSet("a"),
+            ComputedSubjectSet("b"),
+            ComputedSubjectSet("c"),
+            ComputedSubjectSet("d"),
+        ]
+
+    def test_keeps_mixed_operators(self):
+        mixed = SubjectSetRewrite(
+            Operator.OR,
+            [
+                SubjectSetRewrite(
+                    Operator.AND, [ComputedSubjectSet("a"), ComputedSubjectSet("b")]
+                ),
+                ComputedSubjectSet("c"),
+            ],
+        )
+        out = simplify_expression(mixed)
+        assert len(out.children) == 2
+        assert out.children[0].operation == Operator.AND
+
+
+class TestErrorPositions:
+    def test_error_position_json(self):
+        _, errors = parse("class A implements Namespace { related: { x: B[] } }")
+        j = errors[0].to_json()
+        assert j["message"]
+        assert set(j["start"]) == {"Line", "column"}
+        assert j["start"]["Line"] == 1
