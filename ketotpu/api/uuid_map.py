@@ -17,7 +17,15 @@ from typing import Iterable, Optional
 
 
 _SHARED_REVERSE: dict = {}
+_STORE_LOCKS: dict = {}
 _SHARED_LOCK = threading.Lock()
+
+
+def reset_shared_stores() -> None:
+    """Drop all process-global reverse mappings (tests, tenant eviction)."""
+    with _SHARED_LOCK:
+        _SHARED_REVERSE.clear()
+        _STORE_LOCKS.clear()
 
 
 class UUIDMapper:
@@ -42,11 +50,12 @@ class UUIDMapper:
         # of one network; by default a process-wide store per network is used.
         self.network_id = network_id
         self.read_only = read_only
-        if reverse_store is None:
-            with _SHARED_LOCK:
+        with _SHARED_LOCK:
+            if reverse_store is None:
                 reverse_store = _SHARED_REVERSE.setdefault(network_id, {})
+            # One lock per store so all mappers sharing it synchronize.
+            self._lock = _STORE_LOCKS.setdefault(id(reverse_store), threading.Lock())
         self._reverse = reverse_store
-        self._lock = threading.Lock()
 
     def to_uuid(self, value: str) -> uuid.UUID:
         u = uuid.uuid5(self.network_id, value)

@@ -1,0 +1,14 @@
+"""Check/Expand engines.
+
+`oracle` is the sequential parity oracle implementing the reference's exact
+three-valued semantics; `tpu` is the batched JAX engine validated against it.
+"""
+
+from ketotpu.engine.oracle import (
+    CheckEngine,
+    CheckResult,
+    ExpandEngine,
+    Membership,
+)
+
+__all__ = ["CheckEngine", "CheckResult", "ExpandEngine", "Membership"]

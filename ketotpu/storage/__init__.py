@@ -1,0 +1,29 @@
+"""Relation-tuple storage: in-memory manager, traverser, namespace managers.
+
+The store is the system of record for writes (the Manager seam,
+`internal/relationtuple/definitions.go:27-33`); the TPU engine reads
+projected CSR snapshots of it, not the store directly.
+"""
+
+from ketotpu.storage.memory import ErrMalformedPageToken, InMemoryTupleStore
+from ketotpu.storage.namespaces import (
+    OPLFileNamespaceManager,
+    StaticNamespaceManager,
+    ast_relation_for,
+)
+from ketotpu.storage.traverser import (
+    TraversalDirection,
+    TraversalResult,
+    Traverser,
+)
+
+__all__ = [
+    "ErrMalformedPageToken",
+    "InMemoryTupleStore",
+    "OPLFileNamespaceManager",
+    "StaticNamespaceManager",
+    "TraversalDirection",
+    "TraversalResult",
+    "Traverser",
+    "ast_relation_for",
+]

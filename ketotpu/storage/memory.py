@@ -1,0 +1,194 @@
+"""In-memory relation-tuple store.
+
+Implements the Manager contract of the reference persister
+(`internal/persistence/sql/relationtuples.go:207-287`): filtered reads with
+opaque-token pagination, existence probes, transactional insert+delete, and
+delete-by-query — over an ordered in-memory map with secondary indexes instead
+of SQL.  Duplicate tuples are allowed, as in the reference (every insert is a
+fresh row keyed by a new id, relationtuples.go:112-115).
+
+The store versions itself: every committed write bumps ``version`` and fires
+registered change listeners.  Snapshot projection (CSR for the TPU engine)
+keys off that version.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+from ketotpu.api.types import (
+    BadRequestError,
+    RelationQuery,
+    RelationTuple,
+    Subject,
+    SubjectSet,
+)
+
+DEFAULT_PAGE_SIZE = 100
+
+
+def ErrMalformedPageToken() -> BadRequestError:
+    return BadRequestError("malformed page token")
+
+
+class InMemoryTupleStore:
+    """Ordered tuple store with by-userset and by-subject indexes."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._rows: Dict[int, RelationTuple] = {}  # seq -> tuple, insertion order
+        self._next_seq = 0
+        # (namespace, object, relation) -> [seq]; the forward index backing
+        # expand / subject-set traversal (the reference's
+        # idx_relation_tuples_full partial indexes).
+        self._by_userset: Dict[Tuple[str, str, str], List[int]] = {}
+        # subject unique_id -> [seq]; the reverse-subject index.
+        self._by_subject: Dict[str, List[int]] = {}
+        self.version = 0
+        self._listeners: List[Callable[[int], None]] = []
+
+    # -- change notification -------------------------------------------------
+
+    def on_change(self, fn: Callable[[int], None]) -> None:
+        self._listeners.append(fn)
+
+    def _bump(self) -> None:
+        self.version += 1
+        for fn in self._listeners:
+            fn(self.version)
+
+    # -- reads ---------------------------------------------------------------
+
+    def get_relation_tuples(
+        self,
+        query: Optional[RelationQuery] = None,
+        *,
+        page_token: str = "",
+        page_size: int = 0,
+    ) -> Tuple[List[RelationTuple], str]:
+        """Return (tuples, next_page_token); empty token means last page."""
+        if page_size <= 0:
+            page_size = DEFAULT_PAGE_SIZE
+        after = -1
+        if page_token:
+            try:
+                after = int(page_token)
+            except ValueError:
+                raise ErrMalformedPageToken() from None
+
+        with self._lock:
+            out: List[Tuple[int, RelationTuple]] = []
+            for seq in self._candidates(query):
+                if seq <= after:
+                    continue
+                t = self._rows.get(seq)
+                if t is not None and _matches(t, query):
+                    out.append((seq, t))
+                    if len(out) > page_size:
+                        # one overflow row fetched: a next page exists
+                        page = out[:page_size]
+                        return [t for _, t in page], str(page[-1][0])
+        return [t for _, t in out], ""
+
+    def _candidates(self, query: Optional[RelationQuery]) -> Iterable[int]:
+        """Pick the most selective index for the query; always sorted by seq."""
+        if query is not None and query.namespace is not None and query.object is not None \
+                and query.relation is not None:
+            return list(self._by_userset.get(
+                (query.namespace, query.object, query.relation), ()))
+        if query is not None and query.subject() is not None:
+            return list(self._by_subject.get(query.subject().unique_id(), ()))
+        return list(self._rows.keys())
+
+    def exists_relation_tuples(self, query: Optional[RelationQuery] = None) -> bool:
+        with self._lock:
+            return any(_matches(self._rows[s], query) for s in self._candidates(query))
+
+    def __len__(self) -> int:
+        return len(self._rows)
+
+    def all_tuples(self) -> List[RelationTuple]:
+        with self._lock:
+            return list(self._rows.values())
+
+    # -- writes --------------------------------------------------------------
+
+    def write_relation_tuples(self, *tuples: RelationTuple) -> None:
+        self.transact_relation_tuples(insert=tuples, delete=())
+
+    def delete_relation_tuples(self, *tuples: RelationTuple) -> None:
+        self.transact_relation_tuples(insert=(), delete=tuples)
+
+    def transact_relation_tuples(
+        self,
+        insert: Iterable[RelationTuple] = (),
+        delete: Iterable[RelationTuple] = (),
+    ) -> None:
+        """Apply inserts then deletes atomically (transact_server semantics:
+        sql/relationtuples.go:277-287)."""
+        insert, delete = list(insert), list(delete)
+        for t in insert:
+            if t.subject is None:
+                raise BadRequestError("subject is not allowed to be nil")
+        with self._lock:
+            for t in insert:
+                self._insert_locked(t)
+            n_deleted = 0
+            for t in delete:
+                n_deleted += self._delete_exact_locked(t)
+            if insert or n_deleted:
+                self._bump()
+
+    def delete_all_relation_tuples(self, query: Optional[RelationQuery] = None) -> int:
+        with self._lock:
+            doomed = [s for s in self._candidates(query) if _matches(self._rows[s], query)]
+            for seq in doomed:
+                self._remove_row_locked(seq)
+            if doomed:
+                self._bump()
+            return len(doomed)
+
+    # -- internals -----------------------------------------------------------
+
+    def _insert_locked(self, t: RelationTuple) -> None:
+        seq = self._next_seq
+        self._next_seq += 1
+        self._rows[seq] = t
+        self._by_userset.setdefault((t.namespace, t.object, t.relation), []).append(seq)
+        self._by_subject.setdefault(t.subject.unique_id(), []).append(seq)
+
+    def _delete_exact_locked(self, t: RelationTuple) -> int:
+        key = (t.namespace, t.object, t.relation)
+        n = 0
+        for seq in list(self._by_userset.get(key, ())):
+            if self._rows[seq] == t:
+                self._remove_row_locked(seq)
+                n += 1
+        return n
+
+    def _remove_row_locked(self, seq: int) -> None:
+        t = self._rows.pop(seq)
+        key = (t.namespace, t.object, t.relation)
+        self._by_userset[key].remove(seq)
+        if not self._by_userset[key]:
+            del self._by_userset[key]
+        sid = t.subject.unique_id()
+        self._by_subject[sid].remove(seq)
+        if not self._by_subject[sid]:
+            del self._by_subject[sid]
+
+
+def _matches(t: RelationTuple, q: Optional[RelationQuery]) -> bool:
+    if q is None:
+        return True
+    if q.namespace is not None and t.namespace != q.namespace:
+        return False
+    if q.object is not None and t.object != q.object:
+        return False
+    if q.relation is not None and t.relation != q.relation:
+        return False
+    subject = q.subject()
+    if subject is not None and t.subject != subject:
+        return False
+    return True

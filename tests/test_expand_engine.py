@@ -1,0 +1,113 @@
+"""Expand-engine tests mirroring internal/expand/engine_test.go."""
+
+from ketotpu.api.types import (
+    RelationTuple,
+    SubjectID,
+    SubjectSet,
+    TreeNodeType,
+)
+from ketotpu.engine import ExpandEngine
+from ketotpu.storage import InMemoryTupleStore
+
+T = RelationTuple.from_string
+
+
+def subjects(tree):
+    return [c.tuple.subject for c in tree.children]
+
+
+def make(tuples, **kw):
+    store = InMemoryTupleStore()
+    store.write_relation_tuples(*[T(s) for s in tuples])
+    return ExpandEngine(store, **kw)
+
+
+class TestExpand:
+    def test_returns_subject_id_on_expand(self):
+        e = make([])
+        tree = e.build_tree(SubjectID("user"), 100)
+        assert tree.type == TreeNodeType.LEAF
+        assert tree.tuple.subject == SubjectID("user")
+
+    def test_expands_one_level(self):
+        e = make(
+            ["z:boulderers#member@tammo", "z:boulderers#member@pike"]
+        )
+        tree = e.build_tree(SubjectSet("z", "boulderers", "member"), 100)
+        assert tree.type == TreeNodeType.UNION
+        assert subjects(tree) == [SubjectID("tammo"), SubjectID("pike")]
+
+    def test_expands_two_levels(self):
+        e = make(
+            [
+                "z:obj#access@z:orgA#member",
+                "z:obj#access@z:orgB#member",
+                "z:orgA#member@alice",
+                "z:orgA#member@bob",
+                "z:orgB#member@carol",
+            ]
+        )
+        tree = e.build_tree(SubjectSet("z", "obj", "access"), 100)
+        assert tree.type == TreeNodeType.UNION
+        a, b = tree.children
+        assert a.type == TreeNodeType.UNION
+        assert a.tuple.subject == SubjectSet("z", "orgA", "member")
+        assert subjects(a) == [SubjectID("alice"), SubjectID("bob")]
+        assert b.type == TreeNodeType.UNION
+        assert subjects(b) == [SubjectID("carol")]
+
+    def test_respects_max_depth(self):
+        # chain a <- b <- c <- d; with depth 4 the last expanded node becomes
+        # a leaf holding the subject set (engine.go:101-104)
+        e = make(
+            [
+                "z:a#r@z:b#r",
+                "z:b#r@z:c#r",
+                "z:c#r@z:d#r",
+                "z:d#r@end",
+            ]
+        )
+        tree = e.build_tree(SubjectSet("z", "a", "r"), 4)
+        n = tree
+        depth = 1
+        while n.children:
+            assert n.type == TreeNodeType.UNION
+            n = n.children[0]
+            depth += 1
+        assert n.type == TreeNodeType.LEAF
+        # depth 4: a(union) -> b(union) -> c(union) -> d(leaf, unexpanded)
+        assert depth == 4
+        assert n.tuple.subject == SubjectSet("z", "d", "r")
+
+    def test_paginates(self):
+        tuples = [f"z:group#member@user{i:02d}" for i in range(150)]
+        e = make(tuples)
+        tree = e.build_tree(SubjectSet("z", "group", "member"), 100)
+        assert len(tree.children) == 150
+        assert all(c.type == TreeNodeType.LEAF for c in tree.children)
+
+    def test_handles_subject_sets_as_leaf(self):
+        # a subject set pointing nowhere stays a leaf
+        e = make(["z:group#member@z:other#rel"])
+        tree = e.build_tree(SubjectSet("z", "group", "member"), 100)
+        assert tree.type == TreeNodeType.UNION
+        assert tree.children[0].type == TreeNodeType.LEAF
+        assert tree.children[0].tuple.subject == SubjectSet("z", "other", "rel")
+
+    def test_nonexistent_userset_returns_none(self):
+        e = make([])
+        assert e.build_tree(SubjectSet("z", "nothing", "r"), 100) is None
+
+    def test_cycle_guard(self):
+        e = make(
+            [
+                "z:a#r@z:b#r",
+                "z:b#r@z:a#r",
+            ]
+        )
+        tree = e.build_tree(SubjectSet("z", "a", "r"), 100)
+        # b expands back to a, which is already visited -> child becomes leaf
+        b = tree.children[0]
+        assert b.tuple.subject == SubjectSet("z", "b", "r")
+        assert b.children[0].type == TreeNodeType.LEAF
+        assert b.children[0].tuple.subject == SubjectSet("z", "a", "r")

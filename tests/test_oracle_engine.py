@@ -1,0 +1,485 @@
+"""Check-engine oracle tests.
+
+Scenario-for-scenario port of the reference's engine tests
+(internal/check/engine_test.go:79-579) and the full userset-rewrite matrix
+(internal/check/rewrites_test.go:23-265), using string ids (UUID mapping is an
+API-layer concern here).
+"""
+
+from pathlib import Path
+
+import pytest
+
+from ketotpu.api.types import RelationTuple, SubjectID, SubjectSet, Tree
+from ketotpu.engine import CheckEngine, Membership
+from ketotpu.opl.ast import (
+    ComputedSubjectSet,
+    InvertResult,
+    Namespace,
+    Operator,
+    Relation,
+    RelationType,
+    SubjectSetRewrite,
+    TupleToSubjectSet,
+)
+from ketotpu.opl.parser import parse
+from ketotpu.storage import InMemoryTupleStore, StaticNamespaceManager
+
+T = RelationTuple.from_string
+
+
+def make_engine(namespaces, tuples, **kw):
+    store = InMemoryTupleStore()
+    store.write_relation_tuples(*[T(s) for s in tuples])
+    nsm = StaticNamespaceManager(namespaces) if namespaces is not None else None
+    return CheckEngine(store, nsm, **kw)
+
+
+class TestEngineBasics:
+    """engine_test.go:79-579"""
+
+    def test_respects_max_depth(self):
+        e = make_engine(
+            [Namespace("test")],
+            [
+                "test:object#admin@user",
+                "test:object#owner@test:object#admin",
+                "test:object#access@test:object#owner",
+            ],
+        )
+        q = T("test:object#access@user")
+        # request max-depth takes precedence; 2 is not enough, 3 is
+        assert e.check_is_member(q, 2) is False
+        assert e.check_is_member(q, 3) is True
+        # global max-depth takes precedence when lesser
+        e.max_depth = 2
+        assert e.check_is_member(q, 2) is False
+        e.max_depth = 3
+        assert e.check_is_member(q, 0) is True
+
+    @pytest.mark.parametrize(
+        "query",
+        [
+            "n:o#r@subject_id",
+            "n:o#r@u:with_relation#r",
+            "n:o#r@u:empty_relation",
+            "n:o#r@u:empty_relation#",
+            "n:o#r@u:missing_relation",
+            "n:o#r@u:missing_relation#",
+        ],
+    )
+    def test_direct_inclusion(self, query):
+        e = make_engine(
+            [Namespace("n"), Namespace("u")],
+            [
+                "n:o#r@subject_id",
+                "n:o#r@u:with_relation#r",
+                "n:o#r@u:empty_relation#",
+                "n:o#r@u:missing_relation",
+            ],
+        )
+        assert e.check_is_member(T(query), 0) is True
+
+    def test_indirect_inclusion_level_1(self):
+        e = make_engine(
+            [Namespace("sofa")],
+            [
+                "sofa:dust#have_to_remove@sofa:dust#producer",
+                "sofa:dust#producer@mark",
+            ],
+        )
+        assert e.check_is_member(T("sofa:dust#have_to_remove@mark"), 0) is True
+
+    def test_direct_exclusion(self):
+        e = make_engine([Namespace("n")], ["n:o#relation@user_a"])
+        assert e.check_is_member(T("n:o#relation@user_b"), 0) is False
+
+    @pytest.mark.parametrize(
+        "query", ["n:d#r@u", "n:c#r@u", "n:b#r@u", "n:a#r@u"]
+    )
+    def test_subject_expansion_chain(self, query):
+        e = make_engine(
+            [
+                Namespace(
+                    "n",
+                    relations=[
+                        Relation("r", types=[RelationType("n", "r")])
+                    ],
+                )
+            ],
+            ["n:a#r@n:b#r", "n:b#r@n:c#r", "n:c#r@n:d#r", "n:d#r@u"],
+        )
+        assert e.check_is_member(T(query), 0) is True
+
+    def test_wrong_object_id(self):
+        e = make_engine(
+            [Namespace("ns")],
+            ["ns:object#access@ns:object#owner", "ns:other#owner@user"],
+        )
+        assert e.check_is_member(T("ns:object#access@user"), 0) is False
+
+    def test_wrong_relation_name(self):
+        e = make_engine(
+            [Namespace("diaries")],
+            [
+                "diaries:entry#read@diaries:entry#author",
+                "diaries:entry#not_author@user",
+            ],
+        )
+        assert e.check_is_member(T("diaries:entry#read@user"), 0) is False
+
+    def test_indirect_inclusion_level_2(self):
+        e = make_engine(
+            [Namespace("obj"), Namespace("org")],
+            [
+                "obj:object#write@obj:object#owner",
+                "obj:object#owner@org:organization#member",
+                "org:organization#member@user",
+            ],
+        )
+        assert e.check_is_member(T("obj:object#write@user"), 0) is True
+        assert e.check_is_member(T("org:organization#member@user"), 0) is True
+
+    def test_rejects_transitive_relation(self):
+        # file <-parent- directory <-access- user, but no rewrite that would
+        # interpret "parent"; access to file must be denied.
+        e = make_engine(
+            [Namespace("2")],
+            ["2:file#parent@2:directory#", "2:directory#access@user"],
+        )
+        assert e.check_is_member(T("2:file#access@user"), 0) is False
+
+    def test_subject_id_next_to_subject_set(self):
+        e = make_engine(
+            [Namespace("39231")],
+            [
+                "39231:obj#owner@direct_owner",
+                "39231:obj#owner@39231:org#member",
+                "39231:org#member@indirect_owner",
+            ],
+        )
+        assert e.check_is_member(T("39231:obj#owner@direct_owner"), 0) is True
+        assert e.check_is_member(T("39231:obj#owner@indirect_owner"), 0) is True
+
+    def test_wide_tuple_graph(self):
+        users = [f"user{i}" for i in range(4)]
+        orgs = [f"org{i}" for i in range(2)]
+        tuples = [f"9234:obj#access@9234:{org}#member" for org in orgs]
+        tuples += [
+            f"9234:{orgs[i % len(orgs)]}#member@{user}"
+            for i, user in enumerate(users)
+        ]
+        e = make_engine([Namespace("9234")], tuples)
+        for user in users:
+            assert e.check_is_member(T(f"9234:obj#access@{user}"), 0) is True
+
+    def test_circular_tuples(self):
+        e = make_engine(
+            [Namespace("7743")],
+            [
+                "7743:sendlinger_tor#connected@7743:odeonsplatz#connected",
+                "7743:odeonsplatz#connected@7743:central_station#connected",
+                "7743:central_station#connected@7743:sendlinger_tor#connected",
+            ],
+        )
+        assert (
+            e.check_is_member(T("7743:sendlinger_tor#connected@central_station"), 0)
+            is False
+        )
+
+    def test_strict_mode(self):
+        src = Path(
+            "/root/reference/internal/check/testfixtures/project_opl.ts"
+        ).read_text()
+        namespaces, errors = parse(src)
+        assert not errors
+        store = InMemoryTupleStore()
+        store.write_relation_tuples(
+            *[
+                T(s)
+                for s in [
+                    "Project:abc#owner@User:1",
+                    "Project:abc#owner@User1",
+                    # ignored in strict mode:
+                    "Project:abc#isOwner@User:isOwner",
+                    "Project:abc#readProject@readProjectUser",
+                    "Project:abc#readProject@User:ReadProject",
+                ]
+            ]
+        )
+        e = CheckEngine(
+            store, StaticNamespaceManager(namespaces), strict_mode=True
+        )
+        for sub in ["readProjectUser", "User:ReadProject", "User:isOwner"]:
+            assert e.check_is_member(T(f"Project:abc#readProject@{sub}"), 10) is False
+        for sub in ["User:1", "User1"]:
+            assert e.check_is_member(T(f"Project:abc#readProject@{sub}"), 10) is True
+
+
+# --------------------------------------------------------------------------
+# Userset rewrite matrix (rewrites_test.go)
+# --------------------------------------------------------------------------
+
+REWRITE_NAMESPACES = [
+    Namespace(
+        "doc",
+        relations=[
+            Relation("owner"),
+            Relation(
+                "editor",
+                subject_set_rewrite=SubjectSetRewrite(
+                    children=[ComputedSubjectSet("owner")]
+                ),
+            ),
+            Relation(
+                "viewer",
+                subject_set_rewrite=SubjectSetRewrite(
+                    children=[
+                        ComputedSubjectSet("editor"),
+                        TupleToSubjectSet("parent", "viewer"),
+                    ]
+                ),
+            ),
+        ],
+    ),
+    Namespace("users"),
+    Namespace("group", relations=[Relation("member")]),
+    Namespace("level", relations=[Relation("member")]),
+    Namespace(
+        "resource",
+        relations=[
+            Relation("level"),
+            Relation(
+                "viewer",
+                subject_set_rewrite=SubjectSetRewrite(
+                    children=[TupleToSubjectSet("owner", "member")]
+                ),
+            ),
+            Relation(
+                "owner",
+                subject_set_rewrite=SubjectSetRewrite(
+                    children=[TupleToSubjectSet("owner", "member")]
+                ),
+            ),
+            Relation(
+                "read",
+                subject_set_rewrite=SubjectSetRewrite(
+                    children=[
+                        ComputedSubjectSet("viewer"),
+                        ComputedSubjectSet("owner"),
+                    ]
+                ),
+            ),
+            Relation(
+                "update",
+                subject_set_rewrite=SubjectSetRewrite(
+                    children=[ComputedSubjectSet("owner")]
+                ),
+            ),
+            Relation(
+                "delete",
+                subject_set_rewrite=SubjectSetRewrite(
+                    operation=Operator.AND,
+                    children=[
+                        ComputedSubjectSet("owner"),
+                        TupleToSubjectSet("level", "member"),
+                    ],
+                ),
+            ),
+        ],
+    ),
+    Namespace(
+        "acl",
+        relations=[
+            Relation("allow"),
+            Relation("deny"),
+            Relation(
+                "access",
+                subject_set_rewrite=SubjectSetRewrite(
+                    operation=Operator.AND,
+                    children=[
+                        ComputedSubjectSet("allow"),
+                        InvertResult(ComputedSubjectSet("deny")),
+                    ],
+                ),
+            ),
+        ],
+    ),
+]
+
+REWRITE_FIXTURES = [
+    "doc:document#owner@plain_user",
+    "doc:document#owner@users:user",
+    "doc:doc_in_folder#parent@doc:folder",
+    "doc:folder#owner@plain_user",
+    "doc:folder#owner@users:user",
+    # folder_a -> folder_b -> folder_c -> file; folder_a owned by user
+    "doc:file#parent@doc:folder_c",
+    "doc:folder_c#parent@doc:folder_b",
+    "doc:folder_b#parent@doc:folder_a",
+    "doc:folder_a#owner@user",
+    "group:editors#member@mark",
+    "level:superadmin#member@mark",
+    "level:superadmin#member@sandy",
+    "resource:topsecret#owner@group:editors#",
+    "resource:topsecret#level@level:superadmin#",
+    "resource:topsecret#owner@mike",
+    "acl:document#allow@alice",
+    "acl:document#allow@bob",
+    "acl:document#allow@mallory",
+    "acl:document#deny@mallory",
+]
+
+REWRITE_CASES = [
+    ("doc:document#owner@users:user", True),
+    ("doc:document#editor@users:user", True),
+    ("doc:document#editor@plain_user", True),
+    ("doc:document#viewer@users:user", True),
+    ("doc:document#editor@nobody", False),
+    ("doc:folder#viewer@users:user", True),
+    ("doc:doc_in_folder#viewer@users:user", True),
+    ("doc:doc_in_folder#viewer@plain_user", True),
+    ("doc:doc_in_folder#viewer@nobody", False),
+    ("doc:another_doc#viewer@user", False),
+    ("doc:file#viewer@user", True),
+    ("level:superadmin#member@mark", True),
+    ("resource:topsecret#owner@mark", True),
+    ("resource:topsecret#delete@mark", True),
+    ("resource:topsecret#update@mike", True),
+    ("level:superadmin#member@mike", False),
+    ("resource:topsecret#delete@mike", False),
+    ("resource:topsecret#delete@sandy", False),
+    ("acl:document#access@alice", True),
+    ("acl:document#access@bob", True),
+    ("acl:document#allow@mallory", True),
+    ("acl:document#access@mallory", False),
+]
+
+
+@pytest.fixture(scope="module")
+def rewrite_engine():
+    store = InMemoryTupleStore()
+    store.write_relation_tuples(*[T(s) for s in REWRITE_FIXTURES])
+    return CheckEngine(store, StaticNamespaceManager(REWRITE_NAMESPACES))
+
+
+class TestUsersetRewrites:
+    @pytest.mark.parametrize("query,expected", REWRITE_CASES)
+    def test_matrix(self, rewrite_engine, query, expected):
+        res = rewrite_engine.check_relation_tuple(T(query), 100)
+        assert res.allowed is expected, f"{query}: {res.membership}"
+
+    def test_delete_tree_paths(self, rewrite_engine):
+        res = rewrite_engine.check_relation_tuple(
+            T("resource:topsecret#delete@mark"), 100
+        )
+        assert res.allowed
+        assert _has_path(
+            ["*", "resource:topsecret#delete@mark", "level:superadmin#member@mark"],
+            res.tree,
+        )
+        assert _has_path(
+            [
+                "*",
+                "resource:topsecret#delete@mark",
+                "resource:topsecret#owner@mark",
+                "group:editors#member@mark",
+            ],
+            res.tree,
+        )
+
+    def test_access_tree_path(self, rewrite_engine):
+        res = rewrite_engine.check_relation_tuple(T("acl:document#access@alice"), 100)
+        assert res.allowed
+        assert _has_path(
+            ["*", "acl:document#access@alice", "acl:document#allow@alice"], res.tree
+        )
+
+
+def _has_path(path, tree: Tree) -> bool:
+    # rewrites_test.go:273-296
+    if not path:
+        return True
+    if tree is None:
+        return False
+    if path[0] != "*" and str(T(path[0])) != tree.label():
+        return False
+    if len(path) == 1:
+        return True
+    return any(_has_path(path[1:], child) for child in tree.children)
+
+
+class TestThreeValuedLogic:
+    """NOT must preserve UNKNOWN: a depth-exhausted subtree under a negation
+    may not flip to allowed (rewrites.go:186-195)."""
+
+    def test_depth_exhausted_deny_chain(self):
+        # access = allow AND NOT deny, where deny requires a deep chain to
+        # resolve.  Reference semantics quirk: the depth-exhausted UNKNOWN in
+        # the deny-subtree is swallowed to NOT_MEMBER by the enclosing
+        # checkgroup (concurrent_checkgroup.go:108-123) BEFORE the inversion,
+        # so NOT flips it to IS_MEMBER -- i.e. the reference allows access
+        # when the deny-chain is cut off by max-depth.  UNKNOWN preservation
+        # through NOT (rewrites.go:186-195) only applies when the depth guard
+        # fires directly at the inverted child.  The oracle reproduces this
+        # exactly.
+        namespaces = [
+            Namespace(
+                "acl",
+                relations=[
+                    Relation("allow"),
+                    Relation("deny"),
+                    Relation(
+                        "access",
+                        subject_set_rewrite=SubjectSetRewrite(
+                            operation=Operator.AND,
+                            children=[
+                                ComputedSubjectSet("allow"),
+                                InvertResult(ComputedSubjectSet("deny")),
+                            ],
+                        ),
+                    ),
+                ],
+            )
+        ]
+        tuples = [
+            "acl:doc#allow@mallory",
+            # deny only resolvable via a 3-hop subject-set chain
+            "acl:doc#deny@acl:g1#deny",
+            "acl:g1#deny@acl:g2#deny",
+            "acl:g2#deny@mallory",
+        ]
+        e_deep = make_engine(namespaces, tuples, max_depth=10)
+        assert e_deep.check_is_member(T("acl:doc#access@mallory"), 0) is False
+
+        e_shallow = make_engine(namespaces, tuples, max_depth=2)
+        res = e_shallow.check_relation_tuple(T("acl:doc#access@mallory"), 0)
+        # deny-chain unresolvable at depth 2: group-swallow + invert => allowed
+        assert res.membership is Membership.IS_MEMBER
+
+    def test_invert_preserves_unknown_directly(self):
+        # unit-level: _check_inverted with an exhausted budget stays UNKNOWN
+        from ketotpu.opl.ast import ComputedSubjectSet as CS, InvertResult as IR
+
+        e = make_engine([Namespace("n")], [])
+        res = e._check_inverted(
+            T("n:o#r@alice"), IR(CS("r2")), rest_depth=-1, visited=None
+        )
+        assert res.membership is Membership.UNKNOWN
+
+    def test_unknown_swallowed_by_group(self):
+        # a depth-exhausted expansion next to a successful direct hit: the
+        # UNKNOWN branch must not mask the IS_MEMBER
+        e = make_engine(
+            [Namespace("n")],
+            ["n:o#r@n:deep#r", "n:o#r@alice"],
+            max_depth=2,
+        )
+        assert e.check_is_member(T("n:o#r@alice"), 0) is True
+
+    def test_depth_one_cannot_even_check_direct(self):
+        # checkDirect runs at rest_depth-1 with a <=0 guard (engine.go:242,
+        # 168-172): at max_depth=1 even a directly-stored tuple is UNKNOWN,
+        # collapsing to not-allowed.
+        e = make_engine([Namespace("n")], ["n:o#r@alice"], max_depth=1)
+        assert e.check_is_member(T("n:o#r@alice"), 0) is False

@@ -1,0 +1,199 @@
+"""Storage tests mirroring the reference's persister conformance suite
+(internal/relationtuple/manager_requirements.go) and traverser tests."""
+
+import pytest
+
+from ketotpu.api.types import (
+    BadRequestError,
+    NotFoundError,
+    RelationQuery,
+    RelationTuple,
+    SubjectID,
+    SubjectSet,
+)
+from ketotpu.opl.ast import Namespace, Relation
+from ketotpu.storage import (
+    InMemoryTupleStore,
+    OPLFileNamespaceManager,
+    StaticNamespaceManager,
+    Traverser,
+    ast_relation_for,
+)
+
+T = RelationTuple.from_string
+
+
+@pytest.fixture
+def store():
+    return InMemoryTupleStore()
+
+
+class TestManager:
+    def test_write_and_get(self, store):
+        t = T("n:o#r@alice")
+        store.write_relation_tuples(t)
+        got, token = store.get_relation_tuples(RelationQuery(namespace="n"))
+        assert got == [t] and token == ""
+
+    def test_get_all_with_none_query(self, store):
+        ts = [T("a:b#c@x"), T("d:e#f@y")]
+        store.write_relation_tuples(*ts)
+        got, _ = store.get_relation_tuples(None)
+        assert got == ts
+
+    def test_query_by_each_field(self, store):
+        t1 = T("n:o#r@alice")
+        t2 = T("n:o#r2@bob")
+        t3 = T("n:o2#r@n:o#r")
+        store.write_relation_tuples(t1, t2, t3)
+
+        assert store.get_relation_tuples(RelationQuery(relation="r"))[0] == [t1, t3]
+        assert store.get_relation_tuples(RelationQuery(object="o2"))[0] == [t3]
+        q = RelationQuery().with_subject(SubjectID("alice"))
+        assert store.get_relation_tuples(q)[0] == [t1]
+        q = RelationQuery().with_subject(SubjectSet("n", "o", "r"))
+        assert store.get_relation_tuples(q)[0] == [t3]
+
+    def test_subject_id_does_not_match_subject_set(self, store):
+        # a subject set and a same-string subject id are distinct subjects
+        store.write_relation_tuples(T("n:o#r@x:y#z"))
+        assert not store.exists_relation_tuples(
+            RelationQuery(namespace="n").with_subject(SubjectID("x:y#z"))
+        )
+        assert store.exists_relation_tuples(
+            RelationQuery(namespace="n").with_subject(SubjectSet("x", "y", "z"))
+        )
+
+    def test_pagination(self, store):
+        ts = [T(f"n:o#r@user{i:03d}") for i in range(25)]
+        store.write_relation_tuples(*ts)
+        got, token = store.get_relation_tuples(
+            RelationQuery(namespace="n"), page_size=10
+        )
+        assert len(got) == 10 and token
+        got2, token2 = store.get_relation_tuples(
+            RelationQuery(namespace="n"), page_token=token, page_size=10
+        )
+        assert len(got2) == 10 and token2
+        got3, token3 = store.get_relation_tuples(
+            RelationQuery(namespace="n"), page_token=token2, page_size=10
+        )
+        assert len(got3) == 5 and token3 == ""
+        assert got + got2 + got3 == ts
+
+    def test_malformed_page_token(self, store):
+        with pytest.raises(BadRequestError):
+            store.get_relation_tuples(None, page_token="not-a-token")
+
+    def test_exact_last_page_has_no_token(self, store):
+        ts = [T(f"n:o#r@u{i}") for i in range(10)]
+        store.write_relation_tuples(*ts)
+        got, token = store.get_relation_tuples(None, page_size=10)
+        assert len(got) == 10 and token == ""
+
+    def test_delete_exact(self, store):
+        t1, t2 = T("n:o#r@a"), T("n:o#r@b")
+        store.write_relation_tuples(t1, t2)
+        store.delete_relation_tuples(t1)
+        assert store.all_tuples() == [t2]
+
+    def test_transact_insert_then_delete(self, store):
+        t1, t2 = T("n:o#r@a"), T("n:o#r@b")
+        store.write_relation_tuples(t1)
+        store.transact_relation_tuples(insert=[t2], delete=[t1])
+        assert store.all_tuples() == [t2]
+
+    def test_delete_all_by_query(self, store):
+        store.write_relation_tuples(T("n:o#r@a"), T("n:o#r@b"), T("n:x#r@c"))
+        n = store.delete_all_relation_tuples(RelationQuery(namespace="n", object="o"))
+        assert n == 2
+        assert [str(t) for t in store.all_tuples()] == ["n:x#r@c"]
+
+    def test_duplicates_allowed(self, store):
+        t = T("n:o#r@a")
+        store.write_relation_tuples(t, t)
+        assert len(store) == 2
+
+    def test_version_bumps_and_listener(self, store):
+        seen = []
+        store.on_change(seen.append)
+        store.write_relation_tuples(T("n:o#r@a"))
+        store.delete_all_relation_tuples(None)
+        assert seen == [1, 2]
+
+
+class TestTraverser:
+    def test_expansion_found_bit_and_short_circuit(self, store):
+        # obj#rel has three subject-set children; the second contains alice.
+        store.write_relation_tuples(
+            T("n:obj#rel@n:g1#member"),
+            T("n:obj#rel@n:g2#member"),
+            T("n:obj#rel@n:g3#member"),
+            T("n:g2#member@alice"),
+        )
+        tr = Traverser(store)
+        res = tr.traverse_subject_set_expansion(T("n:obj#rel@alice"))
+        # short-circuits after the found child: g3 never visited
+        assert [(str(r.to), r.found) for r in res] == [
+            ("n:g1#member@alice", False),
+            ("n:g2#member@alice", True),
+        ]
+
+    def test_expansion_ignores_plain_subjects(self, store):
+        store.write_relation_tuples(T("n:obj#rel@bob"), T("n:obj#rel@n:g1#m"))
+        tr = Traverser(store)
+        res = tr.traverse_subject_set_expansion(T("n:obj#rel@alice"))
+        assert [str(r.to) for r in res] == ["n:g1#m@alice"]
+
+    def test_rewrite_probe_hit(self, store):
+        store.write_relation_tuples(T("n:obj#owner@alice"))
+        tr = Traverser(store)
+        res = tr.traverse_subject_set_rewrite(
+            T("n:obj#view@alice"), ["reader", "owner"]
+        )
+        assert len(res) == 1 and res[0].found
+
+    def test_rewrite_probe_miss_returns_all_candidates(self, store):
+        tr = Traverser(store)
+        res = tr.traverse_subject_set_rewrite(T("n:obj#view@alice"), ["reader", "owner"])
+        assert [(str(r.to), r.found) for r in res] == [
+            ("n:obj#reader@alice", False),
+            ("n:obj#owner@alice", False),
+        ]
+
+
+class TestNamespaceManagers:
+    def test_static_lookup(self):
+        m = StaticNamespaceManager([Namespace("videos")])
+        assert m.get_namespace("videos").name == "videos"
+        with pytest.raises(NotFoundError):
+            m.get_namespace("nope")
+
+    def test_opl_file_reload_and_rollback(self, tmp_path):
+        p = tmp_path / "ns.ts"
+        p.write_text("class A implements Namespace {}")
+        m = OPLFileNamespaceManager(str(p))
+        assert [n.name for n in m.namespaces()] == ["A"]
+
+        # valid update is picked up
+        p.write_text("class A implements Namespace {}\nclass B implements Namespace {}")
+        import os
+
+        os.utime(p, (0, 12345))
+        assert [n.name for n in m.namespaces()] == ["A", "B"]
+
+        # broken update rolls back to the previous value
+        p.write_text("class ???")
+        os.utime(p, (0, 23456))
+        assert [n.name for n in m.namespaces()] == ["A", "B"]
+
+    def test_ast_relation_for_special_cases(self):
+        ns = Namespace("n", relations=[Relation("r")])
+        m = StaticNamespaceManager([ns, Namespace("legacy")])
+
+        assert ast_relation_for(m, "n", "") is None  # empty relation
+        assert ast_relation_for(m, "unknown", "r") is None  # unknown namespace
+        assert ast_relation_for(m, "legacy", "r") is None  # no relation config
+        assert ast_relation_for(m, "n", "r") is ns.relations[0]
+        with pytest.raises(BadRequestError):  # declared ns, undeclared relation
+            ast_relation_for(m, "n", "missing")
