@@ -16,8 +16,19 @@ import uuid
 from typing import Iterable, Optional
 
 
+class ReverseStore:
+    """A reverse uuid->string mapping with its own lock.
+
+    This is the shareable handle: every mapper given the same ReverseStore
+    synchronizes on the same lock (the analog of one keto_uuid_mappings
+    table shared by all connections)."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.data: dict = {}
+
+
 _SHARED_REVERSE: dict = {}
-_STORE_LOCKS: dict = {}
 _SHARED_LOCK = threading.Lock()
 
 
@@ -25,7 +36,6 @@ def reset_shared_stores() -> None:
     """Drop all process-global reverse mappings (tests, tenant eviction)."""
     with _SHARED_LOCK:
         _SHARED_REVERSE.clear()
-        _STORE_LOCKS.clear()
 
 
 class UUIDMapper:
@@ -42,34 +52,35 @@ class UUIDMapper:
         network_id: uuid.UUID,
         *,
         read_only: bool = False,
-        reverse_store: Optional[dict] = None,
+        reverse_store: Optional[ReverseStore] = None,
     ):
         # The reverse store is shared storage in the reference (the
         # keto_uuid_mappings table): a read-only mapper skips writes but still
-        # resolves reverse lookups from it.  Pass the same dict to every mapper
-        # of one network; by default a process-wide store per network is used.
+        # resolves reverse lookups from it.  Pass the same ReverseStore to
+        # every mapper of one network; by default a process-wide store per
+        # network is used.
         self.network_id = network_id
         self.read_only = read_only
-        with _SHARED_LOCK:
-            if reverse_store is None:
-                reverse_store = _SHARED_REVERSE.setdefault(network_id, {})
-            # One lock per store so all mappers sharing it synchronize.
-            self._lock = _STORE_LOCKS.setdefault(id(reverse_store), threading.Lock())
-        self._reverse = reverse_store
+        if reverse_store is None:
+            with _SHARED_LOCK:
+                reverse_store = _SHARED_REVERSE.setdefault(
+                    network_id, ReverseStore()
+                )
+        self._store = reverse_store
 
     def to_uuid(self, value: str) -> uuid.UUID:
         u = uuid.uuid5(self.network_id, value)
         if not self.read_only:
-            with self._lock:
-                self._reverse.setdefault(u, value)
+            with self._store.lock:
+                self._store.data.setdefault(u, value)
         return u
 
     def to_uuids(self, values: Iterable[str]) -> list:
         return [self.to_uuid(v) for v in values]
 
     def from_uuid(self, u: uuid.UUID) -> Optional[str]:
-        with self._lock:
-            return self._reverse.get(u)
+        with self._store.lock:
+            return self._store.data.get(u)
 
     def from_uuids(self, uuids: Iterable[uuid.UUID]) -> list:
         return [self.from_uuid(u) for u in uuids]

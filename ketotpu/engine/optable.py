@@ -1,0 +1,265 @@
+"""Compile namespace rewrite ASTs into flat numeric op tables.
+
+The reference interprets the rewrite AST lazily at check time
+(`internal/check/engine.go:260`, `rewrites.go:33-134`).  Here the whole
+namespace configuration is compiled once per snapshot into dense arrays the
+device interpreter walks with gathers — the "bytecode" the SURVEY calls for:
+
+* ``p_*``: a forest of program nodes (OR / AND / NOT / computed-subject-set /
+  tuple-to-subject-set / batched-computed-subject-set) with a CSR of children.
+* ``rel_meta``: per (namespace-id, relation-id) — rewrite program root, the
+  "relation does not exist" client error bit (namespace/definitions.go:61),
+  and whether the relation's types admit subject sets (strict mode,
+  engine.go:251-258).
+
+Semantics encoded structurally (all referencing the oracle / reference):
+
+* An OR node's ComputedSubjectSet children are batched into one BATCHCSS node
+  (the traverser shortcut, rewrites.go:62-93): its children are checked at
+  depth-1 with skip_direct, and relations are direct-probed first — in strict
+  mode only those without their own rewrite (sql/traverser.go:135-140).
+* Nested OR/AND under OR/AND recurse at depth-1 (rewrites.go:118); every other
+  child edge keeps the parent depth (``p_child_dec``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import numpy as np
+
+from ketotpu.engine.vocab import Vocab
+
+
+def _bucket(n: int, floor: int) -> int:
+    b = floor
+    while b < n:
+        b *= 2
+    return b
+from ketotpu.opl import ast
+from ketotpu.storage.namespaces import NamespaceManager
+
+# program node kinds
+P_OR = 0
+P_AND = 1
+P_NOT = 2
+P_CSS = 3
+P_TTU = 4
+P_BATCHCSS = 5
+
+
+@dataclass
+class OpTable:
+    """Numeric rewrite tables (host numpy; converted to jnp by the snapshot)."""
+
+    # program nodes
+    p_kind: np.ndarray  # int32[P]
+    p_a: np.ndarray  # int32[P]: CSS rel / TTU via-rel / BATCHCSS batch-row
+    p_b: np.ndarray  # int32[P]: TTU computed rel
+    p_child_ptr: np.ndarray  # int32[P+1]
+    p_child_idx: np.ndarray  # int32[C]
+    p_child_dec: np.ndarray  # int32[C]: depth decrement on that child edge
+    # batched computed-subject-set rows
+    b_ptr: np.ndarray  # int32[B+1]
+    b_rel: np.ndarray  # int32[BT]
+    b_probe: np.ndarray  # bool[BT]: include in the direct-probe shortcut
+    # per (namespace, relation)
+    prog_root: np.ndarray  # int32[NS, R]: -1 = no rewrite
+    rel_err: np.ndarray  # bool[NS, R]: lookup raises "relation does not exist"
+    can_sset: np.ndarray  # bool[NS, R]: strict-mode subject-set expansion gate
+
+
+@dataclass
+class _Builder:
+    p_kind: List[int] = field(default_factory=list)
+    p_a: List[int] = field(default_factory=list)
+    p_b: List[int] = field(default_factory=list)
+    p_children: List[List[int]] = field(default_factory=list)
+    p_child_decs: List[List[int]] = field(default_factory=list)
+    b_rows: List[List[int]] = field(default_factory=list)
+    b_probes: List[List[bool]] = field(default_factory=list)
+
+    def node(self, kind: int, a: int = -1, b: int = -1) -> int:
+        self.p_kind.append(kind)
+        self.p_a.append(a)
+        self.p_b.append(b)
+        self.p_children.append([])
+        self.p_child_decs.append([])
+        return len(self.p_kind) - 1
+
+
+def _has_own_rewrite(ns: ast.Namespace, relation: str) -> bool:
+    """Mirror the traverser's lenient AST lookup (errors => no rewrite)."""
+    if not ns.relations:
+        return False
+    rel = ns.relation(relation)
+    return rel is not None and rel.subject_set_rewrite is not None
+
+
+def _compile_child(
+    b: _Builder, vocab: Vocab, ns: ast.Namespace, child: ast.Child, strict: bool
+) -> int:
+    if isinstance(child, ast.SubjectSetRewrite):
+        return _compile_rewrite(b, vocab, ns, child, strict)
+    if isinstance(child, ast.ComputedSubjectSet):
+        return b.node(P_CSS, a=vocab.relations.intern(child.relation))
+    if isinstance(child, ast.TupleToSubjectSet):
+        return b.node(
+            P_TTU,
+            a=vocab.relations.intern(child.relation),
+            b=vocab.relations.intern(child.computed_subject_set_relation),
+        )
+    if isinstance(child, ast.InvertResult):
+        n = b.node(P_NOT)
+        c = _compile_child(b, vocab, ns, child.child, strict)
+        b.p_children[n].append(c)
+        b.p_child_decs[n].append(0)  # NOT children keep depth (rewrites.go:136-200)
+        return n
+    raise TypeError(f"unknown rewrite child {type(child)!r}")
+
+
+def _compile_rewrite(
+    b: _Builder,
+    vocab: Vocab,
+    ns: ast.Namespace,
+    rw: ast.SubjectSetRewrite,
+    strict: bool,
+) -> int:
+    kind = P_AND if rw.operation is ast.Operator.AND else P_OR
+    n = b.node(kind)
+
+    handled = set()
+    if rw.operation is ast.Operator.OR:
+        css = [
+            (i, c)
+            for i, c in enumerate(rw.children)
+            if isinstance(c, ast.ComputedSubjectSet)
+        ]
+        if css:
+            rels, probes = [], []
+            for i, c in css:
+                handled.add(i)
+                rels.append(vocab.relations.intern(c.relation))
+                # strict mode: relations with their own rewrites are excluded
+                # from the probe but stay as recursion children.
+                probes.append(not (strict and _has_own_rewrite(ns, c.relation)))
+            row = len(b.b_rows)
+            b.b_rows.append(rels)
+            b.b_probes.append(probes)
+            batch = b.node(P_BATCHCSS, a=row)
+            b.p_children[n].append(batch)
+            b.p_child_decs[n].append(0)
+
+    for i, c in enumerate(rw.children):
+        if i in handled:
+            continue
+        ci = _compile_child(b, vocab, ns, c, strict)
+        b.p_children[n].append(ci)
+        # nested or/and recurse at depth-1 (rewrites.go:118); leaves keep depth
+        b.p_child_decs[n].append(1 if isinstance(c, ast.SubjectSetRewrite) else 0)
+    return n
+
+
+def compile_op_table(
+    manager: Optional[NamespaceManager], vocab: Vocab, *, strict: bool
+) -> OpTable:
+    b = _Builder()
+    namespaces = manager.namespaces() if manager is not None else []
+
+    # Intern every config-mentioned string up front so table shapes are final.
+    for ns in namespaces:
+        vocab.namespaces.intern(ns.name)
+        for rel in ns.relations:
+            vocab.relations.intern(rel.name)
+            for t in rel.types:
+                vocab.namespaces.intern(t.namespace)
+                if t.relation:
+                    vocab.relations.intern(t.relation)
+
+    roots = {}  # (ns_id, rel_id) -> prog root
+    declared = {}  # ns_id -> set of declared rel ids (None = legacy no-config ns)
+    csets = {}  # (ns_id, rel_id) -> can have subject sets
+    for ns in namespaces:
+        ns_id = vocab.namespaces.intern(ns.name)
+        if not ns.relations:
+            declared[ns_id] = None  # legacy name-only namespace: no lookups fail
+            continue
+        declared[ns_id] = set()
+        for rel in ns.relations:
+            rel_id = vocab.relations.intern(rel.name)
+            declared[ns_id].add(rel_id)
+            csets[(ns_id, rel_id)] = any(t.relation != "" for t in rel.types)
+            if rel.subject_set_rewrite is not None:
+                roots[(ns_id, rel_id)] = _compile_rewrite(
+                    b, vocab, ns, rel.subject_set_rewrite, strict
+                )
+
+    # Pad to power-of-two buckets: stable shapes across config changes mean
+    # the jitted check step does not recompile (and tests share one compile).
+    num_ns = _bucket(max(len(vocab.namespaces), 1), 4)
+    num_rel = _bucket(max(len(vocab.relations), 1), 8)
+    prog_root = np.full((num_ns, num_rel), -1, np.int32)
+    rel_err = np.zeros((num_ns, num_rel), bool)
+    can_sset = np.ones((num_ns, num_rel), bool)
+    empty_rel = vocab.relations.lookup("")
+    for ns_id, rels in declared.items():
+        if rels is None:
+            continue
+        # any relation not declared on a configured namespace is a client
+        # error (namespace/definitions.go:61) — except the empty relation,
+        # which means "no AST" (definitions.go:38-40).
+        rel_err[ns_id, :] = True
+        rel_err[ns_id, empty_rel] = False
+        for rel_id in rels:
+            rel_err[ns_id, rel_id] = False
+            can_sset[ns_id, rel_id] = csets[(ns_id, rel_id)]
+    for (ns_id, rel_id), root in roots.items():
+        prog_root[ns_id, rel_id] = root
+
+    num_p = len(b.p_kind)
+    ppad = _bucket(max(num_p, 1), 8)
+    child_ptr = np.zeros(ppad + 1, np.int32)
+    for i, ch in enumerate(b.p_children):
+        child_ptr[i + 1] = child_ptr[i] + len(ch)
+    child_ptr[num_p:] = child_ptr[num_p]
+    n_child = int(child_ptr[num_p])
+    cpad = _bucket(max(n_child, 1), 8)
+    child_idx = np.zeros(cpad, np.int32)
+    child_dec = np.zeros(cpad, np.int32)
+    child_idx[:n_child] = [c for ch in b.p_children for c in ch]
+    child_dec[:n_child] = [d for ds in b.p_child_decs for d in ds]
+
+    bpad = _bucket(max(len(b.b_rows), 1), 4)
+    b_ptr = np.zeros(bpad + 1, np.int32)
+    for i, row in enumerate(b.b_rows):
+        b_ptr[i + 1] = b_ptr[i] + len(row)
+    b_ptr[len(b.b_rows):] = b_ptr[len(b.b_rows)]
+    n_brel = int(b_ptr[len(b.b_rows)])
+    btpad = _bucket(max(n_brel, 1), 8)
+    b_rel = np.zeros(btpad, np.int32)
+    b_probe = np.zeros(btpad, bool)
+    b_rel[:n_brel] = [r for row in b.b_rows for r in row]
+    b_probe[:n_brel] = [p for row in b.b_probes for p in row]
+
+    p_kind = np.zeros(ppad, np.int32)
+    p_a = np.full(ppad, -1, np.int32)
+    p_b = np.full(ppad, -1, np.int32)
+    p_kind[:num_p] = b.p_kind
+    p_a[:num_p] = b.p_a
+    p_b[:num_p] = b.p_b
+
+    return OpTable(
+        p_kind=p_kind,
+        p_a=p_a,
+        p_b=p_b,
+        p_child_ptr=child_ptr,
+        p_child_idx=child_idx,
+        p_child_dec=child_dec,
+        b_ptr=b_ptr,
+        b_rel=b_rel,
+        b_probe=b_probe,
+        prog_root=prog_root,
+        rel_err=rel_err,
+        can_sset=can_sset,
+    )

@@ -1,0 +1,198 @@
+"""Snapshot: project the tuple store into device-resident graph arrays.
+
+This replaces the reference's SQL round-trips (`internal/persistence/sql/
+relationtuples.go:207-287`, `traverser.go:53-191`) with a static-between-
+snapshots sparse graph in HBM:
+
+* **node table** — every userset ``(namespace, object, relation)`` that owns
+  at least one tuple, as two sorted int32 key columns
+  (``hi = ns * num_rels + rel``, ``lo = obj``) for lexicographic binary search.
+* **subject-set CSR** — per node, its subject-set tuples in insertion order
+  (pagination order parity with `relationtuples.go:216-219`): the one-hop
+  frontier of `TraverseSubjectSetExpansion` and `checkTupleToSubjectSet`.
+* **membership pairs** — every tuple as a sorted ``(node, subject-key)`` pair;
+  one lexicographic search replaces `ExistsRelationTuples`
+  (relationtuples.go:249-261).
+* **op table** — the compiled rewrite programs (see optable.py).
+
+Arrays are padded to power-of-two buckets so that small write deltas rebuild
+into the *same* shapes and the jitted check step does not recompile.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+import numpy as np
+
+from ketotpu.api.types import RelationTuple, SubjectSet
+from ketotpu.engine.optable import OpTable, compile_op_table
+from ketotpu.engine.vocab import Vocab
+from ketotpu.storage.memory import InMemoryTupleStore
+from ketotpu.storage.namespaces import NamespaceManager
+
+_I32MAX = np.iinfo(np.int32).max
+
+
+def _bucket(n: int, floor: int = 64) -> int:
+    b = floor
+    while b < n:
+        b *= 2
+    return b
+
+
+@dataclass
+class Snapshot:
+    """Device graph arrays (numpy here; the engine ships them to HBM)."""
+
+    vocab: Vocab
+    op: OpTable
+    num_rels: int  # hi-key stride, static per snapshot
+
+    node_hi: np.ndarray  # int32[N'] sorted (pad: I32MAX)
+    node_lo: np.ndarray  # int32[N']
+    row_ptr: np.ndarray  # int32[N'+1] subject-set CSR (pad rows: empty)
+    edge_ns: np.ndarray  # int32[E'] subject-set triple of the edge target
+    edge_obj: np.ndarray  # int32[E']
+    edge_rel: np.ndarray  # int32[E']
+    edge_node: np.ndarray  # int32[E'] node id of the target userset, -1 if none
+    mem_node: np.ndarray  # int32[M'] sorted with mem_subj (pad: I32MAX)
+    mem_subj: np.ndarray  # int32[M']
+
+    n_nodes: int
+    n_edges: int
+    n_tuples: int
+    version: int = -1
+
+    def arrays(self) -> Dict[str, np.ndarray]:
+        """The pytree of device arrays the jitted step consumes."""
+        return {
+            "node_hi": self.node_hi,
+            "node_lo": self.node_lo,
+            "row_ptr": self.row_ptr,
+            "edge_ns": self.edge_ns,
+            "edge_obj": self.edge_obj,
+            "edge_rel": self.edge_rel,
+            "edge_node": self.edge_node,
+            "mem_node": self.mem_node,
+            "mem_subj": self.mem_subj,
+            "p_kind": self.op.p_kind,
+            "p_a": self.op.p_a,
+            "p_b": self.op.p_b,
+            "p_child_ptr": self.op.p_child_ptr,
+            "p_child_idx": self.op.p_child_idx,
+            "p_child_dec": self.op.p_child_dec,
+            "b_ptr": self.op.b_ptr,
+            "b_rel": self.op.b_rel,
+            "b_probe": self.op.b_probe,
+            "prog_root": self.op.prog_root,
+            "rel_err": self.op.rel_err,
+            "can_sset": self.op.can_sset,
+        }
+
+    def node_key(self, ns_id: int, obj_id: int, rel_id: int):
+        return ns_id * self.num_rels + rel_id, obj_id
+
+
+def build_snapshot(
+    store: InMemoryTupleStore,
+    manager: Optional[NamespaceManager] = None,
+    vocab: Optional[Vocab] = None,
+    *,
+    strict: bool = False,
+) -> Snapshot:
+    vocab = vocab if vocab is not None else Vocab()
+    tuples = store.all_tuples()  # insertion (seq) order
+    for t in tuples:
+        vocab.intern_tuple(t)
+    op = compile_op_table(manager, vocab, strict=strict)
+    # the node hi-key stride is the (padded) relation dimension of the op
+    # table, so device-side key computation agrees with the build
+    num_rels = op.prog_root.shape[1]
+
+    def hi(ns: int, rel: int) -> int:
+        return ns * num_rels + rel
+
+    # -- node table ---------------------------------------------------------
+    triples = []  # (hi, lo) per tuple LHS
+    for t in tuples:
+        triples.append(
+            (
+                hi(vocab.namespaces.lookup(t.namespace), vocab.relations.lookup(t.relation)),
+                vocab.objects.lookup(t.object),
+            )
+        )
+    uniq = sorted(set(triples))
+    node_id = {k: i for i, k in enumerate(uniq)}
+    n_nodes = len(uniq)
+
+    # -- membership pairs ---------------------------------------------------
+    pairs = sorted(
+        (node_id[k], vocab.subjects.lookup(t.subject.unique_id()))
+        for k, t in zip(triples, tuples)
+    )
+    n_tuples = len(pairs)
+
+    # -- subject-set CSR (insertion order within each row) -------------------
+    per_row: Dict[int, list] = {}
+    for k, t in zip(triples, tuples):
+        if not isinstance(t.subject, SubjectSet):
+            continue
+        s = t.subject
+        s_ns = vocab.namespaces.lookup(s.namespace)
+        s_obj = vocab.objects.lookup(s.object)
+        s_rel = vocab.relations.lookup(s.relation)
+        per_row.setdefault(node_id[k], []).append(
+            (s_ns, s_obj, s_rel, node_id.get((hi(s_ns, s_rel), s_obj), -1))
+        )
+    n_edges = sum(len(v) for v in per_row.values())
+
+    # -- pack + pad ---------------------------------------------------------
+    npad = _bucket(n_nodes)
+    epad = _bucket(n_edges)
+    mpad = _bucket(n_tuples)
+
+    node_hi = np.full(npad, _I32MAX, np.int32)
+    node_lo = np.full(npad, _I32MAX, np.int32)
+    if n_nodes:
+        node_hi[:n_nodes] = [k[0] for k in uniq]
+        node_lo[:n_nodes] = [k[1] for k in uniq]
+
+    row_ptr = np.zeros(npad + 1, np.int32)
+    edge_ns = np.full(epad, -1, np.int32)
+    edge_obj = np.full(epad, -1, np.int32)
+    edge_rel = np.full(epad, -1, np.int32)
+    edge_node = np.full(epad, -1, np.int32)
+    e = 0
+    for n in range(n_nodes):
+        row_ptr[n] = e
+        for s_ns, s_obj, s_rel, s_node in per_row.get(n, ()):
+            edge_ns[e], edge_obj[e], edge_rel[e], edge_node[e] = s_ns, s_obj, s_rel, s_node
+            e += 1
+    row_ptr[n_nodes:] = e
+
+    mem_node = np.full(mpad, _I32MAX, np.int32)
+    mem_subj = np.full(mpad, _I32MAX, np.int32)
+    if n_tuples:
+        mem_node[:n_tuples] = [p[0] for p in pairs]
+        mem_subj[:n_tuples] = [p[1] for p in pairs]
+
+    return Snapshot(
+        vocab=vocab,
+        op=op,
+        num_rels=num_rels,
+        node_hi=node_hi,
+        node_lo=node_lo,
+        row_ptr=row_ptr,
+        edge_ns=edge_ns,
+        edge_obj=edge_obj,
+        edge_rel=edge_rel,
+        edge_node=edge_node,
+        mem_node=mem_node,
+        mem_subj=mem_subj,
+        n_nodes=n_nodes,
+        n_edges=n_edges,
+        n_tuples=n_tuples,
+        version=store.version,
+    )

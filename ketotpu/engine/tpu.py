@@ -1,0 +1,233 @@
+"""The TPU check engine: host wrapper around the batched device interpreter.
+
+Plays the role of the reference's `check.Engine` (`internal/check/engine.go:
+65-95`) behind the same provider seam: callers hand it relation tuples, it
+answers allow/deny.  Internally it
+
+1. projects the tuple store into a device snapshot (cached by store version,
+   rebuilt on write — the CSR analog of read-committed SQL),
+2. interns query strings to dense ids (unknown strings miss everywhere, which
+   reproduces "unknown namespace => not allowed", check/handler.go:169-171),
+3. dispatches the whole batch to `device.run_batch`, and
+4. falls back to the sequential oracle for queries the device flags —
+   capacity overflow or an error verdict (errors re-raise host-side with the
+   reference's exact message via the oracle path).
+
+`check()` is the single-query API; `batch_check()` is the throughput surface
+(the BatchCheck of BASELINE config #4 — the reference has no batch RPC at
+this version, SURVEY §2 proto row).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ketotpu.api.types import RelationTuple
+from ketotpu.engine import device as dev
+from ketotpu.engine.oracle import (
+    DEFAULT_MAX_DEPTH,
+    DEFAULT_MAX_WIDTH,
+    CheckEngine,
+)
+from ketotpu.engine.snapshot import Snapshot, build_snapshot
+from ketotpu.engine.vocab import Vocab
+from ketotpu.storage.memory import InMemoryTupleStore
+from ketotpu.storage.namespaces import NamespaceManager
+
+
+def _bucket(n: int, floor: int = 32) -> int:
+    b = floor
+    while b < n:
+        b *= 2
+    return b
+
+
+class DeviceCheckEngine:
+    """Batched permission checks on the device, oracle fallback on the host."""
+
+    def __init__(
+        self,
+        store: InMemoryTupleStore,
+        namespace_manager: Optional[NamespaceManager] = None,
+        *,
+        max_depth: int = DEFAULT_MAX_DEPTH,
+        max_width: int = DEFAULT_MAX_WIDTH,
+        strict_mode: bool = False,
+        cap: int = 8192,
+        arena: int = 8192,
+        vcap: int = 4096,
+        max_iters: int = 64,
+        max_batch: int = 1024,
+    ):
+        self.store = store
+        self.namespace_manager = namespace_manager
+        self.max_depth = max_depth
+        self.max_width = max_width
+        self.strict_mode = strict_mode
+        self.cap = cap
+        self.arena = arena
+        self.vcap = vcap
+        self.max_iters = max_iters
+        self.max_batch = min(max_batch, cap // 4)
+        self.oracle = CheckEngine(
+            store,
+            namespace_manager,
+            max_depth=max_depth,
+            max_width=max_width,
+            strict_mode=strict_mode,
+        )
+        self._vocab = Vocab()
+        self._snap: Optional[Snapshot] = None
+        self._device_arrays = None
+        self.fallbacks = 0  # observability: host-fallback counter
+
+    # -- snapshot lifecycle -------------------------------------------------
+
+    def snapshot(self) -> Snapshot:
+        if self._snap is None or self._snap.version != self.store.version:
+            self._snap = build_snapshot(
+                self.store,
+                self.namespace_manager,
+                self._vocab,
+                strict=self.strict_mode,
+            )
+            self._device_arrays = jax.device_put(self._snap.arrays())
+        return self._snap
+
+    # -- query encoding -----------------------------------------------------
+
+    def _encode(self, queries: Sequence[RelationTuple], rest_depth: int):
+        snap = self.snapshot()
+        v = snap.vocab
+        n = len(queries)
+        q_ns = np.full(n, -1, np.int32)
+        q_obj = np.full(n, -1, np.int32)
+        q_rel = np.full(n, -1, np.int32)
+        q_subj = np.full(n, -1, np.int32)
+        for i, q in enumerate(queries):
+            q_ns[i] = v.namespaces.lookup(q.namespace)
+            q_obj[i] = v.objects.lookup(q.object)
+            q_rel[i] = v.relations.lookup(q.relation)
+            q_subj[i] = v.subject_key(q.subject)
+        # global max-depth precedence (engine.go:82-84)
+        if rest_depth <= 0 or self.max_depth < rest_depth:
+            rest_depth = self.max_depth
+        q_depth = np.full(n, rest_depth, np.int32)
+        return q_ns, q_obj, q_rel, q_subj, q_depth
+
+    def _needs_host(self, q: RelationTuple) -> bool:
+        """A top-level relation undeclared on a configured namespace is a
+        client error (namespace/definitions.go:61).  Declared relations are
+        always in the vocab, so this only triggers for genuine errors the
+        device can't see (its ids are -1 for unknown strings)."""
+        if self.namespace_manager is None:
+            return False
+        try:
+            from ketotpu.storage.namespaces import ast_relation_for
+
+            ast_relation_for(self.namespace_manager, q.namespace, q.relation)
+            return False
+        except Exception:
+            return True
+
+    # -- public API ---------------------------------------------------------
+
+    def check(self, r: RelationTuple, rest_depth: int = 0) -> bool:
+        return self.batch_check([r], rest_depth)[0]
+
+    def check_is_member(self, r: RelationTuple, rest_depth: int = 0) -> bool:
+        return self.check(r, rest_depth)
+
+    def batch_check(
+        self, queries: Sequence[RelationTuple], rest_depth: int = 0
+    ) -> List[bool]:
+        out: List[Optional[bool]] = [None] * len(queries)
+        for lo in range(0, len(queries), self.max_batch):
+            chunk = list(queries)[lo : lo + self.max_batch]
+            for i, r in enumerate(
+                self._batch_check_chunk(chunk, rest_depth)
+            ):
+                out[lo + i] = r
+        return out  # type: ignore[return-value]
+
+    def _batch_check_chunk(
+        self, queries: Sequence[RelationTuple], rest_depth: int
+    ) -> List[bool]:
+        if not queries:
+            return []
+        q_ns, q_obj, q_rel, q_subj, q_depth = self._encode(queries, rest_depth)
+        # pad the batch to a bucket so jit caches across batch sizes
+        n = len(queries)
+        qpad = _bucket(n)
+        pad = qpad - n
+        if pad:
+            q_ns = np.pad(q_ns, (0, pad), constant_values=-1)
+            q_obj = np.pad(q_obj, (0, pad), constant_values=-1)
+            q_rel = np.pad(q_rel, (0, pad), constant_values=-1)
+            q_subj = np.pad(q_subj, (0, pad), constant_values=-1)
+            q_depth = np.pad(q_depth, (0, pad), constant_values=1)
+
+        res = dev.run_batch(
+            self._device_arrays,
+            q_ns,
+            q_obj,
+            q_rel,
+            q_subj,
+            q_depth,
+            cap=self.cap,
+            arena=self.arena,
+            vcap=self.vcap,
+            max_iters=self.max_iters,
+            max_width=self.max_width,
+            strict=self.strict_mode,
+        )
+        codes = np.asarray(res.result)[:n]
+        over = np.asarray(res.overflow)[:n]
+
+        out: List[bool] = []
+        for i, r in enumerate(queries):
+            if over[i] or codes[i] == dev.R_ERR or self._needs_host(r):
+                # oracle reproduces the exact verdict or typed error
+                self.fallbacks += 1
+                out.append(self.oracle.check_is_member(r, rest_depth))
+            else:
+                out.append(bool(codes[i] == dev.R_IS))
+        return out
+
+    def batch_check_device_only(
+        self, queries: Sequence[RelationTuple], rest_depth: int = 0
+    ):
+        """Device verdicts without fallback: (allowed[], fallback_needed[]).
+        Test/diagnostic surface."""
+        n = len(queries)
+        q_ns, q_obj, q_rel, q_subj, q_depth = self._encode(queries, rest_depth)
+        pad = _bucket(n) - n
+        if pad:
+            q_ns = np.pad(q_ns, (0, pad), constant_values=-1)
+            q_obj = np.pad(q_obj, (0, pad), constant_values=-1)
+            q_rel = np.pad(q_rel, (0, pad), constant_values=-1)
+            q_subj = np.pad(q_subj, (0, pad), constant_values=-1)
+            q_depth = np.pad(q_depth, (0, pad), constant_values=1)
+        res = dev.run_batch(
+            self._device_arrays,
+            q_ns,
+            q_obj,
+            q_rel,
+            q_subj,
+            q_depth,
+            cap=self.cap,
+            arena=self.arena,
+            vcap=self.vcap,
+            max_iters=self.max_iters,
+            max_width=self.max_width,
+            strict=self.strict_mode,
+        )
+        codes = np.asarray(res.result)[:n]
+        over = np.asarray(res.overflow)[:n]
+        needs = over | (codes == dev.R_ERR) | np.array(
+            [self._needs_host(q) for q in queries], dtype=bool
+        )
+        return (codes == dev.R_IS).tolist(), needs.tolist()

@@ -1,0 +1,71 @@
+"""String interning: the host-side vocabulary mapping API strings to dense ids.
+
+The reference maps every namespace/object/subject string to a UUIDv5 before it
+touches storage (`internal/relationtuple/uuid_mapping.go:199-267`,
+`internal/persistence/sql/uuid_mapping.go:35-74`).  On TPU we go one step
+further: dense int32 ids, so graph nodes index directly into CSR arrays.  The
+UUID mapper (`ketotpu/api/uuid_map.py`) stays the wire-parity layer; this
+vocabulary is the device-id layer.
+
+Interners are append-only so ids remain stable across snapshot rebuilds —
+arrays grow, existing ids never move (mirrors the reference's INSERT ON
+CONFLICT DO NOTHING mapping writes).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ketotpu.api.types import RelationTuple, Subject, SubjectSet
+
+
+class Interner:
+    """Append-only string -> int32 id mapping."""
+
+    def __init__(self):
+        self._ids: Dict[str, int] = {}
+
+    def intern(self, s: str) -> int:
+        i = self._ids.get(s)
+        if i is None:
+            i = len(self._ids)
+            self._ids[s] = i
+        return i
+
+    def lookup(self, s: str) -> int:
+        """-1 for unknown strings (a miss everywhere on device)."""
+        return self._ids.get(s, -1)
+
+    def __len__(self) -> int:
+        return len(self._ids)
+
+    def strings(self):
+        return list(self._ids.keys())
+
+
+class Vocab:
+    """The four id spaces of the tuple graph."""
+
+    def __init__(self):
+        self.namespaces = Interner()
+        self.objects = Interner()
+        self.relations = Interner()
+        self.subjects = Interner()  # keyed by Subject.unique_id()
+        # The empty relation is legal ("the object itself",
+        # ketoapi/enc_string.go:79-94) — always present.
+        self.relations.intern("")
+
+    def intern_tuple(self, t: RelationTuple) -> None:
+        self.namespaces.intern(t.namespace)
+        self.objects.intern(t.object)
+        self.relations.intern(t.relation)
+        self.subjects.intern(t.subject.unique_id())
+        if isinstance(t.subject, SubjectSet):
+            self.namespaces.intern(t.subject.namespace)
+            self.objects.intern(t.subject.object)
+            self.relations.intern(t.subject.relation)
+
+    def subject_key(self, s: Optional[Subject]) -> int:
+        if s is None:
+            return -1
+        return self.subjects.lookup(s.unique_id())

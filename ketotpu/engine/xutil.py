@@ -1,0 +1,113 @@
+"""Array utilities for the device check engine.
+
+Small, jittable building blocks: vectorized lexicographic binary search over
+multi-key sorted arrays (the device-side replacement for the reference's SQL
+index probes, `internal/persistence/sql/traverser.go:53-191`), and the
+prefix-sum "arena" expansion that turns per-task child counts into flat child
+slots (the batched replacement for goroutine fan-out in
+`internal/check/checkgroup/concurrent_checkgroup.go:66-138`).
+
+Everything works on int32 arrays and static shapes so XLA can tile it; no
+int64 needed (keys stay as tuples of int32 columns compared lexicographically).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _lex_less(a: Sequence[jax.Array], b: Sequence[jax.Array]) -> jax.Array:
+    """Elementwise a < b under lexicographic order over key columns."""
+    lt = jnp.zeros(jnp.broadcast_shapes(a[0].shape, b[0].shape), dtype=bool)
+    eq = jnp.ones_like(lt)
+    for ka, kb in zip(a, b):
+        lt = lt | (eq & (ka < kb))
+        eq = eq & (ka == kb)
+    return lt
+
+
+def _lex_eq(a: Sequence[jax.Array], b: Sequence[jax.Array]) -> jax.Array:
+    eq = jnp.ones(jnp.broadcast_shapes(a[0].shape, b[0].shape), dtype=bool)
+    for ka, kb in zip(a, b):
+        eq = eq & (ka == kb)
+    return eq
+
+
+def lex_searchsorted(
+    keys: Sequence[jax.Array], queries: Sequence[jax.Array]
+) -> Tuple[jax.Array, jax.Array]:
+    """Vectorized lexicographic binary search.
+
+    ``keys``: tuple of K sorted-together int32 columns, each of length N
+    (sorted by ``jax.lax.sort(..., num_keys=K)`` order).
+    ``queries``: tuple of K columns of query keys, each of length Q.
+
+    Returns ``(idx, found)``: the insertion point (first index with
+    key >= query) and whether the key at that index equals the query.
+    Works for N == 0 (idx = 0, found = False).
+    """
+    n = keys[0].shape[0]
+    q = queries[0].shape[0]
+    if n == 0:
+        return jnp.zeros((q,), jnp.int32), jnp.zeros((q,), bool)
+    lo = jnp.zeros((q,), jnp.int32)
+    hi = jnp.full((q,), n, jnp.int32)
+    # ceil(log2(n))+1 iterations; static trip count for jit.
+    iters = max(1, int(n).bit_length() + 1)
+
+    def body(_, state):
+        lo, hi = state
+        mid = (lo + hi) // 2
+        mid_keys = [k[jnp.clip(mid, 0, max(n - 1, 0))] for k in keys]
+        live = lo < hi
+        go_right = live & _lex_less(mid_keys, queries)  # key[mid] < query
+        lo = jnp.where(go_right, mid + 1, lo)
+        hi = jnp.where(go_right | ~live, hi, mid)
+        return lo, hi
+
+    lo, hi = jax.lax.fori_loop(0, iters, body, (lo, hi))
+    idx = lo
+    if n == 0:
+        return idx, jnp.zeros((q,), bool)
+    at = jnp.clip(idx, 0, n - 1)
+    found = (idx < n) & _lex_eq([k[at] for k in keys], queries)
+    return idx, found
+
+
+def lex_sort(keys: Sequence[jax.Array], *payload: jax.Array):
+    """Sort key columns lexicographically, carrying payload columns along."""
+    out = jax.lax.sort(tuple(keys) + tuple(payload), num_keys=len(keys))
+    return out[: len(keys)], out[len(keys):]
+
+
+def arena_assign(counts: jax.Array, arena_size: int) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Flatten per-task child counts into arena slots.
+
+    ``counts``: int32[T] children requested per task (0 for inactive tasks).
+
+    Returns ``(offsets, total, parent, ordinal)`` where ``offsets[t]`` is the
+    exclusive prefix sum (the arena base of task t's children), ``total`` the
+    scalar total, and for each arena slot ``j < arena_size``: ``parent[j]`` =
+    the task index owning the slot and ``ordinal[j]`` its child ordinal;
+    slots >= total get parent == -1.
+    """
+    counts = counts.astype(jnp.int32)
+    offsets = jnp.cumsum(counts) - counts
+    total = jnp.sum(counts)
+    j = jnp.arange(arena_size, dtype=jnp.int32)
+    # parent[j] = last t with offsets[t] <= j (only among counts>0 rows).
+    # searchsorted over "starts of occupied ranges": use offsets where count>0
+    # else a sentinel beyond the arena so empty tasks never win.
+    starts = jnp.where(counts > 0, offsets, jnp.iinfo(jnp.int32).max)
+    order = jnp.argsort(starts)
+    sorted_starts = starts[order]
+    pos = jnp.searchsorted(sorted_starts, j, side="right") - 1
+    parent = jnp.where(
+        (j < total) & (pos >= 0), order[jnp.clip(pos, 0, counts.shape[0] - 1)], -1
+    ).astype(jnp.int32)
+    safe_parent = jnp.clip(parent, 0, counts.shape[0] - 1)
+    ordinal = jnp.where(parent >= 0, j - offsets[safe_parent], 0).astype(jnp.int32)
+    return offsets.astype(jnp.int32), total.astype(jnp.int32), parent, ordinal

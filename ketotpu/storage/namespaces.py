@@ -54,6 +54,10 @@ class OPLFileNamespaceManager:
         self._namespaces: List[Namespace] = []
         self._mtime: Optional[float] = None
         self._last_errors: List[ParseError] = []
+        try:
+            self._mtime = os.stat(path).st_mtime
+        except OSError:
+            pass
         self._load(initial=True)
 
     def _load(self, *, initial: bool = False) -> None:

@@ -6,7 +6,11 @@ time (pytest imports conftest.py before collecting test modules).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force, don't setdefault: the ambient environment points JAX_PLATFORMS at
+# real TPU hardware, and running the test matrix over that tunnel is both slow
+# and single-device.  Benchmarks (bench.py) use the real chip; tests use a
+# virtual 8-device CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,400 @@
+"""Differential tests: device check engine vs the sequential oracle.
+
+The oracle (ketotpu/engine/oracle.py) carries the reference's exact semantics;
+every scenario here asserts the batched device interpreter reaches the same
+allow/deny verdicts — including the rewrite matrix of
+internal/check/rewrites_test.go and randomized graph fuzzing.
+"""
+
+import numpy as np
+import pytest
+
+from ketotpu.api.types import BadRequestError, RelationTuple
+from ketotpu.engine import CheckEngine
+from ketotpu.engine.tpu import DeviceCheckEngine
+from ketotpu.opl.ast import Namespace
+from ketotpu.opl.parser import parse
+from ketotpu.storage import InMemoryTupleStore, StaticNamespaceManager
+
+T = RelationTuple.from_string
+
+
+def make_engines(namespaces, tuples, *, opl=None, **kw):
+    store = InMemoryTupleStore()
+    store.write_relation_tuples(*[T(s) for s in tuples])
+    if opl is not None:
+        parsed, errs = parse(opl)
+        assert not errs, errs
+        namespaces = parsed
+    nsm = StaticNamespaceManager(namespaces) if namespaces is not None else None
+    oracle = CheckEngine(store, nsm, **{k.replace("strict_mode", "strict_mode"): v for k, v in kw.items()})
+    device = DeviceCheckEngine(store, nsm, **kw)
+    return oracle, device
+
+
+def assert_parity(oracle, device, queries, rest_depth=0, *, allow_fallback=False):
+    """Compare verdicts; by default also require the device answered itself."""
+    want = []
+    for q in queries:
+        try:
+            want.append(oracle.check_is_member(T(q), rest_depth))
+        except BadRequestError:
+            want.append("error")
+    if not allow_fallback:
+        dev_ok, needs = device.batch_check_device_only(
+            [T(q) for q in queries], rest_depth
+        )
+        for q, w, ok, nh in zip(queries, want, dev_ok, needs):
+            if w == "error":
+                assert nh, f"{q}: oracle errors but device did not flag fallback"
+            else:
+                assert not nh, f"{q}: device flagged fallback unexpectedly"
+                assert ok == w, f"{q}: device={ok} oracle={w}"
+    got = []
+    for q in queries:
+        try:
+            got.append(device.check(T(q), rest_depth))
+        except BadRequestError:
+            got.append("error")
+    assert got == want, f"full-path mismatch: {list(zip(queries, got, want))}"
+
+
+class TestDirectAndExpansion:
+    def test_direct(self):
+        o, d = make_engines(
+            [Namespace("n"), Namespace("u")],
+            [
+                "n:o#r@subject_id",
+                "n:o#r@u:with_relation#r",
+                "n:o#r@u:empty_relation#",
+                "n:o#r@u:missing_relation",
+            ],
+        )
+        assert_parity(
+            o,
+            d,
+            [
+                "n:o#r@subject_id",
+                "n:o#r@u:with_relation#r",
+                "n:o#r@u:empty_relation",
+                "n:o#r@u:empty_relation#",
+                "n:o#r@u:missing_relation",
+                "n:o#r@other",
+                "n:o#other@subject_id",
+                "unknown:o#r@subject_id",
+            ],
+        )
+
+    def test_indirect_chain_and_depth(self):
+        o, d = make_engines(
+            [Namespace("test")],
+            [
+                "test:object#admin@user",
+                "test:object#owner@test:object#admin",
+                "test:object#access@test:object#owner",
+            ],
+        )
+        q = ["test:object#access@user", "test:object#owner@user"]
+        for depth in (0, 1, 2, 3, 4, 10):
+            assert_parity(o, d, q, depth)
+
+    def test_cycle(self):
+        o, d = make_engines(
+            [Namespace("g")],
+            [
+                "g:a#member@g:b#member",
+                "g:b#member@g:a#member",
+                "g:b#member@user",
+            ],
+        )
+        assert_parity(
+            o, d, ["g:a#member@user", "g:b#member@user", "g:a#member@ghost"]
+        )
+
+    def test_wide_fanout(self):
+        tuples = [f"w:o#r@w:g{i}#m" for i in range(30)] + ["w:g29#m@user"]
+        o, d = make_engines([Namespace("w")], tuples)
+        assert_parity(o, d, ["w:o#r@user", "w:o#r@nobody"])
+
+    def test_width_truncation(self):
+        # 6 subject-set children with max_width 5: the last child is truncated
+        tuples = [f"w:o#r@w:g{i}#m" for i in range(6)] + ["w:g5#m@user"]
+        o, d = make_engines([Namespace("w")], tuples, max_width=5)
+        o.max_width = 5
+        assert_parity(o, d, ["w:o#r@user"])
+
+    def test_empty_relation_subject_set(self):
+        o, d = make_engines(
+            None,
+            ["files:f1#parent@dirs:d1", "dirs:d1#owner@user"],
+        )
+        assert_parity(o, d, ["files:f1#parent@dirs:d1", "files:f1#parent@user"])
+
+
+OPL_REWRITES = """
+import { Namespace, SubjectSet, Context } from "@ory/keto-namespace-types"
+
+class User implements Namespace {}
+
+class Group implements Namespace {
+  related: {
+    members: (User | Group)[]
+  }
+}
+
+class Folder implements Namespace {
+  related: {
+    viewers: (User | SubjectSet<Group, "members">)[]
+    owners: (User | SubjectSet<Group, "members">)[]
+  }
+  permits = {
+    view: (ctx: Context): boolean =>
+      this.related.viewers.includes(ctx.subject) ||
+      this.permits.owner(ctx),
+    owner: (ctx: Context): boolean =>
+      this.related.owners.includes(ctx.subject),
+  }
+}
+
+class File implements Namespace {
+  related: {
+    parents: (File | Folder)[]
+    viewers: (User | SubjectSet<Group, "members">)[]
+    owners: (User | SubjectSet<Group, "members">)[]
+  }
+  permits = {
+    view: (ctx: Context): boolean =>
+      this.related.parents.traverse((p) => p.permits.view(ctx)) ||
+      this.related.viewers.includes(ctx.subject) ||
+      this.permits.owner(ctx),
+    owner: (ctx: Context): boolean =>
+      this.related.owners.includes(ctx.subject),
+  }
+}
+"""
+
+
+class TestRewrites:
+    def test_computed_userset(self):
+        o, d = make_engines(
+            None,
+            ["Folder:f#owners@alice"],
+            opl=OPL_REWRITES,
+        )
+        assert_parity(
+            o,
+            d,
+            [
+                "Folder:f#view@alice",
+                "Folder:f#owner@alice",
+                "Folder:f#view@bob",
+            ],
+        )
+
+    def test_tuple_to_userset_chain(self):
+        o, d = make_engines(
+            None,
+            [
+                "File:report#parents@Folder:proj",
+                "Folder:proj#viewers@alice",
+                "Folder:proj#owners@carol",
+                "File:report#viewers@bob",
+                "Group:eng#members@dave",
+                "Folder:proj#viewers@Group:eng#members",
+            ],
+            opl=OPL_REWRITES,
+        )
+        assert_parity(
+            o,
+            d,
+            [
+                "File:report#view@alice",
+                "File:report#view@bob",
+                "File:report#view@carol",
+                "File:report#view@dave",
+                "File:report#view@mallory",
+                "Folder:proj#view@dave",
+            ],
+        )
+
+    def test_deep_parent_chain_vs_depth(self):
+        tuples = ["File:f0#viewers@alice"]
+        for i in range(6):
+            tuples.append(f"File:f{i+1}#parents@File:f{i}")
+        o, d = make_engines(None, tuples, opl=OPL_REWRITES)
+        queries = [f"File:f{i}#view@alice" for i in range(7)]
+        for depth in (0, 2, 3, 5, 20):
+            assert_parity(o, d, queries, depth)
+
+
+OPL_ANDNOT = """
+import { Namespace, SubjectSet, Context } from "@ory/keto-namespace-types"
+
+class User implements Namespace {}
+
+class Doc implements Namespace {
+  related: {
+    editors: User[]
+    signers: User[]
+    banned: User[]
+  }
+  permits = {
+    finalize: (ctx: Context): boolean =>
+      this.related.editors.includes(ctx.subject) &&
+      this.related.signers.includes(ctx.subject),
+    edit: (ctx: Context): boolean =>
+      this.related.editors.includes(ctx.subject) &&
+      !this.related.banned.includes(ctx.subject),
+  }
+}
+"""
+
+
+class TestAndNot:
+    def test_intersection(self):
+        o, d = make_engines(
+            None,
+            [
+                "Doc:a#editors@alice",
+                "Doc:a#signers@alice",
+                "Doc:a#editors@bob",
+            ],
+            opl=OPL_ANDNOT,
+        )
+        assert_parity(
+            o,
+            d,
+            [
+                "Doc:a#finalize@alice",
+                "Doc:a#finalize@bob",
+                "Doc:a#finalize@carol",
+            ],
+        )
+
+    def test_exclusion(self):
+        o, d = make_engines(
+            None,
+            [
+                "Doc:a#editors@alice",
+                "Doc:a#editors@bob",
+                "Doc:a#banned@bob",
+            ],
+            opl=OPL_ANDNOT,
+        )
+        assert_parity(
+            o,
+            d,
+            ["Doc:a#edit@alice", "Doc:a#edit@bob", "Doc:a#edit@carol"],
+        )
+
+    def test_exclusion_with_depth_exhaustion(self):
+        # NOT over an UNKNOWN subtree must stay UNKNOWN (rewrites.go:186-195)
+        tuples = ["Doc:a#editors@alice"]
+        o, d = make_engines(None, tuples, opl=OPL_ANDNOT)
+        for depth in (1, 2, 3):
+            assert_parity(o, d, ["Doc:a#edit@alice"], depth)
+
+
+class TestStrictMode:
+    def test_strict_suppresses_direct(self):
+        o, d = make_engines(
+            None,
+            ["Folder:f#view@eve", "Folder:f#owners@alice"],
+            opl=OPL_REWRITES,
+            strict_mode=True,
+        )
+        # direct tuple on a rewritten relation is ignored in strict mode
+        assert_parity(o, d, ["Folder:f#view@eve", "Folder:f#view@alice"])
+
+    def test_non_strict_allows_direct(self):
+        o, d = make_engines(
+            None,
+            ["Folder:f#view@eve"],
+            opl=OPL_REWRITES,
+        )
+        assert_parity(o, d, ["Folder:f#view@eve"])
+
+
+class TestErrors:
+    def test_undeclared_relation_is_client_error(self):
+        o, d = make_engines(None, ["User:u#x@y"], opl=OPL_REWRITES)
+        with pytest.raises(BadRequestError):
+            o.check_is_member(T("Folder:f#nosuch@alice"))
+        with pytest.raises(BadRequestError):
+            d.check(T("Folder:f#nosuch@alice"))
+
+    def test_error_reached_mid_traversal(self):
+        # Group:g#members leads into Folder:f#nosuch via a direct subject-set
+        o, d = make_engines(
+            None,
+            ["Group:g#members@Folder:f#nosuch"],
+            opl=OPL_REWRITES,
+        )
+        # oracle only errors when it actually traverses into the bad relation
+        assert_parity(o, d, ["Group:g#members@alice"], allow_fallback=True)
+
+
+def _random_case(rng):
+    n_ns = rng.integers(1, 3)
+    namespaces = []
+    rels = ["r0", "r1", "r2", "r3"]
+    lines = ["import { Namespace, SubjectSet, Context } from '@ory/keto-namespace-types'"]
+    for i in range(n_ns):
+        name = f"N{i}"
+        related = "\n".join(f"    {r}: N0[]" for r in rels[:2])
+        exprs = []
+        # r2: union of computed / ttu
+        choices = [
+            'this.related.r0.includes(ctx.subject)',
+            'this.related.r1.includes(ctx.subject)',
+            'this.related.r0.traverse((x) => x.permits.r3(ctx))',
+        ]
+        k = rng.integers(1, 3)
+        expr2 = " || ".join(rng.choice(choices, size=k, replace=False).tolist())
+        exprs.append(f"    r2: (ctx: Context): boolean =>\n      {expr2},")
+        # r3: maybe intersection/exclusion
+        style = rng.integers(0, 3)
+        if style == 0:
+            expr3 = "this.related.r0.includes(ctx.subject) && this.related.r1.includes(ctx.subject)"
+        elif style == 1:
+            expr3 = "this.related.r0.includes(ctx.subject) && !this.related.r1.includes(ctx.subject)"
+        else:
+            expr3 = "this.related.r1.includes(ctx.subject)"
+        exprs.append(f"    r3: (ctx: Context): boolean =>\n      {expr3},")
+        lines.append(
+            f"class {name} implements Namespace {{\n"
+            f"  related: {{\n{related}\n  }}\n"
+            f"  permits = {{\n" + "\n".join(exprs) + "\n  }\n}"
+        )
+        namespaces.append(name)
+    source = "\n".join(lines)
+
+    objects = [f"o{i}" for i in range(4)]
+    users = [f"u{i}" for i in range(3)]
+    tuples = set()
+    for _ in range(int(rng.integers(5, 25))):
+        ns = rng.choice(namespaces)
+        obj = rng.choice(objects)
+        rel = rng.choice(rels[:2])
+        if rng.random() < 0.5:
+            subj = rng.choice(users)
+        else:
+            subj = f"{rng.choice(namespaces)}:{rng.choice(objects)}#{rels[0]}"
+        tuples.add(f"{ns}:{obj}#{rel}@{subj}")
+
+    queries = []
+    for _ in range(20):
+        queries.append(
+            f"{rng.choice(namespaces)}:{rng.choice(objects)}"
+            f"#{rng.choice(rels)}@{rng.choice(users)}"
+        )
+    return source, sorted(tuples), queries
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_fuzz_random_graphs(seed):
+    rng = np.random.default_rng(seed)
+    source, tuples, queries = _random_case(rng)
+    o, d = make_engines(None, tuples, opl=source)
+    for depth in (0, 2, 4):
+        assert_parity(o, d, queries, depth, allow_fallback=True)
