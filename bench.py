@@ -1,0 +1,89 @@
+"""Benchmark: batched permission checks per second on the device engine.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: the reference's checked-in BenchmarkComputedUsersets figure —
+81,280 ns per sequential strict-mode check on in-memory SQLite
+(`benchtest.new.txt:5`), i.e. ~12,303 checks/s/core.  `vs_baseline` is the
+speedup multiple of this engine's batched throughput over that number.
+
+Workload: Drive-style synthetic graph (folder tree, group subject-sets,
+computed-userset + tuple-to-userset view chains — the "5-hop rewrites"
+BASELINE shape), batches of mixed doc-view checks, steady-state timing after
+a warmup batch.  Runs on whatever JAX platform is ambient (the real TPU chip
+under the driver; set JAX_PLATFORMS=cpu to try it without one).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_NS_PER_OP = 81_280  # reference benchtest.new.txt:5
+BATCH = 1024
+ROUNDS = 8
+
+
+def main() -> None:
+    from ketotpu.engine import device as dev
+    from ketotpu.engine.tpu import DeviceCheckEngine
+    from ketotpu.utils.synth import build_synth, synth_queries
+
+    graph = build_synth(
+        n_users=2000, n_groups=100, n_folders=2000, n_docs=20000, seed=0
+    )
+    eng = DeviceCheckEngine(
+        graph.store, graph.manager, cap=65536, arena=65536, vcap=32768,
+        max_batch=BATCH,
+    )
+    eng.snapshot()
+
+    queries = synth_queries(graph, BATCH * ROUNDS, seed=2)
+    batches = [
+        eng._encode(queries[i * BATCH : (i + 1) * BATCH], 0)
+        for i in range(ROUNDS)
+    ]
+
+    def run(b):
+        return dev.run_batch(
+            eng._device_arrays, *b,
+            cap=eng.cap, arena=eng.arena, vcap=eng.vcap,
+            max_iters=eng.max_iters, max_width=eng.max_width,
+            strict=eng.strict_mode,
+        )
+
+    # warmup/compile
+    warm = run(batches[0])
+    warm.result.block_until_ready()
+    fallback_rate = float(np.asarray(warm.overflow).mean())
+
+    t0 = time.perf_counter()
+    done = 0
+    for b in batches:
+        res = run(b)
+        done += b[0].shape[0]
+    res.result.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    checks_per_sec = done / dt
+    baseline = 1e9 / BASELINE_NS_PER_OP
+    print(
+        json.dumps(
+            {
+                "metric": "check_throughput",
+                "value": round(checks_per_sec, 1),
+                "unit": "checks/sec",
+                "vs_baseline": round(checks_per_sec / baseline, 3),
+                "batch": BATCH,
+                "tuples": len(graph.store),
+                "device_fallback_rate": fallback_rate,
+                "p50_batch_ms": round(1000 * dt / ROUNDS, 1),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

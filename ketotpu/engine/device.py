@@ -4,9 +4,9 @@ The reference resolves one Check by pointer-chasing through SQL with a
 goroutine per subcheck (`internal/check/engine.go:214-249`, `rewrites.go`,
 `checkgroup/concurrent_checkgroup.go:66-138`).  Here a *batch* of checks is
 one device program: every pending subcheck is a row in fixed-capacity task
-buffers, one `lax.while_loop` iteration expands the whole frontier a level
-(CSR gathers + membership binary searches), and results propagate up explicit
-parent pointers with OR/AND/NOT/PASS combiners — three-valued logic
+buffers, one step expands the whole frontier a level (CSR gathers +
+membership binary searches), and results propagate up explicit parent
+pointers with OR/AND/NOT/PASS combiners — three-valued logic
 {UNKNOWN, IS, NOT} plus an ERROR code standing in for Go error returns.
 
 Short-circuiting becomes masking: an OR parent resolves as soon as any child
@@ -19,6 +19,16 @@ line-by-line semantic contract this engine is differential-tested against.
 
 Queries that exceed a static capacity (task buffer, arena, or visited log)
 are flagged for host fallback instead of returning wrong answers.
+
+Execution is host-stepped: `check_step` is one flat jitted device program
+that advances every pending subcheck a level and runs a fixed number of
+result-propagation passes; `run_batch` drives it from the host with early
+exit.  This is deliberate — on current XLA:TPU, gathers/scatters nested
+inside a device-side `lax.while_loop` are demoted to the scalar core
+(~30-500x slower; measured ~6ms per gather per iteration at 2^17 rows), so
+the wavefront loop lives on the host and each step stays fully vectorized.
+The step costs one small host round-trip per frontier level (≤ max_depth ×
+rewrite-nesting levels, typically ~15), amortized across the whole batch.
 """
 
 from __future__ import annotations
@@ -28,6 +38,7 @@ from typing import Dict, NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ketotpu.engine.xutil import arena_assign, lex_searchsorted, lex_sort
 
@@ -44,11 +55,14 @@ OP_OR, OP_AND, OP_NOT, OP_PASS = 0, 1, 2, 3
 # prog node kinds (optable.py)
 P_OR, P_AND, P_NOT, P_CSS, P_TTU, P_BATCHCSS = 0, 1, 2, 3, 4, 5
 
+# flag bits returned per step
+F_PENDING, F_CHANGED, F_ALL_ROOTS_DONE = 1, 2, 4
+
 
 class RunResult(NamedTuple):
     result: jax.Array  # int32[Q] of R_* codes
     overflow: jax.Array  # bool[Q]: needs host fallback
-    iters: jax.Array  # int32 device iterations executed
+    iters: jax.Array  # int32 device steps executed
     tasks: jax.Array  # int32 tasks allocated (cursor)
 
 
@@ -73,17 +87,486 @@ def _row_deg(g, node):
     return jnp.where(node >= 0, deg, 0).astype(jnp.int32)
 
 
+def init_state(
+    q_ns, q_obj, q_rel, q_subj, q_depth, *, cap: int, vcap: int
+) -> Dict[str, jax.Array]:
+    """Fresh task buffers with one root K_CHECK per query in slots 0..Q-1."""
+    Q = q_ns.shape[0]
+    if Q > cap:
+        raise ValueError(f"batch {Q} exceeds task capacity {cap}")
+    iota = jnp.arange(cap, dtype=jnp.int32)
+    in_q = iota < Q
+
+    def pad(x, fill):
+        return jnp.where(
+            in_q, jnp.pad(jnp.asarray(x, jnp.int32), (0, cap - Q), constant_values=fill), fill
+        )
+
+    T = dict(
+        state=jnp.where(in_q, S_PENDING, S_EMPTY).astype(jnp.int32),
+        result=jnp.zeros((cap,), jnp.int32),
+        qid=jnp.where(in_q, iota, 0),
+        kind=jnp.full((cap,), KC_CHECK, jnp.int32),
+        ns=pad(q_ns, -1),
+        obj=pad(q_obj, -1),
+        rel=pad(q_rel, -1),
+        depth=pad(q_depth, 0),
+        skip=jnp.zeros((cap,), bool),
+        vscope=jnp.full((cap,), -1, jnp.int32),
+        parent=jnp.full((cap,), -1, jnp.int32),
+        prog=jnp.full((cap,), -1, jnp.int32),
+        cop=jnp.full((cap,), OP_OR, jnp.int32),
+        nchild=jnp.zeros((cap,), jnp.int32),
+        ndone=jnp.zeros((cap,), jnp.int32),
+        nis=jnp.zeros((cap,), jnp.int32),
+        nnot=jnp.zeros((cap,), jnp.int32),
+        nerr=jnp.zeros((cap,), jnp.int32),
+        delivered=jnp.zeros((cap,), bool),
+    )
+    return dict(
+        T=T,
+        vlog=tuple(jnp.full((vcap,), _I32MAX, jnp.int32) for _ in range(4)),
+        cursor=jnp.int32(Q),
+        vcursor=jnp.int32(0),
+        q_over=jnp.zeros((Q,), bool),
+        q_subj=jnp.asarray(q_subj, jnp.int32),
+        flags=jnp.int32(F_PENDING),
+    )
+
+
+def _propagate(T, q_over, Q, cap, iota, passes: int):
+    """Deliver resolved children, resolve combiners, cancel dead work.
+
+    ``passes`` flat passes: each moves results one level up the task tree;
+    undrained propagation continues on the next host step.
+    """
+    changed_any = jnp.bool_(False)
+    for _ in range(passes):
+        psafe = jnp.clip(T["parent"], 0, cap - 1)
+        deliver = (T["state"] == S_DONE) & ~T["delivered"] & (T["parent"] >= 0)
+        d32 = deliver.astype(jnp.int32)
+        T = dict(T)
+        T["ndone"] = T["ndone"].at[psafe].add(d32)
+        T["nis"] = T["nis"].at[psafe].add(d32 * (T["result"] == R_IS))
+        T["nnot"] = T["nnot"].at[psafe].add(d32 * (T["result"] == R_NOT))
+        T["nerr"] = T["nerr"].at[psafe].add(d32 * (T["result"] == R_ERR))
+        T["delivered"] = T["delivered"] | deliver
+
+        w = T["state"] == S_WAIT
+        nunk = T["ndone"] - T["nis"] - T["nnot"] - T["nerr"]
+        # error unwinds immediately, like a Go error return
+        r_err = T["nerr"] > 0
+        # checkgroup OR: first IS wins; all-done without IS => NOT
+        # (UNKNOWN swallowed, concurrent_checkgroup.go:108-123)
+        r_or_is = (T["cop"] == OP_OR) & (T["nis"] > 0)
+        r_or_not = (
+            (T["cop"] == OP_OR) & (T["ndone"] == T["nchild"]) & (T["nis"] == 0)
+        )
+        # AND: any non-IS (incl. UNKNOWN) => NOT; all IS => IS (binop.go:41-73)
+        r_and_not = (T["cop"] == OP_AND) & ((T["nnot"] > 0) | (nunk > 0))
+        r_and_is = (T["cop"] == OP_AND) & (T["ndone"] == T["nchild"]) & (
+            T["nis"] == T["nchild"]
+        )
+        one_done = T["ndone"] >= 1
+        # NOT flips IS<->NOT, preserves UNKNOWN (rewrites.go:186-195)
+        r_not = (T["cop"] == OP_NOT) & one_done
+        not_val = jnp.where(
+            T["nis"] > 0, R_NOT, jnp.where(T["nnot"] > 0, R_IS, R_UNKNOWN)
+        )
+        # PASS forwards the single child verbatim (rewrites.go:208-230)
+        r_pass = (T["cop"] == OP_PASS) & one_done
+        pass_val = jnp.where(
+            T["nis"] > 0, R_IS, jnp.where(T["nnot"] > 0, R_NOT, R_UNKNOWN)
+        )
+
+        resolved = w & (
+            r_err | r_or_is | r_or_not | r_and_not | r_and_is | r_not | r_pass
+        )
+        val = jnp.where(
+            r_err,
+            R_ERR,
+            jnp.where(
+                r_or_is | r_and_is,
+                R_IS,
+                jnp.where(
+                    r_or_not | r_and_not,
+                    R_NOT,
+                    jnp.where(r_not, not_val, pass_val),
+                ),
+            ),
+        )
+        T["state"] = jnp.where(resolved, S_DONE, T["state"])
+        T["result"] = jnp.where(resolved, val, T["result"])
+
+        # cancellation: dead parents kill pending/waiting descendants
+        par_state = T["state"][psafe]
+        active = (T["state"] == S_PENDING) | (T["state"] == S_WAIT)
+        cancel = active & (T["parent"] >= 0) & (
+            (par_state == S_DONE) | (par_state == S_CANCEL)
+        )
+        # whole query resolved => cancel its remaining tasks
+        root_state = T["state"][jnp.clip(T["qid"], 0, cap - 1)]
+        cancel = cancel | (active & (iota >= Q) & (root_state == S_DONE))
+        T["state"] = jnp.where(cancel, S_CANCEL, T["state"])
+
+        changed_any = (
+            changed_any | jnp.any(deliver) | jnp.any(resolved) | jnp.any(cancel)
+        )
+    return T, q_over, changed_any
+
+
 @functools.partial(
     jax.jit,
-    static_argnames=("cap", "arena", "vcap", "max_iters", "max_width", "strict"),
+    static_argnames=("cap", "arena", "vcap", "max_width", "strict", "prop_passes"),
 )
+def check_step(
+    g: Dict[str, jax.Array],
+    s: Dict[str, jax.Array],
+    *,
+    cap: int,
+    arena: int,
+    vcap: int,
+    max_width: int = 100,
+    strict: bool = False,
+    prop_passes: int = 4,
+) -> Dict[str, jax.Array]:
+    """One frontier level: expand all pending tasks, propagate results."""
+    Q = s["q_over"].shape[0]
+    NS, R = g["prog_root"].shape
+    iota = jnp.arange(cap, dtype=jnp.int32)
+
+    def full(v):
+        return jnp.full((cap,), v, jnp.int32)
+
+    def zeros():
+        return jnp.zeros((cap,), jnp.int32)
+
+    T = dict(s["T"])
+    q_subj = s["q_subj"]
+    cursor, vcursor, q_over = s["cursor"], s["vcursor"], s["q_over"]
+
+    # ---- phase A: classify pending tasks ------------------------------
+    pending = T["state"] == S_PENDING
+    nsc = jnp.clip(T["ns"], 0, NS - 1)
+    relc = jnp.clip(T["rel"], 0, R - 1)
+    valid = (T["ns"] >= 0) & (T["rel"] >= 0) & (T["ns"] < NS) & (T["rel"] < R)
+    prog_root = jnp.where(valid, g["prog_root"][nsc, relc], -1)
+    err = valid & g["rel_err"][nsc, relc]
+    has_rw = prog_root >= 0
+    can_exp = (
+        (~valid | g["can_sset"][nsc, relc]) if strict
+        else jnp.ones((cap,), bool)
+    )
+    direct_inc = ((~has_rw) if strict else jnp.ones((cap,), bool)) & ~T["skip"]
+
+    progc = jnp.clip(T["prog"], 0, g["p_kind"].shape[0] - 1)
+    pk = g["p_kind"][progc]
+    p_deg = g["p_child_ptr"][progc + 1] - g["p_child_ptr"][progc]
+    browc = jnp.clip(g["p_a"][progc], 0, g["b_ptr"].shape[0] - 2)
+    b_deg = g["b_ptr"][browc + 1] - g["b_ptr"][browc]
+
+    is_check = T["kind"] == KC_CHECK
+    is_direct = T["kind"] == KC_DIRECT
+    is_expand = T["kind"] == KC_EXPAND
+    is_prog = T["kind"] == KC_PROG
+    p_or_and = is_prog & ((pk == P_OR) | (pk == P_AND))
+    p_not = is_prog & (pk == P_NOT)
+    p_css = is_prog & (pk == P_CSS)
+    p_ttu = is_prog & (pk == P_TTU)
+    p_batch = is_prog & (pk == P_BATCHCSS)
+
+    # depth guards: <=0 for check/rewrite/direct/expand (engine.go:215,
+    # rewrites.go:39), <0 for NOT/CSS/TTU (rewrites.go:141,214,247)
+    g_le0 = (is_check | is_direct | is_expand | p_or_and) & (T["depth"] <= 0)
+    g_lt0 = (p_not | p_css | p_ttu) & (T["depth"] < 0)
+    guard_unk = g_le0 | g_lt0
+
+    # node lookups for expansion-shaped tasks
+    node_self = _node_lookup(g, T["ns"], T["obj"], T["rel"])
+    exp_deg = _row_deg(g, node_self)
+    node_ttu = _node_lookup(g, T["ns"], T["obj"], g["p_a"][progc])
+    ttu_deg = _row_deg(g, node_ttu)
+
+    # direct check resolves immediately (engine.go:167-208)
+    direct_hit = _member(g, node_self, q_subj[jnp.clip(T["qid"], 0, Q - 1)])
+
+    count = jnp.select(
+        [
+            is_check,
+            is_expand,
+            p_or_and,
+            p_not | p_css,
+            p_ttu,
+            p_batch,
+        ],
+        [
+            has_rw.astype(jnp.int32)
+            + direct_inc.astype(jnp.int32)
+            + can_exp.astype(jnp.int32),
+            exp_deg,
+            p_deg,
+            jnp.ones((cap,), jnp.int32),
+            ttu_deg,
+            b_deg,
+        ],
+        0,
+    )
+
+    resolve_a = pending & (
+        guard_unk
+        | (is_check & err)
+        | is_direct
+        | (count == 0)
+    )
+    result_a = jnp.select(
+        [
+            guard_unk,
+            is_check & err,
+            is_direct & direct_hit,
+            is_direct,
+        ],
+        [full(R_UNKNOWN), full(R_ERR), full(R_IS), full(R_NOT)],
+        # empty group => NOT (binop.go:25-27, _group([]))
+        full(R_NOT),
+    )
+    expanding = pending & ~resolve_a
+    cop = jnp.select(
+        [p_or_and & (pk == P_AND), p_not, p_css],
+        [full(OP_AND), full(OP_NOT), full(OP_PASS)],
+        full(OP_OR),
+    )
+
+    T["state"] = jnp.where(resolve_a, S_DONE, T["state"])
+    T["result"] = jnp.where(resolve_a, result_a, T["result"])
+    T["cop"] = jnp.where(expanding, cop, T["cop"])
+
+    # ---- phase B: arena allocation ------------------------------------
+    counts = jnp.where(expanding, count, 0)
+    offsets, total, ap, ao = arena_assign(counts, arena)
+    limit = jnp.minimum(jnp.int32(arena), jnp.int32(cap) - cursor)
+    fits = offsets + counts <= limit
+    over_parent = expanding & ~fits
+    q_over = q_over.at[jnp.clip(T["qid"], 0, Q - 1)].max(over_parent)
+    # over-capacity parents resolve UNKNOWN; their queries fall back
+    T["state"] = jnp.where(over_parent, S_DONE, T["state"])
+    T["result"] = jnp.where(over_parent, R_UNKNOWN, T["result"])
+
+    aps = jnp.clip(ap, 0, cap - 1)
+    alive = (ap >= 0) & fits[aps] & expanding[aps]
+
+    # ---- phase C: child construction ----------------------------------
+    pns, pobj, prel = T["ns"][aps], T["obj"][aps], T["rel"][aps]
+    pdepth, pqid = T["depth"][aps], T["qid"][aps]
+    pvs, pprog_task = T["vscope"][aps], T["prog"][aps]
+    pkind = T["kind"][aps]
+    ppk = pk[aps]
+    psubj = q_subj[jnp.clip(pqid, 0, Q - 1)]
+
+    c_is_check = pkind == KC_CHECK
+    c_is_expand = pkind == KC_EXPAND
+    c_prog = pkind == KC_PROG
+    c_or_and_not = c_prog & ((ppk == P_OR) | (ppk == P_AND) | (ppk == P_NOT))
+    c_css = c_prog & (ppk == P_CSS)
+    c_ttu = c_prog & (ppk == P_TTU)
+    c_batch = c_prog & (ppk == P_BATCHCSS)
+
+    # KC_CHECK children in order [rewrite?, direct?, expand?]
+    r0 = has_rw[aps].astype(jnp.int32)
+    d0 = direct_inc[aps].astype(jnp.int32)
+    chk_rewrite = c_is_check & (ao < r0)
+    chk_direct = c_is_check & ~chk_rewrite & (ao < r0 + d0)
+    chk_expand = c_is_check & ~chk_rewrite & ~chk_direct
+
+    # expand / ttu edge gathers
+    base_exp = g["row_ptr"][jnp.clip(node_self[aps], 0, g["row_ptr"].shape[0] - 2)]
+    base_ttu = g["row_ptr"][jnp.clip(node_ttu[aps], 0, g["row_ptr"].shape[0] - 2)]
+    eidx = jnp.clip(
+        jnp.where(c_ttu, base_ttu, base_exp) + ao, 0, g["edge_ns"].shape[0] - 1
+    )
+    e_ns, e_obj, e_rel = g["edge_ns"][eidx], g["edge_obj"][eidx], g["edge_rel"][eidx]
+    e_node = g["edge_node"][eidx]
+
+    # prog CSR gathers
+    pp = jnp.clip(pprog_task, 0, g["p_kind"].shape[0] - 1)
+    pci = jnp.clip(
+        g["p_child_ptr"][pp] + ao, 0, g["p_child_idx"].shape[0] - 1
+    )
+    prog_child = g["p_child_idx"][pci]
+    prog_dec = g["p_child_dec"][pci]
+
+    # batch CSR gathers
+    bbase = g["b_ptr"][jnp.clip(g["p_a"][pp], 0, g["b_ptr"].shape[0] - 2)]
+    bi = jnp.clip(bbase + ao, 0, g["b_rel"].shape[0] - 1)
+    brel = g["b_rel"][bi]
+    bprobe = g["b_probe"][bi]
+
+    ch_kind = jnp.select(
+        [chk_rewrite, chk_direct, chk_expand, c_or_and_not, c_css, c_ttu, c_batch, c_is_expand],
+        [
+            jnp.full_like(ao, KC_PROG),
+            jnp.full_like(ao, KC_DIRECT),
+            jnp.full_like(ao, KC_EXPAND),
+            jnp.full_like(ao, KC_PROG),
+            jnp.full_like(ao, KC_CHECK),
+            jnp.full_like(ao, KC_CHECK),
+            jnp.full_like(ao, KC_CHECK),
+            jnp.full_like(ao, KC_CHECK),
+        ],
+        KC_CHECK,
+    )
+    ch_ns = jnp.where(c_is_expand | c_ttu, e_ns, pns)
+    ch_obj = jnp.where(c_is_expand | c_ttu, e_obj, pobj)
+    ch_rel = jnp.select(
+        [c_is_expand, c_ttu, c_css, c_batch],
+        [e_rel, g["p_b"][pp], g["p_a"][pp], brel],
+        prel,
+    )
+    ch_depth = jnp.select(
+        [
+            chk_direct | chk_expand,  # engine.go:242,245
+            c_or_and_not,  # nested or/and decrement (rewrites.go:118)
+            c_ttu | c_batch,  # rewrites.go:281,:86 (depth-1 children)
+        ],
+        [pdepth - 1, pdepth - prog_dec, pdepth - 1],
+        pdepth,
+    )
+    ch_prog = jnp.select(
+        [chk_rewrite, c_or_and_not],
+        [prog_root[aps], prog_child],
+        -1,
+    )
+    ch_skip = (c_is_expand | c_batch)  # skip_direct (engine.go:161, rewrites.go:86)
+    # visited scope: expand nodes open a scope if none inherited
+    # (engine.go:119: visited created lazily, inherited downward)
+    ch_vscope = jnp.where(c_is_expand & (pvs < 0), aps, pvs)
+
+    # ---- phase D: found/probe shortcut --------------------------------
+    exp_found = c_is_expand & alive & _member(g, e_node, psubj)
+    batch_probe = (
+        c_batch & alive & bprobe
+        & _member(g, _node_lookup(g, pns, pobj, brel), psubj)
+    )
+    found = exp_found | batch_probe
+    any_found = zeros().at[aps].max(found.astype(jnp.int32) * alive)
+    parent_found = (any_found > 0) & expanding
+    T["state"] = jnp.where(parent_found, S_DONE, T["state"])
+    T["result"] = jnp.where(parent_found, R_IS, T["result"])
+    alive = alive & ~parent_found[aps]
+
+    # ---- phase E: width truncation (engine.go:141-150) ----------------
+    deg = counts[aps]
+    alive = alive & ~(c_is_expand & (deg > max_width) & (ao >= max_width - 1))
+
+    # ---- phase F: visited scopes --------------------------------------
+    evc = c_is_expand & alive
+    k1 = jnp.where(evc, ch_vscope, _I32MAX)
+    k2 = jnp.where(evc, ch_ns, _I32MAX)
+    k3 = jnp.where(evc, ch_obj, _I32MAX)
+    k4 = jnp.where(evc, ch_rel, _I32MAX)
+    _, seen = lex_searchsorted(s["vlog"], (k1, k2, k3, k4))
+    alive = alive & ~(evc & seen)
+    evc = evc & ~seen
+    # in-batch first-occurrence dedup
+    aidx = jnp.arange(arena, dtype=jnp.int32)
+    sk, (sj,) = lex_sort(
+        (jnp.where(evc, k1, _I32MAX), jnp.where(evc, k2, _I32MAX),
+         jnp.where(evc, k3, _I32MAX), jnp.where(evc, k4, _I32MAX), aidx),
+        aidx,
+    )
+    same_prev = (
+        (sk[0] == jnp.roll(sk[0], 1)) & (sk[1] == jnp.roll(sk[1], 1))
+        & (sk[2] == jnp.roll(sk[2], 1)) & (sk[3] == jnp.roll(sk[3], 1))
+    )
+    same_prev = same_prev.at[0].set(False) & (sk[0] != _I32MAX)
+    dup = jnp.zeros((arena,), bool).at[sj].set(same_prev)
+    alive = alive & ~(evc & dup)
+    evc = evc & ~dup
+    # append new keys to the log
+    nadd = jnp.sum(evc.astype(jnp.int32))
+    vover = vcursor + nadd > vcap
+    q_over = q_over.at[jnp.clip(pqid, 0, Q - 1)].max(evc & vover)
+    write_v = evc & ~vover
+    # dead slots scatter out of bounds and are dropped
+    vpos = jnp.where(
+        write_v, vcursor + jnp.cumsum(evc.astype(jnp.int32)) - 1, vcap
+    )
+    vlog = list(s["vlog"])
+    for i, col in enumerate((k1, k2, k3, k4)):
+        vlog[i] = vlog[i].at[vpos].set(col, mode="drop")
+    vkeys, _ = lex_sort(tuple(vlog))
+    vlog = tuple(vkeys)
+    vcursor = jnp.where(vover, vcursor, vcursor + nadd)
+
+    # ---- phase G: write surviving children ----------------------------
+    alive32 = alive.astype(jnp.int32)
+    # dead slots scatter out of bounds and are dropped
+    newpos = jnp.where(alive, cursor + jnp.cumsum(alive32) - 1, cap)
+
+    def scat(dst, val):
+        return dst.at[newpos].set(val, mode="drop")
+
+    T["state"] = scat(T["state"], jnp.full_like(newpos, S_PENDING))
+    T["result"] = scat(T["result"], jnp.zeros_like(newpos))
+    T["qid"] = scat(T["qid"], pqid)
+    T["kind"] = scat(T["kind"], ch_kind)
+    T["ns"] = scat(T["ns"], ch_ns)
+    T["obj"] = scat(T["obj"], ch_obj)
+    T["rel"] = scat(T["rel"], ch_rel)
+    T["depth"] = scat(T["depth"], ch_depth)
+    T["skip"] = scat(T["skip"], ch_skip)
+    T["vscope"] = scat(T["vscope"], ch_vscope)
+    T["parent"] = scat(T["parent"], ap)
+    T["prog"] = scat(T["prog"], ch_prog)
+    for f in ("nchild", "ndone", "nis", "nnot", "nerr"):
+        T[f] = scat(T[f], jnp.zeros_like(newpos))
+    T["delivered"] = scat(T["delivered"], jnp.zeros_like(newpos, dtype=bool))
+
+    nchild_final = zeros().at[aps].add(alive32)
+    became_parent = expanding & ~parent_found & ~over_parent
+    # all children dropped (visited/width) => empty group => NOT
+    empty_group = became_parent & (nchild_final == 0)
+    T["state"] = jnp.where(
+        became_parent, jnp.where(empty_group, S_DONE, S_WAIT), T["state"]
+    )
+    T["result"] = jnp.where(empty_group, R_NOT, T["result"])
+    T["nchild"] = jnp.where(became_parent, nchild_final, T["nchild"])
+    cursor = cursor + jnp.sum(alive32)
+
+    # ---- phase H: propagate results up --------------------------------
+    T, q_over, prop_changed = _propagate(T, q_over, Q, cap, iota, prop_passes)
+
+    pending_any = jnp.any(T["state"] == S_PENDING)
+    roots_done = jnp.all((T["state"][:Q] == S_DONE) | q_over)
+    changed = (
+        prop_changed
+        | jnp.any(resolve_a)
+        | jnp.any(parent_found)
+        | (jnp.sum(alive32) > 0)
+    )
+    flags = (
+        pending_any.astype(jnp.int32) * F_PENDING
+        + changed.astype(jnp.int32) * F_CHANGED
+        + roots_done.astype(jnp.int32) * F_ALL_ROOTS_DONE
+    )
+
+    return dict(
+        T=T,
+        vlog=vlog,
+        cursor=cursor,
+        vcursor=vcursor,
+        q_over=q_over,
+        q_subj=q_subj,
+        flags=flags,
+    )
+
+
 def run_batch(
     g: Dict[str, jax.Array],
-    q_ns: jax.Array,
-    q_obj: jax.Array,
-    q_rel: jax.Array,
-    q_subj: jax.Array,
-    q_depth: jax.Array,
+    q_ns,
+    q_obj,
+    q_rel,
+    q_subj,
+    q_depth,
     *,
     cap: int = 4096,
     arena: int = 4096,
@@ -91,449 +574,30 @@ def run_batch(
     max_iters: int = 64,
     max_width: int = 100,
     strict: bool = False,
+    prop_passes: int = 4,
 ) -> RunResult:
+    """Host-driven wavefront: step until all roots resolve or no progress."""
     Q = q_ns.shape[0]
-    NS, R = g["prog_root"].shape
-
-    def zeros(dtype=jnp.int32):
-        return jnp.zeros((cap,), dtype)
-
-    def full(v):
-        return jnp.full((cap,), v, jnp.int32)
-
-    iota = jnp.arange(cap, dtype=jnp.int32)
-    in_q = iota < Q
-
-    T = dict(
-        state=jnp.where(in_q, S_PENDING, S_EMPTY).astype(jnp.int32),
-        result=zeros(),
-        qid=jnp.where(in_q, iota, 0),
-        kind=full(KC_CHECK),
-        ns=jnp.where(in_q, jnp.pad(q_ns, (0, cap - Q), constant_values=-1), -1),
-        obj=jnp.where(in_q, jnp.pad(q_obj, (0, cap - Q), constant_values=-1), -1),
-        rel=jnp.where(in_q, jnp.pad(q_rel, (0, cap - Q), constant_values=-1), -1),
-        depth=jnp.where(in_q, jnp.pad(q_depth, (0, cap - Q)), 0),
-        skip=jnp.zeros((cap,), bool),
-        vscope=full(-1),
-        parent=full(-1),
-        prog=full(-1),
-        cop=full(OP_OR),
-        nchild=zeros(),
-        ndone=zeros(),
-        nis=zeros(),
-        nnot=zeros(),
-        nerr=zeros(),
-        delivered=jnp.zeros((cap,), bool),
-    )
-    vlog = tuple(jnp.full((vcap,), _I32MAX, jnp.int32) for _ in range(4))
-    state0 = dict(
-        T=T,
-        vlog=vlog,
-        cursor=jnp.int32(Q),
-        vcursor=jnp.int32(0),
-        it=jnp.int32(0),
-        q_over=jnp.zeros((Q,), bool),
-        q_subj=q_subj,
-    )
-
-    def propagate(T, q_over):
-        """Deliver resolved children, resolve combiners, cancel dead work."""
-
-        def cond(c):
-            return c[2]
-
-        def body(c):
-            T, q_over, _ = c
-            psafe = jnp.clip(T["parent"], 0, cap - 1)
-            deliver = (T["state"] == S_DONE) & ~T["delivered"] & (T["parent"] >= 0)
-            d32 = deliver.astype(jnp.int32)
-            T = dict(T)
-            T["ndone"] = T["ndone"].at[psafe].add(d32)
-            T["nis"] = T["nis"].at[psafe].add(d32 * (T["result"] == R_IS))
-            T["nnot"] = T["nnot"].at[psafe].add(d32 * (T["result"] == R_NOT))
-            T["nerr"] = T["nerr"].at[psafe].add(d32 * (T["result"] == R_ERR))
-            T["delivered"] = T["delivered"] | deliver
-
-            w = T["state"] == S_WAIT
-            nunk = T["ndone"] - T["nis"] - T["nnot"] - T["nerr"]
-            # error unwinds immediately, like a Go error return
-            r_err = T["nerr"] > 0
-            # checkgroup OR: first IS wins; all-done without IS => NOT
-            # (UNKNOWN swallowed, concurrent_checkgroup.go:108-123)
-            r_or_is = (T["cop"] == OP_OR) & (T["nis"] > 0)
-            r_or_not = (
-                (T["cop"] == OP_OR) & (T["ndone"] == T["nchild"]) & (T["nis"] == 0)
-            )
-            # AND: any non-IS (incl. UNKNOWN) => NOT; all IS => IS (binop.go:41-73)
-            r_and_not = (T["cop"] == OP_AND) & ((T["nnot"] > 0) | (nunk > 0))
-            r_and_is = (T["cop"] == OP_AND) & (T["ndone"] == T["nchild"]) & (
-                T["nis"] == T["nchild"]
-            )
-            one_done = T["ndone"] >= 1
-            # NOT flips IS<->NOT, preserves UNKNOWN (rewrites.go:186-195)
-            r_not = (T["cop"] == OP_NOT) & one_done
-            not_val = jnp.where(
-                T["nis"] > 0, R_NOT, jnp.where(T["nnot"] > 0, R_IS, R_UNKNOWN)
-            )
-            # PASS forwards the single child verbatim (rewrites.go:208-230)
-            r_pass = (T["cop"] == OP_PASS) & one_done
-            pass_val = jnp.where(
-                T["nis"] > 0, R_IS, jnp.where(T["nnot"] > 0, R_NOT, R_UNKNOWN)
-            )
-
-            resolved = w & (
-                r_err | r_or_is | r_or_not | r_and_not | r_and_is | r_not | r_pass
-            )
-            val = jnp.where(
-                r_err,
-                R_ERR,
-                jnp.where(
-                    r_or_is | r_and_is,
-                    R_IS,
-                    jnp.where(
-                        r_or_not | r_and_not,
-                        R_NOT,
-                        jnp.where(r_not, not_val, pass_val),
-                    ),
-                ),
-            )
-            T["state"] = jnp.where(resolved, S_DONE, T["state"])
-            T["result"] = jnp.where(resolved, val, T["result"])
-
-            # cancellation: dead parents kill pending/waiting descendants
-            par_state = T["state"][psafe]
-            active = (T["state"] == S_PENDING) | (T["state"] == S_WAIT)
-            cancel = active & (T["parent"] >= 0) & (
-                (par_state == S_DONE) | (par_state == S_CANCEL)
-            )
-            # whole query resolved => cancel its remaining tasks
-            root_state = T["state"][jnp.clip(T["qid"], 0, cap - 1)]
-            cancel = cancel | (active & (iota >= Q) & (root_state == S_DONE))
-            T["state"] = jnp.where(cancel, S_CANCEL, T["state"])
-
-            changed = jnp.any(deliver) | jnp.any(resolved) | jnp.any(cancel)
-            return T, q_over, changed
-
-        T, q_over, _ = jax.lax.while_loop(
-            cond, body, (T, q_over, jnp.bool_(True))
+    s = init_state(q_ns, q_obj, q_rel, q_subj, q_depth, cap=cap, vcap=vcap)
+    it = 0
+    for it in range(1, max_iters + 1):
+        s = check_step(
+            g, s,
+            cap=cap, arena=arena, vcap=vcap,
+            max_width=max_width, strict=strict, prop_passes=prop_passes,
         )
-        return T, q_over
-
-    def outer_cond(s):
-        return (s["it"] < max_iters) & jnp.any(s["T"]["state"] == S_PENDING)
-
-    def outer_body(s):
-        T = dict(s["T"])
-        q_subj = s["q_subj"]
-        cursor, vcursor, q_over = s["cursor"], s["vcursor"], s["q_over"]
-
-        # ---- phase A: classify pending tasks ------------------------------
-        pending = T["state"] == S_PENDING
-        nsc = jnp.clip(T["ns"], 0, NS - 1)
-        relc = jnp.clip(T["rel"], 0, R - 1)
-        valid = (T["ns"] >= 0) & (T["rel"] >= 0) & (T["ns"] < NS) & (T["rel"] < R)
-        prog_root = jnp.where(valid, g["prog_root"][nsc, relc], -1)
-        err = valid & g["rel_err"][nsc, relc]
-        has_rw = prog_root >= 0
-        can_exp = (
-            (~valid | g["can_sset"][nsc, relc]) if strict
-            else jnp.ones((cap,), bool)
-        )
-        direct_inc = ((~has_rw) if strict else jnp.ones((cap,), bool)) & ~T["skip"]
-
-        progc = jnp.clip(T["prog"], 0, g["p_kind"].shape[0] - 1)
-        pk = g["p_kind"][progc]
-        p_deg = g["p_child_ptr"][progc + 1] - g["p_child_ptr"][progc]
-        browc = jnp.clip(g["p_a"][progc], 0, g["b_ptr"].shape[0] - 2)
-        b_deg = g["b_ptr"][browc + 1] - g["b_ptr"][browc]
-
-        is_check = T["kind"] == KC_CHECK
-        is_direct = T["kind"] == KC_DIRECT
-        is_expand = T["kind"] == KC_EXPAND
-        is_prog = T["kind"] == KC_PROG
-        p_or_and = is_prog & ((pk == P_OR) | (pk == P_AND))
-        p_not = is_prog & (pk == P_NOT)
-        p_css = is_prog & (pk == P_CSS)
-        p_ttu = is_prog & (pk == P_TTU)
-        p_batch = is_prog & (pk == P_BATCHCSS)
-
-        # depth guards: <=0 for check/rewrite/direct/expand (engine.go:215,
-        # rewrites.go:39), <0 for NOT/CSS/TTU (rewrites.go:141,214,247)
-        g_le0 = (is_check | is_direct | is_expand | p_or_and) & (T["depth"] <= 0)
-        g_lt0 = (p_not | p_css | p_ttu) & (T["depth"] < 0)
-        guard_unk = g_le0 | g_lt0
-
-        # node lookups for expansion-shaped tasks
-        node_self = _node_lookup(g, T["ns"], T["obj"], T["rel"])
-        exp_deg = _row_deg(g, node_self)
-        node_ttu = _node_lookup(g, T["ns"], T["obj"], g["p_a"][progc])
-        ttu_deg = _row_deg(g, node_ttu)
-
-        # direct check resolves immediately (engine.go:167-208)
-        direct_hit = _member(g, node_self, q_subj[jnp.clip(T["qid"], 0, Q - 1)])
-
-        count = jnp.select(
-            [
-                is_check,
-                is_expand,
-                p_or_and,
-                p_not | p_css,
-                p_ttu,
-                p_batch,
-            ],
-            [
-                has_rw.astype(jnp.int32)
-                + direct_inc.astype(jnp.int32)
-                + can_exp.astype(jnp.int32),
-                exp_deg,
-                p_deg,
-                jnp.ones((cap,), jnp.int32),
-                ttu_deg,
-                b_deg,
-            ],
-            0,
-        )
-
-        resolve_a = pending & (
-            guard_unk
-            | (is_check & err)
-            | is_direct
-            | (count == 0)
-        )
-        result_a = jnp.select(
-            [
-                guard_unk,
-                is_check & err,
-                is_direct & direct_hit,
-                is_direct,
-            ],
-            [full(R_UNKNOWN), full(R_ERR), full(R_IS), full(R_NOT)],
-            # empty group => NOT (binop.go:25-27, _group([]))
-            full(R_NOT),
-        )
-        expanding = pending & ~resolve_a
-        cop = jnp.select(
-            [p_or_and & (pk == P_AND), p_not, p_css],
-            [full(OP_AND), full(OP_NOT), full(OP_PASS)],
-            full(OP_OR),
-        )
-
-        T["state"] = jnp.where(resolve_a, S_DONE, T["state"])
-        T["result"] = jnp.where(resolve_a, result_a, T["result"])
-        T["cop"] = jnp.where(expanding, cop, T["cop"])
-
-        # ---- phase B: arena allocation ------------------------------------
-        counts = jnp.where(expanding, count, 0)
-        offsets, total, ap, ao = arena_assign(counts, arena)
-        limit = jnp.minimum(jnp.int32(arena), jnp.int32(cap) - cursor)
-        fits = offsets + counts <= limit
-        over_parent = expanding & ~fits
-        q_over = q_over.at[jnp.clip(T["qid"], 0, Q - 1)].max(over_parent)
-        # over-capacity parents resolve UNKNOWN; their queries fall back
-        T["state"] = jnp.where(over_parent, S_DONE, T["state"])
-        T["result"] = jnp.where(over_parent, R_UNKNOWN, T["result"])
-
-        aps = jnp.clip(ap, 0, cap - 1)
-        alive = (ap >= 0) & fits[aps] & expanding[aps]
-
-        # ---- phase C: child construction ----------------------------------
-        pns, pobj, prel = T["ns"][aps], T["obj"][aps], T["rel"][aps]
-        pdepth, pqid = T["depth"][aps], T["qid"][aps]
-        pvs, pprog_task = T["vscope"][aps], T["prog"][aps]
-        pkind = T["kind"][aps]
-        ppk = pk[aps]
-        psubj = q_subj[jnp.clip(pqid, 0, Q - 1)]
-
-        c_is_check = pkind == KC_CHECK
-        c_is_expand = pkind == KC_EXPAND
-        c_prog = pkind == KC_PROG
-        c_or_and_not = c_prog & ((ppk == P_OR) | (ppk == P_AND) | (ppk == P_NOT))
-        c_css = c_prog & (ppk == P_CSS)
-        c_ttu = c_prog & (ppk == P_TTU)
-        c_batch = c_prog & (ppk == P_BATCHCSS)
-
-        # KC_CHECK children in order [rewrite?, direct?, expand?]
-        r0 = has_rw[aps].astype(jnp.int32)
-        d0 = direct_inc[aps].astype(jnp.int32)
-        chk_rewrite = c_is_check & (ao < r0)
-        chk_direct = c_is_check & ~chk_rewrite & (ao < r0 + d0)
-        chk_expand = c_is_check & ~chk_rewrite & ~chk_direct
-
-        # expand / ttu edge gathers
-        base_exp = g["row_ptr"][jnp.clip(node_self[aps], 0, g["row_ptr"].shape[0] - 2)]
-        base_ttu = g["row_ptr"][jnp.clip(node_ttu[aps], 0, g["row_ptr"].shape[0] - 2)]
-        eidx = jnp.clip(
-            jnp.where(c_ttu, base_ttu, base_exp) + ao, 0, g["edge_ns"].shape[0] - 1
-        )
-        e_ns, e_obj, e_rel = g["edge_ns"][eidx], g["edge_obj"][eidx], g["edge_rel"][eidx]
-        e_node = g["edge_node"][eidx]
-
-        # prog CSR gathers
-        pp = jnp.clip(pprog_task, 0, g["p_kind"].shape[0] - 1)
-        pci = jnp.clip(
-            g["p_child_ptr"][pp] + ao, 0, g["p_child_idx"].shape[0] - 1
-        )
-        prog_child = g["p_child_idx"][pci]
-        prog_dec = g["p_child_dec"][pci]
-
-        # batch CSR gathers
-        bbase = g["b_ptr"][jnp.clip(g["p_a"][pp], 0, g["b_ptr"].shape[0] - 2)]
-        bi = jnp.clip(bbase + ao, 0, g["b_rel"].shape[0] - 1)
-        brel = g["b_rel"][bi]
-        bprobe = g["b_probe"][bi]
-
-        ch_kind = jnp.select(
-            [chk_rewrite, chk_direct, chk_expand, c_or_and_not, c_css, c_ttu, c_batch, c_is_expand],
-            [
-                jnp.full_like(ao, KC_PROG),
-                jnp.full_like(ao, KC_DIRECT),
-                jnp.full_like(ao, KC_EXPAND),
-                jnp.full_like(ao, KC_PROG),
-                jnp.full_like(ao, KC_CHECK),
-                jnp.full_like(ao, KC_CHECK),
-                jnp.full_like(ao, KC_CHECK),
-                jnp.full_like(ao, KC_CHECK),
-            ],
-            KC_CHECK,
-        )
-        ch_ns = jnp.where(c_is_expand | c_ttu, e_ns, pns)
-        ch_obj = jnp.where(c_is_expand | c_ttu, e_obj, pobj)
-        ch_rel = jnp.select(
-            [c_is_expand, c_ttu, c_css, c_batch],
-            [e_rel, g["p_b"][pp], g["p_a"][pp], brel],
-            prel,
-        )
-        ch_depth = jnp.select(
-            [
-                chk_direct | chk_expand,  # engine.go:242,245
-                c_or_and_not,  # nested or/and decrement (rewrites.go:118)
-                c_ttu | c_batch,  # rewrites.go:281,:86 (depth-1 children)
-            ],
-            [pdepth - 1, pdepth - prog_dec, pdepth - 1],
-            pdepth,
-        )
-        ch_prog = jnp.select(
-            [chk_rewrite, c_or_and_not],
-            [prog_root[aps], prog_child],
-            -1,
-        )
-        ch_skip = (c_is_expand | c_batch)  # skip_direct (engine.go:161, rewrites.go:86)
-        # visited scope: expand nodes open a scope if none inherited
-        # (engine.go:119: visited created lazily, inherited downward)
-        ch_vscope = jnp.where(c_is_expand & (pvs < 0), aps, pvs)
-
-        # ---- phase D: found/probe shortcut --------------------------------
-        exp_found = c_is_expand & alive & _member(g, e_node, psubj)
-        batch_probe = (
-            c_batch & alive & bprobe
-            & _member(g, _node_lookup(g, pns, pobj, brel), psubj)
-        )
-        found = exp_found | batch_probe
-        any_found = zeros().at[aps].max(found.astype(jnp.int32) * alive)
-        parent_found = (any_found > 0) & expanding
-        T["state"] = jnp.where(parent_found, S_DONE, T["state"])
-        T["result"] = jnp.where(parent_found, R_IS, T["result"])
-        alive = alive & ~parent_found[aps]
-
-        # ---- phase E: width truncation (engine.go:141-150) ----------------
-        deg = counts[aps]
-        alive = alive & ~(c_is_expand & (deg > max_width) & (ao >= max_width - 1))
-
-        # ---- phase F: visited scopes --------------------------------------
-        evc = c_is_expand & alive
-        k1 = jnp.where(evc, ch_vscope, _I32MAX)
-        k2 = jnp.where(evc, ch_ns, _I32MAX)
-        k3 = jnp.where(evc, ch_obj, _I32MAX)
-        k4 = jnp.where(evc, ch_rel, _I32MAX)
-        _, seen = lex_searchsorted(s["vlog"], (k1, k2, k3, k4))
-        alive = alive & ~(evc & seen)
-        evc = evc & ~seen
-        # in-batch first-occurrence dedup
-        aidx = jnp.arange(arena, dtype=jnp.int32)
-        sk, (sj,) = lex_sort(
-            (jnp.where(evc, k1, _I32MAX), jnp.where(evc, k2, _I32MAX),
-             jnp.where(evc, k3, _I32MAX), jnp.where(evc, k4, _I32MAX), aidx),
-            aidx,
-        )
-        same_prev = (
-            (sk[0] == jnp.roll(sk[0], 1)) & (sk[1] == jnp.roll(sk[1], 1))
-            & (sk[2] == jnp.roll(sk[2], 1)) & (sk[3] == jnp.roll(sk[3], 1))
-        )
-        same_prev = same_prev.at[0].set(False) & (sk[0] != _I32MAX)
-        dup = jnp.zeros((arena,), bool).at[sj].set(same_prev)
-        alive = alive & ~(evc & dup)
-        evc = evc & ~dup
-        # append new keys to the log
-        nadd = jnp.sum(evc.astype(jnp.int32))
-        vover = vcursor + nadd > vcap
-        q_over = q_over.at[jnp.clip(pqid, 0, Q - 1)].max(evc & vover)
-        write_v = evc & ~vover
-        # dead slots scatter out of bounds and are dropped
-        vpos = jnp.where(
-            write_v, vcursor + jnp.cumsum(evc.astype(jnp.int32)) - 1, vcap
-        )
-        vlog = list(s["vlog"])
-        for i, col in enumerate((k1, k2, k3, k4)):
-            vlog[i] = vlog[i].at[vpos].set(col, mode="drop")
-        vkeys, _ = lex_sort(tuple(vlog))
-        vlog = tuple(vkeys)
-        vcursor = jnp.where(vover, vcursor, vcursor + nadd)
-
-        # ---- phase G: write surviving children ----------------------------
-        alive32 = alive.astype(jnp.int32)
-        # dead slots scatter out of bounds and are dropped
-        newpos = jnp.where(alive, cursor + jnp.cumsum(alive32) - 1, cap)
-
-        def scat(dst, val):
-            return dst.at[newpos].set(val, mode="drop")
-
-        T["state"] = scat(T["state"], jnp.full_like(newpos, S_PENDING))
-        T["result"] = scat(T["result"], jnp.zeros_like(newpos))
-        T["qid"] = scat(T["qid"], pqid)
-        T["kind"] = scat(T["kind"], ch_kind)
-        T["ns"] = scat(T["ns"], ch_ns)
-        T["obj"] = scat(T["obj"], ch_obj)
-        T["rel"] = scat(T["rel"], ch_rel)
-        T["depth"] = scat(T["depth"], ch_depth)
-        T["skip"] = scat(T["skip"], ch_skip)
-        T["vscope"] = scat(T["vscope"], ch_vscope)
-        T["parent"] = scat(T["parent"], ap)
-        T["prog"] = scat(T["prog"], ch_prog)
-        for f in ("nchild", "ndone", "nis", "nnot", "nerr"):
-            T[f] = scat(T[f], jnp.zeros_like(newpos))
-        T["delivered"] = scat(T["delivered"], jnp.zeros_like(newpos, dtype=bool))
-
-        nchild_final = zeros().at[aps].add(alive32)
-        became_parent = expanding & ~parent_found & ~over_parent
-        # all children dropped (visited/width) => empty group => NOT
-        empty_group = became_parent & (nchild_final == 0)
-        T["state"] = jnp.where(
-            became_parent, jnp.where(empty_group, S_DONE, S_WAIT), T["state"]
-        )
-        T["result"] = jnp.where(empty_group, R_NOT, T["result"])
-        T["nchild"] = jnp.where(became_parent, nchild_final, T["nchild"])
-        cursor = cursor + jnp.sum(alive32)
-
-        # ---- phase H: propagate results up --------------------------------
-        T, q_over = propagate(T, q_over)
-
-        return dict(
-            T=T,
-            vlog=vlog,
-            cursor=cursor,
-            vcursor=vcursor,
-            it=s["it"] + 1,
-            q_over=q_over,
-            q_subj=q_subj,
-        )
-
-    s = jax.lax.while_loop(outer_cond, outer_body, state0)
-    root_state = s["T"]["state"][:Q]
-    root_result = s["T"]["result"][:Q]
-    unresolved = root_state != S_DONE
+        flags = int(s["flags"])
+        if flags & F_ALL_ROOTS_DONE:
+            break
+        if not (flags & (F_PENDING | F_CHANGED)):
+            break  # wedged: no progress possible; unresolved roots fall back
+    T = s["T"]
+    root_state = T["state"][:Q]
+    root_result = T["result"][:Q]
+    unresolved = np.asarray(root_state) != S_DONE
     return RunResult(
         result=jnp.where(unresolved, R_UNKNOWN, root_result),
         overflow=s["q_over"] | unresolved,
-        iters=s["it"],
+        iters=jnp.int32(it),
         tasks=s["cursor"],
     )

@@ -55,20 +55,17 @@ def lex_searchsorted(
         return jnp.zeros((q,), jnp.int32), jnp.zeros((q,), bool)
     lo = jnp.zeros((q,), jnp.int32)
     hi = jnp.full((q,), n, jnp.int32)
-    # ceil(log2(n))+1 iterations; static trip count for jit.
-    iters = max(1, int(n).bit_length() + 1)
-
-    def body(_, state):
-        lo, hi = state
+    # Unrolled binary search (static log2(n)+1 steps).  Deliberately NOT a
+    # fori_loop: when this search sits inside an outer lax.while_loop (the
+    # check interpreter), XLA:TPU demotes the nested loop's gathers to the
+    # scalar core (~500x slower); straight-line gathers stay vectorized.
+    for _ in range(max(1, int(n).bit_length() + 1)):
         mid = (lo + hi) // 2
         mid_keys = [k[jnp.clip(mid, 0, max(n - 1, 0))] for k in keys]
         live = lo < hi
         go_right = live & _lex_less(mid_keys, queries)  # key[mid] < query
         lo = jnp.where(go_right, mid + 1, lo)
         hi = jnp.where(go_right | ~live, hi, mid)
-        return lo, hi
-
-    lo, hi = jax.lax.fori_loop(0, iters, body, (lo, hi))
     idx = lo
     if n == 0:
         return idx, jnp.zeros((q,), bool)

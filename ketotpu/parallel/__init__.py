@@ -1,0 +1,19 @@
+"""Multi-chip parallelism for the check engine.
+
+The reference scales out as stateless replicas over a shared SQL database
+(SURVEY §2 checklist: no collectives, no multi-process runtime exist there).
+Here scale-out is a first-class device-mesh design:
+
+* **query data-parallelism** (`shard_batch_check`): the batch axis of checks
+  is sharded over the mesh, the tuple graph is replicated — every device runs
+  the full wavefront interpreter on its query shard with zero cross-device
+  traffic.  This is the throughput axis (BatchCheck, BASELINE config #4).
+* **graph sharding** (parallel/graphshard.py): membership and CSR rows
+  partitioned by node hash across a second mesh axis with psum-combined
+  probes over ICI — the capacity axis for graphs beyond one chip's HBM
+  (BASELINE config #5).
+"""
+
+from ketotpu.parallel.mesh import make_mesh, shard_batch_check
+
+__all__ = ["make_mesh", "shard_batch_check"]

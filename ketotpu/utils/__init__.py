@@ -1,0 +1,1 @@
+"""Shared utilities: synthetic graph generation, timing helpers."""

@@ -1,0 +1,145 @@
+"""Synthetic Drive-style permission graphs for benchmarks and dry runs.
+
+Models the BASELINE benchmark shapes: a folder tree with viewer/owner
+assignments (some through group subject-sets), documents under folders, and
+`view` permissions that chain computed-userset + tuple-to-userset rewrites up
+the tree (the "5-hop rewrites" workload).  Mirrors the reference's deep/wide
+benchmark generators (internal/check/bench_test.go:56-133) in spirit, at
+configurable scale.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Tuple
+
+import numpy as np
+
+from ketotpu.api.types import RelationTuple, SubjectID, SubjectSet
+from ketotpu.opl.parser import parse
+from ketotpu.storage.memory import InMemoryTupleStore
+from ketotpu.storage.namespaces import StaticNamespaceManager
+
+SYNTH_OPL = """
+import { Namespace, SubjectSet, Context } from "@ory/keto-namespace-types"
+
+class User implements Namespace {}
+
+class Group implements Namespace {
+  related: {
+    members: (User | Group)[]
+  }
+}
+
+class Folder implements Namespace {
+  related: {
+    parents: Folder[]
+    viewers: (User | SubjectSet<Group, "members">)[]
+    owners: (User | SubjectSet<Group, "members">)[]
+  }
+  permits = {
+    own: (ctx: Context): boolean =>
+      this.related.owners.includes(ctx.subject) ||
+      this.related.parents.traverse((p) => p.permits.own(ctx)),
+    view: (ctx: Context): boolean =>
+      this.related.viewers.includes(ctx.subject) ||
+      this.permits.own(ctx) ||
+      this.related.parents.traverse((p) => p.permits.view(ctx)),
+  }
+}
+
+class Doc implements Namespace {
+  related: {
+    parents: Folder[]
+    viewers: (User | SubjectSet<Group, "members">)[]
+    owners: (User | SubjectSet<Group, "members">)[]
+  }
+  permits = {
+    view: (ctx: Context): boolean =>
+      this.related.viewers.includes(ctx.subject) ||
+      this.related.owners.includes(ctx.subject) ||
+      this.related.parents.traverse((p) => p.permits.view(ctx)),
+  }
+}
+"""
+
+
+@dataclass
+class SynthGraph:
+    store: InMemoryTupleStore
+    manager: StaticNamespaceManager
+    users: List[str]
+    docs: List[str]
+    folders: List[str]
+
+
+def build_synth(
+    *,
+    n_users: int = 100,
+    n_groups: int = 10,
+    n_folders: int = 50,
+    n_docs: int = 200,
+    fanout: int = 4,
+    seed: int = 0,
+) -> SynthGraph:
+    """Folder tree of degree ``fanout``; docs attach to random folders;
+    viewers/owners assigned directly and through groups."""
+    rng = np.random.default_rng(seed)
+    namespaces, errors = parse(SYNTH_OPL)
+    assert not errors, errors
+    manager = StaticNamespaceManager(namespaces)
+    store = InMemoryTupleStore()
+
+    users = [f"u{i}" for i in range(n_users)]
+    groups = [f"g{i}" for i in range(n_groups)]
+    folders = [f"f{i}" for i in range(n_folders)]
+    docs = [f"d{i}" for i in range(n_docs)]
+    tuples: List[RelationTuple] = []
+
+    def t(ns, obj, rel, subj):
+        tuples.append(RelationTuple(ns, obj, rel, subj))
+
+    # group membership: users spread over groups; a few nested groups
+    for i, u in enumerate(users):
+        t("Group", groups[i % n_groups], "members", SubjectID(u))
+    for i in range(1, n_groups, 3):
+        t("Group", groups[i - 1], "members", SubjectSet("Group", groups[i], "members"))
+
+    # folder tree rooted at f0
+    for i in range(1, n_folders):
+        t("Folder", folders[i], "parents", SubjectSet("Folder", folders[(i - 1) // fanout]))
+    # scatter viewers/owners on folders: direct users and group sets
+    for i, f in enumerate(folders):
+        if i % 3 == 0:
+            t("Folder", f, "viewers", SubjectID(users[int(rng.integers(n_users))]))
+        if i % 5 == 0:
+            t("Folder", f, "owners", SubjectID(users[int(rng.integers(n_users))]))
+        if i % 4 == 0:
+            t("Folder", f, "viewers",
+              SubjectSet("Group", groups[int(rng.integers(n_groups))], "members"))
+
+    # docs under folders with occasional direct grants
+    for i, d in enumerate(docs):
+        t("Doc", d, "parents", SubjectSet("Folder", folders[int(rng.integers(n_folders))]))
+        if i % 7 == 0:
+            t("Doc", d, "viewers", SubjectID(users[int(rng.integers(n_users))]))
+        if i % 11 == 0:
+            t("Doc", d, "owners", SubjectID(users[int(rng.integers(n_users))]))
+
+    store.write_relation_tuples(*tuples)
+    return SynthGraph(
+        store=store, manager=manager, users=users, docs=docs, folders=folders
+    )
+
+
+def synth_queries(
+    graph: SynthGraph, n: int, *, seed: int = 1
+) -> List[RelationTuple]:
+    """Mixed doc-view checks: random (doc, user) pairs."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        d = graph.docs[int(rng.integers(len(graph.docs)))]
+        u = graph.users[int(rng.integers(len(graph.users)))]
+        out.append(RelationTuple("Doc", d, "view", SubjectID(u)))
+    return out
