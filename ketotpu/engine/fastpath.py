@@ -1,0 +1,514 @@
+"""Pure-OR BFS fast path: batched reachability checks with a monotone found-bit.
+
+The checkgroup OR semantics of the reference collapse three-valued logic at
+every level: the first IS_MEMBER child wins and UNKNOWN children are swallowed
+into NOT_MEMBER (`checkgroup/concurrent_checkgroup.go:108-123`, oracle.py
+`_group`).  Consequence: for any query whose reachable rewrite closure
+contains no AND / NOT and no error-raising relation lookup, Check degenerates
+to *depth-bounded multi-source reachability* — the verdict is IS iff some
+membership probe fires within the depth budget, else NOT.  No task tree, no
+parent pointers, no result propagation: just
+
+* a frontier of ``(query, namespace, object, relation, depth, flags)``
+  items (one array row each),
+* a per-query monotone ``found`` bit fed by three probe families — direct
+  membership (`engine.go:167-208`), the OR-of-computed-subject-sets shortcut
+  (`rewrites.go:62-93` / `sql/traverser.go:123-191`), and the EXISTS bit on
+  subject-set expansion edges (`engine.go:131-139` /
+  `sql/traverser.go:53-121`),
+* one level per device step, expanding subject-set CSR rows, flattened
+  computed-subject-set entries, and tuple-to-userset rows
+  (see `optable.FlatTables` for the flattening and per-edge depth math).
+
+Every child's depth is at least one less than its parent's (expansion hops
+decrement at `engine.go:242-245`, batched CSS children at `rewrites.go:86`,
+TTU children at `rewrites.go:281`, nested ORs at `rewrites.go:118`), so a
+batch completes in exactly ``max_depth`` steps — the host enqueues all steps
+asynchronously with **zero** intermediate device syncs, the fix for the
+round-1 engine's 64 blocking round-trips per batch.
+
+Capacity semantics are monotone too: ``found`` can only gain queries, so an
+arena/frontier overflow poisons only the *not-yet-found* queries of the
+affected rows (``q_over``); a query answered IS stays IS.  Fallback work is
+therefore ``over & ~found`` instead of round 1's all-or-nothing flag.
+
+The step is split into two phases so the graph-sharded runner
+(ketotpu/parallel) can route children between them with an all-to-all:
+
+* ``expand_phase`` — probes + child construction into arena columns;
+* ``pack_phase`` — per-(query, node) dedup/merge + compaction into the next
+  frontier.
+
+In sharded mode the expansion EXISTS bit cannot be tested at the parent (the
+target row lives on the owner shard of the child's object), so expansion
+children carry a ``force`` flag: the owner probes membership on arrival,
+regardless of depth — including width-truncated children, which ship as
+probe-only items (depth 0) so the pre-truncation EXISTS semantics survive
+sharding exactly.
+
+Exploration order differs from the sequential oracle in one deliberate way:
+instead of the oracle's per-expansion-subtree visited sets (DFS order,
+`engine.go:119`, `x/graph/graph_utils.go:38-53`), each level merges duplicate
+``(query, node)`` items keeping the maximum remaining depth and the most
+permissive flags.  The explored set is a superset of the oracle's and a
+subset of the visited-set-free depth-bounded closure, so IS verdicts can
+exceed the oracle's only on graphs where the oracle's visited set suppresses
+a higher-budget revisit — exactly the cases where the reference's
+*concurrent* engine (shared visited set raced by goroutines,
+`concurrent_checkgroup.go:66-138`) is itself schedule-dependent.  The
+differential fuzzer arbitrates such divergences against a visited-free
+oracle run (tests/test_fastpath.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ketotpu.engine import hashtab
+from ketotpu.engine.xutil import arena_assign
+
+_I32MAX = jnp.iinfo(jnp.int32).max
+
+ITEM_COLS = ("qid", "ns", "obj", "rel", "d", "skip", "force")
+
+
+class FastResult(NamedTuple):
+    found: jax.Array  # bool[Q]: membership established (monotone)
+    over: jax.Array  # bool[Q]: capacity overflow touched this query
+
+
+def _tab(g: Dict[str, jax.Array], prefix: str) -> Dict[str, jax.Array]:
+    return {k[len(prefix):]: v for k, v in g.items() if k.startswith(prefix)}
+
+
+def _node_lookup(g: Dict[str, jax.Array], ns, obj, rel):
+    """(ns, obj, rel) -> node id or -1.  Stride = padded relation count."""
+    num_rels = g["f_direct_ok"].shape[1]
+    hi = ns * num_rels + rel
+    idx, found = hashtab.lookup(_tab(g, "nt_"), hi, obj)
+    found = found & (ns >= 0) & (obj >= 0) & (rel >= 0)
+    return jnp.where(found, idx, -1).astype(jnp.int32)
+
+
+def _member(g: Dict[str, jax.Array], node, subj):
+    """Does tuple (node, subject) exist?  ExistsRelationTuples equivalent."""
+    _, found = hashtab.lookup(_tab(g, "mt_"), node, subj)
+    return found
+
+
+def _row_deg(g, node):
+    safe = jnp.clip(node, 0, g["row_ptr"].shape[0] - 2)
+    deg = g["row_ptr"][safe + 1] - g["row_ptr"][safe]
+    return jnp.where(node >= 0, deg, 0).astype(jnp.int32)
+
+
+def init_state(
+    q_ns, q_obj, q_rel, q_subj, q_depth, active=None, *, frontier: int
+) -> Dict[str, jax.Array]:
+    """Roots in slots 0..Q-1; ``active=False`` queries never enter the BFS."""
+    Q = q_ns.shape[0]
+    if Q > frontier:
+        raise ValueError(f"batch {Q} exceeds frontier capacity {frontier}")
+    act = np.ones((Q,), bool) if active is None else np.asarray(active, bool)
+    return _init_state(q_ns, q_obj, q_rel, q_subj, q_depth, act, frontier=frontier)
+
+
+@functools.partial(jax.jit, static_argnames=("frontier",))
+def _init_state(
+    q_ns, q_obj, q_rel, q_subj, q_depth, act, *, frontier: int
+) -> Dict[str, jax.Array]:
+    Q = q_ns.shape[0]
+    iota = jnp.arange(frontier, dtype=jnp.int32)
+    in_q = (iota < Q) & jnp.pad(jnp.asarray(act, bool), (0, frontier - Q))
+
+    def pad(x, fill):
+        return jnp.where(
+            in_q,
+            jnp.pad(jnp.asarray(x, jnp.int32), (0, frontier - Q), constant_values=fill),
+            fill,
+        )
+
+    return dict(
+        f_qid=jnp.where(in_q, iota, -1),
+        f_ns=pad(q_ns, -1),
+        f_obj=pad(q_obj, -1),
+        f_rel=pad(q_rel, -1),
+        f_depth=pad(q_depth, 0),
+        f_skip=jnp.zeros((frontier,), bool),
+        f_force=jnp.zeros((frontier,), bool),
+        q_found=jnp.zeros((Q,), bool),
+        q_over=jnp.zeros((Q,), bool),
+        q_subj=jnp.asarray(q_subj, jnp.int32),
+    )
+
+
+def expand_phase(
+    g: Dict[str, jax.Array],
+    s: Dict[str, jax.Array],
+    *,
+    arena: int,
+    max_width: int,
+    sharded: bool = False,
+) -> Tuple[Dict[str, jax.Array], jax.Array, jax.Array]:
+    """Probes + child construction.  Returns (children[A] cols + alive, found, over)."""
+    A = arena
+    F = s["f_qid"].shape[0]
+    NS, R = g["f_direct_ok"].shape
+    Kc = g["f_css_rel"].shape[2]
+    Kt = g["f_ttu_via"].shape[2]
+    Q = s["q_found"].shape[0]
+
+    qid, ns, obj, rel = s["f_qid"], s["f_ns"], s["f_obj"], s["f_rel"]
+    d, skip, force = s["f_depth"], s["f_skip"], s["f_force"]
+    q_found, q_over, q_subj = s["q_found"], s["q_over"], s["q_subj"]
+
+    qc = jnp.clip(qid, 0, Q - 1)
+    live = (qid >= 0) & ~q_found[qc]  # short-circuit: found queries stop
+    subj = q_subj[qc]
+    nsc = jnp.clip(ns, 0, NS - 1)
+    relc = jnp.clip(rel, 0, R - 1)
+    cfg = (ns >= 0) & (ns < NS) & (rel >= 0) & (rel < R)
+    node = _node_lookup(g, ns, obj, rel)
+
+    dok = jnp.where(cfg, g["f_direct_ok"][nsc, relc], True) & ~skip
+    eok = jnp.where(cfg, g["f_expand_ok"][nsc, relc], True)
+
+    # -- probes -------------------------------------------------------------
+    # direct: checked at depth-1 with its own <=0 guard (engine.go:242,
+    # :167-208) => counts only when d >= 2.  A forced probe stands in for
+    # the parent shard's expansion EXISTS bit and ignores depth.
+    self_member = _member(g, node, subj)
+    found = live & self_member & ((dok & (d >= 2)) | force)
+
+    # batched computed-subject-set probes (rewrites.go:62-93); the rewrite
+    # level guard is depth-dec >= 1 (rewrites.go:39)
+    css_rel = jnp.where(cfg[:, None], g["f_css_rel"][nsc, relc], -1)  # [F,Kc]
+    css_dec = g["f_css_dec"][nsc, relc]
+    css_probe = g["f_css_probe"][nsc, relc]
+    css_ok = live[:, None] & (css_rel >= 0) & (d[:, None] - css_dec >= 1)
+    for k in range(Kc):
+        cnode = _node_lookup(g, ns, obj, css_rel[:, k])
+        found = found | (css_ok[:, k] & css_probe[:, k] & _member(g, cnode, subj))
+
+    q_found = q_found.at[qc].max(found)
+    live2 = live & ~q_found[qc]
+
+    # -- per-item child segments: [expansion | css 0..Kc | ttu 0..Kt] -------
+    # expansion runs at depth-1 with a <=0 guard (engine.go:245,:102-110);
+    # the full row degree is gathered so found-bits cover pre-truncation
+    # results (engine.go:131-139 checks found before the width cut)
+    exp_deg = jnp.where(live2 & eok & (d >= 2), _row_deg(g, node), 0)
+    css_need = (css_ok & live2[:, None] & (d[:, None] - css_dec - 1 >= 1)).astype(
+        jnp.int32
+    )
+    ttu_via = jnp.where(cfg[:, None], g["f_ttu_via"][nsc, relc], -1)  # [F,Kt]
+    ttu_tgt = g["f_ttu_tgt"][nsc, relc]
+    ttu_dec = g["f_ttu_dec"][nsc, relc]
+    # TTU guard is depth < 0 (rewrites.go:247) but children recurse at
+    # depth-dec-1 with the root <=0 guard, so rows only matter when
+    # d - dec >= 2
+    ttu_ok = live2[:, None] & (ttu_via >= 0) & (d[:, None] - ttu_dec >= 2)
+    ttu_node_cols = []
+    ttu_deg_cols = []
+    for k in range(Kt):
+        tn = _node_lookup(g, ns, obj, ttu_via[:, k])
+        ttu_node_cols.append(tn)
+        ttu_deg_cols.append(jnp.where(ttu_ok[:, k], _row_deg(g, tn), 0))
+    ttu_nodes = jnp.stack(ttu_node_cols, axis=1)  # [F,Kt]
+
+    seg_len = jnp.stack(
+        [exp_deg] + [css_need[:, k] for k in range(Kc)] + ttu_deg_cols, axis=1
+    )  # [F, 1+Kc+Kt]
+    seg_cum = jnp.cumsum(seg_len, axis=1)
+    counts = seg_cum[:, -1]
+
+    # -- arena allocation ---------------------------------------------------
+    offsets, _total, ap, ao = arena_assign(counts, A)
+    fits = offsets + counts <= A
+    q_over = q_over.at[qc].max(live2 & (counts > 0) & ~fits)
+
+    aps = jnp.clip(ap, 0, F - 1)
+    src_ok = (ap >= 0) & fits[aps]
+
+    # -- segment decomposition per arena slot -------------------------------
+    cum_p = seg_cum[aps]  # [A, S]
+    S = 1 + Kc + Kt
+    seg_idx = jnp.clip(
+        jnp.sum((ao[:, None] >= cum_p).astype(jnp.int32), axis=1), 0, S - 1
+    )
+    prev_cum = jnp.where(
+        seg_idx > 0,
+        jnp.take_along_axis(cum_p, jnp.clip(seg_idx - 1, 0, S - 1)[:, None], 1)[:, 0],
+        0,
+    )
+    off = ao - prev_cum
+
+    p_ns, p_obj, p_d = ns[aps], obj[aps], d[aps]
+    p_qid = qid[aps]
+    pqc = jnp.clip(p_qid, 0, Q - 1)
+    psubj = q_subj[pqc]
+
+    is_exp = src_ok & (seg_idx == 0)
+    is_css = src_ok & (seg_idx >= 1) & (seg_idx <= Kc)
+    css_k = jnp.clip(seg_idx - 1, 0, Kc - 1)
+    is_ttu = src_ok & (seg_idx > Kc)
+    ttu_k = jnp.clip(seg_idx - 1 - Kc, 0, Kt - 1)
+
+    # edge gathers for expansion / ttu rows
+    rp = g["row_ptr"]
+    base_exp = rp[jnp.clip(node[aps], 0, rp.shape[0] - 2)]
+    ttu_node_p = jnp.take_along_axis(ttu_nodes[aps], ttu_k[:, None], 1)[:, 0]
+    base_ttu = rp[jnp.clip(ttu_node_p, 0, rp.shape[0] - 2)]
+    eidx = jnp.clip(
+        jnp.where(is_ttu, base_ttu, base_exp) + off, 0, g["edge_ns"].shape[0] - 1
+    )
+    e_ns, e_obj, e_rel = g["edge_ns"][eidx], g["edge_obj"][eidx], g["edge_rel"][eidx]
+    e_node = g["edge_node"][eidx]
+
+    css_rel_p = jnp.take_along_axis(css_rel[aps], css_k[:, None], 1)[:, 0]
+    css_dec_p = jnp.take_along_axis(css_dec[aps], css_k[:, None], 1)[:, 0]
+    ttu_tgt_p = jnp.take_along_axis(ttu_tgt[aps], ttu_k[:, None], 1)[:, 0]
+    ttu_dec_p = jnp.take_along_axis(ttu_dec[aps], ttu_k[:, None], 1)[:, 0]
+
+    ch_ns = jnp.where(is_css, p_ns, e_ns)
+    ch_obj = jnp.where(is_css, p_obj, e_obj)
+    ch_rel = jnp.select([is_css, is_ttu], [css_rel_p, ttu_tgt_p], e_rel)
+    ch_d = jnp.select(
+        [is_css, is_ttu],
+        [p_d - css_dec_p - 1, p_d - ttu_dec_p - 1],
+        p_d - 1,
+    )
+    # expansion children skip the direct re-check — the EXISTS bit just
+    # tested it (engine.go:161); batched CSS children likewise
+    # (rewrites.go:86); TTU children do not (rewrites.go:281-286)
+    ch_skip = is_exp | is_css
+    ch_qid = jnp.where(src_ok, p_qid, -1)
+
+    # width truncation applies to recursion only (engine.go:141-150)
+    p_exp_deg = exp_deg[aps]
+    trunc = is_exp & (p_exp_deg > max_width) & (off >= max_width - 1)
+
+    if sharded:
+        # the EXISTS probe happens on the child's owner shard: force-flag
+        # every expansion child; width-truncated ones ship probe-only (d=0)
+        ch_force = is_exp
+        ch_d = jnp.where(trunc, 0, ch_d)
+        alive = src_ok & (is_exp | (ch_d >= 1))
+    else:
+        ch_force = jnp.zeros_like(is_exp)
+        exp_found = is_exp & _member(g, e_node, psubj)
+        q_found = q_found.at[pqc].max(exp_found)
+        alive = src_ok & ~trunc & (ch_d >= 1)
+    alive = alive & ~q_found[jnp.clip(ch_qid, 0, Q - 1)]
+
+    children = dict(
+        qid=jnp.where(alive, ch_qid, -1),
+        ns=ch_ns,
+        obj=ch_obj,
+        rel=ch_rel,
+        d=jnp.maximum(ch_d, 0),
+        skip=ch_skip,
+        force=ch_force,
+    )
+    return children, q_found, q_over
+
+
+def _pack_bits(n: int) -> int:
+    return max(int(n - 1).bit_length(), 1)
+
+
+def pack_phase(
+    children: Dict[str, jax.Array],
+    q_found: jax.Array,
+    q_over: jax.Array,
+    *,
+    frontier: int,
+    ns_dim: int = 0,
+    rel_dim: int = 0,
+) -> Tuple[Dict[str, jax.Array], jax.Array]:
+    """Dedup by (query, node) — max depth, min skip, max force — and compact
+    the survivors into the next frontier.  Returns (frontier cols, q_over).
+
+    When (qid, ns, rel) fit one int32 (pass ``ns_dim``/``rel_dim``, the
+    padded table dims), the sort runs on 2 packed keys + 1 packed payload
+    word instead of 4 keys + 3 payloads — the sort is the arena-sized cost
+    of the whole level, so fewer operands is a direct win.
+    """
+    F = frontier
+    Q = q_found.shape[0]
+    A = children["qid"].shape[0]
+    alive = (children["qid"] >= 0) & ~q_found[jnp.clip(children["qid"], 0, Q - 1)]
+
+    nsb = _pack_bits(ns_dim) if ns_dim else 31
+    relb = _pack_bits(rel_dim) if rel_dim else 31
+    qb = _pack_bits(Q)
+    payload = (
+        (children["d"] << 2)
+        | (children["skip"].astype(jnp.int32) << 1)
+        | children["force"].astype(jnp.int32)
+    )
+    if qb + nsb + relb <= 31:
+        k1 = jnp.where(
+            alive,
+            (children["qid"] << (nsb + relb)) | (children["ns"] << relb)
+            | children["rel"],
+            _I32MAX,
+        )
+        k2 = jnp.where(alive, children["obj"], _I32MAX)
+        sk1, sk2, s_pay = jax.lax.sort((k1, k2, payload), num_keys=2)
+        valid = sk1 != _I32MAX
+        same_prev = (sk1 == jnp.roll(sk1, 1)) & (sk2 == jnp.roll(sk2, 1))
+        o_qid = jnp.where(valid, sk1 >> (nsb + relb), -1)
+        o_ns = jnp.where(valid, (sk1 >> relb) & ((1 << nsb) - 1), -1)
+        o_rel = jnp.where(valid, sk1 & ((1 << relb) - 1), -1)
+        o_obj = sk2
+    else:
+        k3 = jnp.where(alive, children["ns"], _I32MAX)
+        k4 = jnp.where(alive, children["rel"], _I32MAX)
+        k1 = jnp.where(alive, children["qid"], _I32MAX)
+        k2 = jnp.where(alive, children["obj"], _I32MAX)
+        sk1, k3s, k4s, sk2, s_pay = jax.lax.sort((k1, k3, k4, k2, payload), num_keys=4)
+        valid = sk1 != _I32MAX
+        same_prev = (
+            (sk1 == jnp.roll(sk1, 1))
+            & (k3s == jnp.roll(k3s, 1))
+            & (k4s == jnp.roll(k4s, 1))
+            & (sk2 == jnp.roll(sk2, 1))
+        )
+        o_qid, o_ns, o_rel, o_obj = sk1, k3s, k4s, sk2
+
+    s_d = s_pay >> 2
+    s_skip = (s_pay >> 1) & 1
+    s_force = s_pay & 1
+    same_prev = same_prev.at[0].set(False)
+    first = valid & ~same_prev
+    seg_id = jnp.cumsum(first.astype(jnp.int32)) - 1
+    seg_safe = jnp.clip(seg_id, 0, A - 1)
+    d_max = jax.ops.segment_max(jnp.where(valid, s_d, -1), seg_safe, num_segments=A)
+    skip_min = jax.ops.segment_min(
+        jnp.where(valid, s_skip, 1), seg_safe, num_segments=A
+    )
+    force_max = jax.ops.segment_max(
+        jnp.where(valid, s_force, 0), seg_safe, num_segments=A
+    )
+
+    pos = jnp.where(first, jnp.cumsum(first.astype(jnp.int32)) - 1, F)
+    drop_f = first & (pos >= F)
+    oq = jnp.where(valid, o_qid, Q)
+    q_over = q_over.at[jnp.clip(oq, 0, Q - 1)].max(drop_f & (oq < Q))
+    pos = jnp.where(pos < F, pos, F)
+
+    def scat(fill, val):
+        return jnp.full((F,), fill, val.dtype).at[pos].set(val, mode="drop")
+
+    out = dict(
+        f_qid=scat(-1, jnp.where(first, o_qid, -1).astype(jnp.int32)),
+        f_ns=scat(-1, o_ns.astype(jnp.int32)),
+        f_obj=scat(-1, o_obj.astype(jnp.int32)),
+        f_rel=scat(-1, o_rel.astype(jnp.int32)),
+        f_depth=scat(0, d_max[seg_safe]),
+        f_skip=scat(False, skip_min[seg_safe].astype(bool)),
+        f_force=scat(False, force_max[seg_safe].astype(bool)),
+    )
+    return out, q_over
+
+
+def step_impl(
+    g: Dict[str, jax.Array],
+    s: Dict[str, jax.Array],
+    *,
+    frontier: int,
+    arena: int,
+    max_width: int = 100,
+) -> Dict[str, jax.Array]:
+    """One whole level: expand + pack (single-shard path)."""
+    NS, R = g["f_direct_ok"].shape
+    children, q_found, q_over = expand_phase(
+        g, s, arena=arena, max_width=max_width, sharded=False
+    )
+    nxt, q_over = pack_phase(
+        children, q_found, q_over, frontier=frontier, ns_dim=NS, rel_dim=R
+    )
+    return dict(nxt, q_found=q_found, q_over=q_over, q_subj=s["q_subj"])
+
+
+fast_step = functools.partial(
+    jax.jit, static_argnames=("frontier", "arena", "max_width"), donate_argnums=(1,)
+)(step_impl)
+
+
+def level_schedule(
+    q: int, frontier: int, arena: int, max_depth: int
+) -> Tuple[Tuple[int, int], ...]:
+    """Per-level (frontier, arena) sizes: level 0 holds exactly the roots,
+    later levels grow geometrically up to the configured caps.  Early levels
+    are the common case (short-circuit kills most queries fast), so sizing
+    them to the work instead of the worst case is most of the win."""
+    out = []
+    f = q
+    for _ in range(max_depth):
+        out.append((min(f, frontier), min(max(4 * f, q), arena)))
+        f *= 4
+    return tuple(out)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("schedule", "max_width")
+)
+def _run_fused(
+    g: Dict[str, jax.Array],
+    q_ns, q_obj, q_rel, q_subj, q_depth, act,
+    *,
+    schedule: Tuple[Tuple[int, int], ...],
+    max_width: int,
+) -> "FastResult":
+    """All BFS levels in ONE device program: one dispatch per batch instead
+    of one per level (each dispatch costs real host-link latency), with the
+    per-level buffer sizes of ``schedule``."""
+    NS, R = g["f_direct_ok"].shape
+    s = _init_state(
+        q_ns, q_obj, q_rel, q_subj, q_depth, act, frontier=schedule[0][0]
+    )
+    for i, (f, a) in enumerate(schedule):
+        nxt_f = schedule[i + 1][0] if i + 1 < len(schedule) else 1
+        children, q_found, q_over = expand_phase(
+            g, s, arena=a, max_width=max_width, sharded=False
+        )
+        nxt, q_over = pack_phase(
+            children, q_found, q_over, frontier=nxt_f, ns_dim=NS, rel_dim=R
+        )
+        s = dict(nxt, q_found=q_found, q_over=q_over, q_subj=s["q_subj"])
+    return FastResult(found=s["q_found"], over=s["q_over"])
+
+
+def run_fast(
+    g: Dict[str, jax.Array],
+    q_ns,
+    q_obj,
+    q_rel,
+    q_subj,
+    q_depth,
+    active=None,
+    *,
+    frontier: int = 8192,
+    arena: int = 32768,
+    max_depth: int = 5,
+    max_width: int = 100,
+) -> FastResult:
+    """Run a batch to completion in a single fused device dispatch.
+
+    Exactly ``max_depth`` levels — depth strictly decreases per level, so
+    the frontier is provably empty afterwards; no early-exit sync needed.
+    """
+    Q = q_ns.shape[0]
+    act = np.ones((Q,), bool) if active is None else np.asarray(active, bool)
+    sched = level_schedule(Q, frontier, arena, max_depth)
+    return _run_fused(
+        g, q_ns, q_obj, q_rel, q_subj, q_depth, act,
+        schedule=sched, max_width=max_width,
+    )

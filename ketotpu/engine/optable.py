@@ -161,6 +161,159 @@ def _compile_rewrite(
     return n
 
 
+@dataclass
+class FlatTables:
+    """Flattened pure-OR rewrite programs for the BFS fast path.
+
+    A relation whose rewrite tree contains only OR / ComputedSubjectSet /
+    TupleToSubjectSet nodes flattens into two entry lists:
+
+    * ``css``: (relation, depth-decrement, probe?) — the batched
+      computed-subject-set shortcut (rewrites.go:62-93): probe = direct
+      membership test on (ns, obj, relation); child check at depth-dec-1
+      with skip_direct (rewrites.go:86).
+    * ``ttu``: (via-relation, target-relation, depth-decrement) — gather the
+      subject-set row of (ns, obj, via) and check each target at
+      depth-dec-1 without skip_direct (rewrites.go:242-293).
+
+    ``dec`` counts nested-OR hops (each nested rewrite recurses at depth-1,
+    rewrites.go:118).  Relations containing AND / NOT set ``impure`` and are
+    routed to the general task-tree interpreter instead.
+    """
+
+    css_rel: np.ndarray  # int32[NS, R, Kc]; -1 = unused slot
+    css_dec: np.ndarray  # int32[NS, R, Kc]
+    css_probe: np.ndarray  # bool[NS, R, Kc]
+    ttu_via: np.ndarray  # int32[NS, R, Kt]; -1 = unused slot
+    ttu_tgt: np.ndarray  # int32[NS, R, Kt]
+    ttu_dec: np.ndarray  # int32[NS, R, Kt]
+    direct_ok: np.ndarray  # bool[NS, R]: direct check allowed (strict gate)
+    expand_ok: np.ndarray  # bool[NS, R]: subject-set expansion allowed
+    impure: np.ndarray  # bool[NS, R]: program has AND/NOT (fastpath-ineligible)
+    ns_cfg: np.ndarray  # bool[NS]: namespace configured with relations
+
+    def arrays(self):
+        return {
+            "f_css_rel": self.css_rel,
+            "f_css_dec": self.css_dec,
+            "f_css_probe": self.css_probe,
+            "f_ttu_via": self.ttu_via,
+            "f_ttu_tgt": self.ttu_tgt,
+            "f_ttu_dec": self.ttu_dec,
+            "f_direct_ok": self.direct_ok,
+            "f_expand_ok": self.expand_ok,
+        }
+
+
+def _flatten_rewrite(
+    vocab: Vocab,
+    ns: ast.Namespace,
+    rw: ast.SubjectSetRewrite,
+    dec: int,
+    strict: bool,
+    css: list,
+    ttu: list,
+) -> bool:
+    """Flatten a pure-OR rewrite into css/ttu entry lists.
+
+    Returns False (impure) on any AND / NOT node; entry order mirrors the
+    oracle's child order per level, which is irrelevant to verdicts (OR is
+    commutative and the BFS explores all branches anyway).
+    """
+    if rw.operation is not ast.Operator.OR:
+        return False
+    for child in rw.children:
+        if isinstance(child, ast.ComputedSubjectSet):
+            probe = not (strict and _has_own_rewrite(ns, child.relation))
+            css.append((vocab.relations.intern(child.relation), dec, probe))
+        elif isinstance(child, ast.TupleToSubjectSet):
+            ttu.append(
+                (
+                    vocab.relations.intern(child.relation),
+                    vocab.relations.intern(child.computed_subject_set_relation),
+                    dec,
+                )
+            )
+        elif isinstance(child, ast.SubjectSetRewrite):
+            # nested rewrites recurse at depth-1 (rewrites.go:118)
+            if not _flatten_rewrite(vocab, ns, child, dec + 1, strict, css, ttu):
+                return False
+        elif isinstance(child, ast.InvertResult):
+            return False
+        else:  # pragma: no cover
+            raise TypeError(f"unknown rewrite child {type(child)!r}")
+    return True
+
+
+def compile_flat_tables(
+    manager: Optional[NamespaceManager],
+    vocab: Vocab,
+    *,
+    strict: bool,
+    num_ns: int,
+    num_rel: int,
+) -> FlatTables:
+    """Flatten every relation's rewrite; shapes padded to (num_ns, num_rel)."""
+    namespaces = manager.namespaces() if manager is not None else []
+    entries = {}  # (ns_id, rel_id) -> (css, ttu) or None for impure
+    ns_cfg = np.zeros(num_ns, bool)
+    direct_ok = np.ones((num_ns, num_rel), bool)
+    expand_ok = np.ones((num_ns, num_rel), bool)
+    impure = np.zeros((num_ns, num_rel), bool)
+    for ns in namespaces:
+        ns_id = vocab.namespaces.intern(ns.name)
+        if not ns.relations:
+            continue
+        ns_cfg[ns_id] = True
+        for rel in ns.relations:
+            rel_id = vocab.relations.intern(rel.name)
+            has_rw = rel.subject_set_rewrite is not None
+            if strict:
+                # rewrites suppress the direct check; expansion needs
+                # subject-set-capable types (engine.go:233-258)
+                direct_ok[ns_id, rel_id] = not has_rw
+                expand_ok[ns_id, rel_id] = any(t.relation != "" for t in rel.types)
+            if has_rw:
+                css: list = []
+                ttu: list = []
+                if _flatten_rewrite(
+                    vocab, ns, rel.subject_set_rewrite, 0, strict, css, ttu
+                ):
+                    entries[(ns_id, rel_id)] = (css, ttu)
+                else:
+                    impure[ns_id, rel_id] = True
+
+    kc = _bucket(max((len(c) for c, _ in entries.values()), default=1), 1)
+    kt = _bucket(max((len(t) for _, t in entries.values()), default=1), 1)
+    css_rel = np.full((num_ns, num_rel, kc), -1, np.int32)
+    css_dec = np.zeros((num_ns, num_rel, kc), np.int32)
+    css_probe = np.zeros((num_ns, num_rel, kc), bool)
+    ttu_via = np.full((num_ns, num_rel, kt), -1, np.int32)
+    ttu_tgt = np.full((num_ns, num_rel, kt), -1, np.int32)
+    ttu_dec = np.zeros((num_ns, num_rel, kt), np.int32)
+    for (ns_id, rel_id), (css, ttu) in entries.items():
+        for k, (r, d, p) in enumerate(css):
+            css_rel[ns_id, rel_id, k] = r
+            css_dec[ns_id, rel_id, k] = d
+            css_probe[ns_id, rel_id, k] = p
+        for k, (v, t, d) in enumerate(ttu):
+            ttu_via[ns_id, rel_id, k] = v
+            ttu_tgt[ns_id, rel_id, k] = t
+            ttu_dec[ns_id, rel_id, k] = d
+    return FlatTables(
+        css_rel=css_rel,
+        css_dec=css_dec,
+        css_probe=css_probe,
+        ttu_via=ttu_via,
+        ttu_tgt=ttu_tgt,
+        ttu_dec=ttu_dec,
+        direct_ok=direct_ok,
+        expand_ok=expand_ok,
+        impure=impure,
+        ns_cfg=ns_cfg,
+    )
+
+
 def compile_op_table(
     manager: Optional[NamespaceManager], vocab: Vocab, *, strict: bool
 ) -> OpTable:

@@ -141,12 +141,20 @@ class CheckEngine:
         max_depth: int = DEFAULT_MAX_DEPTH,
         max_width: int = DEFAULT_MAX_WIDTH,
         strict_mode: bool = False,
+        track_visited: bool = True,
     ):
         self.store = store
         self.namespace_manager = namespace_manager
         self.max_depth = max_depth
         self.max_width = max_width
         self.strict_mode = strict_mode
+        # track_visited=False explores the full depth-bounded closure with no
+        # cycle-visited suppression (exponential; test arbiter only).  The
+        # reference's *concurrent* engine races its shared visited set
+        # (engine.go:119,157-162), so any schedule's IS verdicts lie between
+        # the sequential-DFS run and this closure — the device BFS is
+        # arbitrated against both (see fastpath.py docstring).
+        self.track_visited = track_visited
         self.traverser = Traverser(
             store, namespace_manager, strict_mode=strict_mode
         )
@@ -239,7 +247,7 @@ class CheckEngine:
         checks: List[_Check] = []
         for result in results:
             key = (result.to.namespace, result.to.object, result.to.relation)
-            if key in inner_visited:
+            if self.track_visited and key in inner_visited:
                 continue
             inner_visited.add(key)
             checks.append(

@@ -27,7 +27,13 @@ from typing import Dict, Optional
 import numpy as np
 
 from ketotpu.api.types import RelationTuple, SubjectSet
-from ketotpu.engine.optable import OpTable, compile_op_table
+from ketotpu.engine.hashtab import build_table
+from ketotpu.engine.optable import (
+    FlatTables,
+    OpTable,
+    compile_flat_tables,
+    compile_op_table,
+)
 from ketotpu.engine.vocab import Vocab
 from ketotpu.storage.memory import InMemoryTupleStore
 from ketotpu.storage.namespaces import NamespaceManager
@@ -48,6 +54,9 @@ class Snapshot:
 
     vocab: Vocab
     op: OpTable
+    flat: FlatTables  # flattened pure-OR programs (BFS fast path)
+    taint: np.ndarray  # bool[NS, R]: relation can reach AND/NOT or a client
+    # error through rewrites or live graph edges => general engine, not fastpath
     num_rels: int  # hi-key stride, static per snapshot
 
     node_hi: np.ndarray  # int32[N'] sorted (pad: I32MAX)
@@ -65,9 +74,15 @@ class Snapshot:
     n_tuples: int
     version: int = -1
 
+    node_tab: Dict[str, np.ndarray] = None  # hash table (hi, lo) -> node id
+    mem_tab: Dict[str, np.ndarray] = None  # hash set of (node, subject)
+
     def arrays(self) -> Dict[str, np.ndarray]:
         """The pytree of device arrays the jitted step consumes."""
         return {
+            **self.flat.arrays(),
+            **{f"nt_{k}": v for k, v in self.node_tab.items()},
+            **{f"mt_{k}": v for k, v in self.mem_tab.items()},
             "node_hi": self.node_hi,
             "node_lo": self.node_lo,
             "row_ptr": self.row_ptr,
@@ -93,6 +108,59 @@ class Snapshot:
 
     def node_key(self, ns_id: int, obj_id: int, rel_id: int):
         return ns_id * self.num_rels + rel_id, obj_id
+
+
+def _compute_taint(
+    flat: FlatTables, op: OpTable, dyn_pairs, num_ns: int, num_rel: int
+) -> np.ndarray:
+    """Which (namespace, relation) pairs may NOT use the BFS fast path.
+
+    Backward reachability over the relation-level edge graph to any pair
+    whose program is impure (AND/NOT) or whose lookup is a client error
+    (namespace/definitions.go:61): the oracle raises that error at any
+    recursion depth, and NOT can flip verdicts, so a query that can *reach*
+    such a pair must run on the general interpreter for exact semantics.
+
+    Edges: live subject-set CSR pairs (expansion hops), CSS remaps (same
+    namespace), and TTU hops into every namespace the via-relation's live
+    edges point at (conservative: over-taint is safe, it just routes more
+    queries to the slower engine).
+    """
+    src: list = []
+    dst: list = []
+    ns_targets: Dict[tuple, set] = {}
+    for sns, srel, ens, erel in dyn_pairs:
+        src.append(sns * num_rel + srel)
+        dst.append(ens * num_rel + erel)
+        ns_targets.setdefault((sns, srel), set()).add(ens)
+    kc, kt = flat.css_rel.shape[2], flat.ttu_via.shape[2]
+    for ns_id in range(num_ns):
+        for rel_id in range(num_rel):
+            base = ns_id * num_rel + rel_id
+            for k in range(kc):
+                r = int(flat.css_rel[ns_id, rel_id, k])
+                if r >= 0:
+                    src.append(base)
+                    dst.append(ns_id * num_rel + r)
+            for k in range(kt):
+                v = int(flat.ttu_via[ns_id, rel_id, k])
+                if v < 0:
+                    continue
+                tgt = int(flat.ttu_tgt[ns_id, rel_id, k])
+                for ens in ns_targets.get((ns_id, v), ()):
+                    src.append(base)
+                    dst.append(ens * num_rel + tgt)
+    taint = (flat.impure | op.rel_err).ravel().copy()
+    if src:
+        src_a = np.asarray(src, np.int64)
+        dst_a = np.asarray(dst, np.int64)
+        for _ in range(num_ns * num_rel):
+            new = taint.copy()
+            np.logical_or.at(new, src_a, taint[dst_a])
+            if (new == taint).all():
+                break
+            taint = new
+    return taint.reshape(num_ns, num_rel)
 
 
 def build_snapshot(
@@ -136,6 +204,7 @@ def build_snapshot(
 
     # -- subject-set CSR (insertion order within each row) -------------------
     per_row: Dict[int, list] = {}
+    dyn_pairs = set()  # relation-level (src_ns, src_rel, dst_ns, dst_rel)
     for k, t in zip(triples, tuples):
         if not isinstance(t.subject, SubjectSet):
             continue
@@ -143,6 +212,14 @@ def build_snapshot(
         s_ns = vocab.namespaces.lookup(s.namespace)
         s_obj = vocab.objects.lookup(s.object)
         s_rel = vocab.relations.lookup(s.relation)
+        dyn_pairs.add(
+            (
+                vocab.namespaces.lookup(t.namespace),
+                vocab.relations.lookup(t.relation),
+                s_ns,
+                s_rel,
+            )
+        )
         per_row.setdefault(node_id[k], []).append(
             (s_ns, s_obj, s_rel, node_id.get((hi(s_ns, s_rel), s_obj), -1))
         )
@@ -178,9 +255,28 @@ def build_snapshot(
         mem_node[:n_tuples] = [p[0] for p in pairs]
         mem_subj[:n_tuples] = [p[1] for p in pairs]
 
+    num_ns = op.prog_root.shape[0]
+    flat = compile_flat_tables(
+        manager, vocab, strict=strict, num_ns=num_ns, num_rel=num_rels
+    )
+    taint = _compute_taint(flat, op, dyn_pairs, num_ns, num_rels)
+
+    # O(1) device lookups (see hashtab.py)
+    node_tab = build_table(
+        np.fromiter((k[0] for k in uniq), np.int64, n_nodes),
+        np.fromiter((k[1] for k in uniq), np.int64, n_nodes),
+        np.arange(n_nodes, dtype=np.int32),
+    )
+    mem_tab = build_table(
+        np.fromiter((p[0] for p in pairs), np.int64, n_tuples),
+        np.fromiter((p[1] for p in pairs), np.int64, n_tuples),
+    )
+
     return Snapshot(
         vocab=vocab,
         op=op,
+        flat=flat,
+        taint=taint,
         num_rels=num_rels,
         node_hi=node_hi,
         node_lo=node_lo,
@@ -195,4 +291,6 @@ def build_snapshot(
         n_edges=n_edges,
         n_tuples=n_tuples,
         version=store.version,
+        node_tab=node_tab,
+        mem_tab=mem_tab,
     )

@@ -1,17 +1,27 @@
-"""The TPU check engine: host wrapper around the batched device interpreter.
+"""The TPU check engine: host wrapper around the batched device interpreters.
 
 Plays the role of the reference's `check.Engine` (`internal/check/engine.go:
 65-95`) behind the same provider seam: callers hand it relation tuples, it
 answers allow/deny.  Internally it
 
-1. projects the tuple store into a device snapshot (cached by store version,
-   rebuilt on write — the CSR analog of read-committed SQL),
+1. projects the tuple store into a device snapshot — cached by
+   (store version, namespace-config fingerprint) so an OPL hot-reload
+   invalidates device state just like a tuple write,
 2. interns query strings to dense ids (unknown strings miss everywhere, which
    reproduces "unknown namespace => not allowed", check/handler.go:169-171),
-3. dispatches the whole batch to `device.run_batch`, and
-4. falls back to the sequential oracle for queries the device flags —
-   capacity overflow or an error verdict (errors re-raise host-side with the
-   reference's exact message via the oracle path).
+3. routes each query by a per-(namespace, relation) static classification:
+
+   * **fast path** (`fastpath.run_fast`) — pure-OR rewrite closure:
+     depth-bounded reachability with a monotone found-bit, `max_depth`
+     async device steps, no host syncs;
+   * **general path** (`device.run_batch`) — relations that can reach
+     AND / NOT: the task-tree interpreter with three-valued propagation;
+   * **host path** — queries whose top-level lookup is a client error
+     (namespace/definitions.go:61): the oracle raises the reference's
+     exact typed error;
+
+4. falls back to the sequential oracle only for queries the device could
+   not finish (overflow on a not-yet-found query, or an error verdict).
 
 `check()` is the single-query API; `batch_check()` is the throughput surface
 (the BatchCheck of BASELINE config #4 — the reference has no batch RPC at
@@ -27,6 +37,7 @@ import numpy as np
 
 from ketotpu.api.types import RelationTuple
 from ketotpu.engine import device as dev
+from ketotpu.engine import fastpath as fp
 from ketotpu.engine.oracle import (
     DEFAULT_MAX_DEPTH,
     DEFAULT_MAX_WIDTH,
@@ -38,11 +49,24 @@ from ketotpu.storage.memory import InMemoryTupleStore
 from ketotpu.storage.namespaces import NamespaceManager
 
 
-def _bucket(n: int, floor: int = 32) -> int:
+def _bucket(n: int, floor: int = 256) -> int:
     b = floor
     while b < n:
         b *= 2
     return b
+
+
+def config_fingerprint(manager: Optional[NamespaceManager]) -> int:
+    """Cheap namespace-config identity for snapshot caching.
+
+    Calling ``namespaces()`` first gives file-backed managers their reload
+    window (storage/namespaces.py), then the AST reprs pin the content —
+    so a hot-reloaded OPL file rebuilds the snapshot even when the tuple
+    store version did not move.
+    """
+    if manager is None:
+        return 0
+    return hash(tuple(repr(ns) for ns in manager.namespaces()))
 
 
 class DeviceCheckEngine:
@@ -56,22 +80,26 @@ class DeviceCheckEngine:
         max_depth: int = DEFAULT_MAX_DEPTH,
         max_width: int = DEFAULT_MAX_WIDTH,
         strict_mode: bool = False,
-        cap: int = 8192,
+        frontier: int = 4096,
         arena: int = 8192,
+        cap: int = 8192,
+        gen_arena: int = 8192,
         vcap: int = 4096,
         max_iters: int = 64,
-        max_batch: int = 1024,
+        max_batch: int = 8192,
     ):
         self.store = store
         self.namespace_manager = namespace_manager
         self.max_depth = max_depth
         self.max_width = max_width
         self.strict_mode = strict_mode
-        self.cap = cap
+        self.frontier = frontier
         self.arena = arena
+        self.cap = cap  # general-path task capacity
+        self.gen_arena = gen_arena
         self.vcap = vcap
         self.max_iters = max_iters
-        self.max_batch = min(max_batch, cap // 4)
+        self.max_batch = min(max_batch, frontier)
         self.oracle = CheckEngine(
             store,
             namespace_manager,
@@ -81,19 +109,26 @@ class DeviceCheckEngine:
         )
         self._vocab = Vocab()
         self._snap: Optional[Snapshot] = None
+        self._snap_fingerprint: Optional[int] = None
         self._device_arrays = None
         self.fallbacks = 0  # observability: host-fallback counter
 
     # -- snapshot lifecycle -------------------------------------------------
 
     def snapshot(self) -> Snapshot:
-        if self._snap is None or self._snap.version != self.store.version:
+        fingerprint = config_fingerprint(self.namespace_manager)
+        if (
+            self._snap is None
+            or self._snap.version != self.store.version
+            or self._snap_fingerprint != fingerprint
+        ):
             self._snap = build_snapshot(
                 self.store,
                 self.namespace_manager,
                 self._vocab,
                 strict=self.strict_mode,
             )
+            self._snap_fingerprint = fingerprint
             self._device_arrays = jax.device_put(self._snap.arrays())
         return self._snap
 
@@ -103,35 +138,38 @@ class DeviceCheckEngine:
         snap = self.snapshot()
         v = snap.vocab
         n = len(queries)
-        q_ns = np.full(n, -1, np.int32)
-        q_obj = np.full(n, -1, np.int32)
-        q_rel = np.full(n, -1, np.int32)
-        q_subj = np.full(n, -1, np.int32)
-        for i, q in enumerate(queries):
-            q_ns[i] = v.namespaces.lookup(q.namespace)
-            q_obj[i] = v.objects.lookup(q.object)
-            q_rel[i] = v.relations.lookup(q.relation)
-            q_subj[i] = v.subject_key(q.subject)
+        ns_look = v.namespaces.lookup
+        obj_look = v.objects.lookup
+        rel_look = v.relations.lookup
+        subj_look = v.subject_key
+        q_ns = np.fromiter((ns_look(q.namespace) for q in queries), np.int32, n)
+        q_obj = np.fromiter((obj_look(q.object) for q in queries), np.int32, n)
+        q_rel = np.fromiter((rel_look(q.relation) for q in queries), np.int32, n)
+        q_subj = np.fromiter((subj_look(q.subject) for q in queries), np.int32, n)
         # global max-depth precedence (engine.go:82-84)
         if rest_depth <= 0 or self.max_depth < rest_depth:
             rest_depth = self.max_depth
         q_depth = np.full(n, rest_depth, np.int32)
         return q_ns, q_obj, q_rel, q_subj, q_depth
 
-    def _needs_host(self, q: RelationTuple) -> bool:
-        """A top-level relation undeclared on a configured namespace is a
-        client error (namespace/definitions.go:61).  Declared relations are
-        always in the vocab, so this only triggers for genuine errors the
-        device can't see (its ids are -1 for unknown strings)."""
-        if self.namespace_manager is None:
-            return False
-        try:
-            from ketotpu.storage.namespaces import ast_relation_for
+    def _classify(self, snap: Snapshot, q_ns, q_rel):
+        """(err, general) masks from the snapshot's static tables.
 
-            ast_relation_for(self.namespace_manager, q.namespace, q.relation)
-            return False
-        except Exception:
-            return True
+        err: the oracle must raise the reference's typed client error —
+        a configured namespace queried with an undeclared non-empty relation
+        (namespace/definitions.go:61).  general: the relation's closure can
+        reach AND/NOT or an erroring lookup, so the task-tree interpreter
+        runs it (fastpath semantics would be wrong).
+        """
+        num_ns, num_rel = snap.taint.shape
+        ns_ok = q_ns >= 0
+        nsc = np.clip(q_ns, 0, num_ns - 1)
+        relc = np.clip(q_rel, 0, num_rel - 1)
+        ns_cfg = ns_ok & snap.flat.ns_cfg[nsc]
+        rel_known = q_rel >= 0
+        err = ns_cfg & (~rel_known | snap.op.rel_err[nsc, relc])
+        general = ~err & ns_ok & rel_known & snap.taint[nsc, relc]
+        return err, general
 
     # -- public API ---------------------------------------------------------
 
@@ -144,90 +182,94 @@ class DeviceCheckEngine:
     def batch_check(
         self, queries: Sequence[RelationTuple], rest_depth: int = 0
     ) -> List[bool]:
-        out: List[Optional[bool]] = [None] * len(queries)
+        out: List[bool] = []
+        queries = list(queries)
         for lo in range(0, len(queries), self.max_batch):
-            chunk = list(queries)[lo : lo + self.max_batch]
-            for i, r in enumerate(
-                self._batch_check_chunk(chunk, rest_depth)
-            ):
-                out[lo + i] = r
-        return out  # type: ignore[return-value]
+            out.extend(
+                self._batch_check_chunk(queries[lo : lo + self.max_batch], rest_depth)
+            )
+        return out
+
+    def _pad(self, arrays, n: int, qpad: int):
+        fills = (-1, -1, -1, -1, 1)
+        if qpad == n:
+            return arrays
+        return tuple(
+            np.pad(a, (0, qpad - n), constant_values=f)
+            for a, f in zip(arrays, fills)
+        )
+
+    def _device_verdicts(self, queries: Sequence[RelationTuple], rest_depth: int):
+        """(allowed, fallback) bool arrays for one chunk, no oracle calls."""
+        n = len(queries)
+        snap = self.snapshot()
+        enc = self._encode(queries, rest_depth)
+        err, general = self._classify(snap, enc[0], enc[2])
+        qpad = _bucket(n)
+        q_ns, q_obj, q_rel, q_subj, q_depth = self._pad(enc, n, qpad)
+
+        allowed = np.zeros(n, bool)
+        fallback = err.copy()
+
+        fast_active = np.pad(~(err | general), (0, qpad - n))
+        res = fp.run_fast(
+            self._device_arrays,
+            q_ns,
+            q_obj,
+            q_rel,
+            q_subj,
+            q_depth,
+            fast_active,
+            frontier=self.frontier,
+            arena=self.arena,
+            max_depth=self.max_depth,
+            max_width=self.max_width,
+        )
+
+        if general.any():
+            gi = np.flatnonzero(general)
+            gpad = _bucket(len(gi), 32)
+            genc = self._pad(tuple(a[gi] for a in enc), len(gi), gpad)
+            gres = dev.run_batch(
+                self._device_arrays,
+                *genc,
+                cap=self.cap,
+                arena=self.gen_arena,
+                vcap=self.vcap,
+                max_iters=self.max_iters,
+                max_width=self.max_width,
+                strict=self.strict_mode,
+            )
+            codes = np.asarray(gres.result)[: len(gi)]
+            gover = np.asarray(gres.overflow)[: len(gi)]
+            allowed[gi] = codes == dev.R_IS
+            fallback[gi] |= gover | (codes == dev.R_ERR)
+
+        found = np.asarray(res.found)[:n]
+        over = np.asarray(res.over)[:n]
+        fmask = ~(err | general)
+        allowed[fmask] = found[fmask]
+        # found is monotone: an overflow only voids not-yet-found queries
+        fallback[fmask] |= over[fmask] & ~found[fmask]
+        return allowed, fallback
 
     def _batch_check_chunk(
         self, queries: Sequence[RelationTuple], rest_depth: int
     ) -> List[bool]:
         if not queries:
             return []
-        q_ns, q_obj, q_rel, q_subj, q_depth = self._encode(queries, rest_depth)
-        # pad the batch to a bucket so jit caches across batch sizes
-        n = len(queries)
-        qpad = _bucket(n)
-        pad = qpad - n
-        if pad:
-            q_ns = np.pad(q_ns, (0, pad), constant_values=-1)
-            q_obj = np.pad(q_obj, (0, pad), constant_values=-1)
-            q_rel = np.pad(q_rel, (0, pad), constant_values=-1)
-            q_subj = np.pad(q_subj, (0, pad), constant_values=-1)
-            q_depth = np.pad(q_depth, (0, pad), constant_values=1)
-
-        res = dev.run_batch(
-            self._device_arrays,
-            q_ns,
-            q_obj,
-            q_rel,
-            q_subj,
-            q_depth,
-            cap=self.cap,
-            arena=self.arena,
-            vcap=self.vcap,
-            max_iters=self.max_iters,
-            max_width=self.max_width,
-            strict=self.strict_mode,
-        )
-        codes = np.asarray(res.result)[:n]
-        over = np.asarray(res.overflow)[:n]
-
-        out: List[bool] = []
-        for i, r in enumerate(queries):
-            if over[i] or codes[i] == dev.R_ERR or self._needs_host(r):
+        allowed, fallback = self._device_verdicts(queries, rest_depth)
+        if fallback.any():
+            for i in np.flatnonzero(fallback):
                 # oracle reproduces the exact verdict or typed error
                 self.fallbacks += 1
-                out.append(self.oracle.check_is_member(r, rest_depth))
-            else:
-                out.append(bool(codes[i] == dev.R_IS))
-        return out
+                allowed[i] = self.oracle.check_is_member(queries[i], rest_depth)
+        return allowed.tolist()
 
     def batch_check_device_only(
         self, queries: Sequence[RelationTuple], rest_depth: int = 0
     ):
         """Device verdicts without fallback: (allowed[], fallback_needed[]).
         Test/diagnostic surface."""
-        n = len(queries)
-        q_ns, q_obj, q_rel, q_subj, q_depth = self._encode(queries, rest_depth)
-        pad = _bucket(n) - n
-        if pad:
-            q_ns = np.pad(q_ns, (0, pad), constant_values=-1)
-            q_obj = np.pad(q_obj, (0, pad), constant_values=-1)
-            q_rel = np.pad(q_rel, (0, pad), constant_values=-1)
-            q_subj = np.pad(q_subj, (0, pad), constant_values=-1)
-            q_depth = np.pad(q_depth, (0, pad), constant_values=1)
-        res = dev.run_batch(
-            self._device_arrays,
-            q_ns,
-            q_obj,
-            q_rel,
-            q_subj,
-            q_depth,
-            cap=self.cap,
-            arena=self.arena,
-            vcap=self.vcap,
-            max_iters=self.max_iters,
-            max_width=self.max_width,
-            strict=self.strict_mode,
-        )
-        codes = np.asarray(res.result)[:n]
-        over = np.asarray(res.overflow)[:n]
-        needs = over | (codes == dev.R_ERR) | np.array(
-            [self._needs_host(q) for q in queries], dtype=bool
-        )
-        return (codes == dev.R_IS).tolist(), needs.tolist()
+        allowed, fallback = self._device_verdicts(queries, rest_depth)
+        return allowed.tolist(), fallback.tolist()

@@ -4,16 +4,24 @@ The reference scales out as stateless replicas over a shared SQL database
 (SURVEY §2 checklist: no collectives, no multi-process runtime exist there).
 Here scale-out is a first-class device-mesh design:
 
-* **query data-parallelism** (`shard_batch_check`): the batch axis of checks
-  is sharded over the mesh, the tuple graph is replicated — every device runs
-  the full wavefront interpreter on its query shard with zero cross-device
+* **query data-parallelism** (`shard_fast_check`, `shard_batch_check`): the
+  batch axis of checks is sharded over the mesh, the tuple graph is
+  replicated — every device runs its query shard with zero cross-device
   traffic.  This is the throughput axis (BatchCheck, BASELINE config #4).
-* **graph sharding** (parallel/graphshard.py): membership and CSR rows
-  partitioned by node hash across a second mesh axis with psum-combined
-  probes over ICI — the capacity axis for graphs beyond one chip's HBM
-  (BASELINE config #5).
+* **graph sharding** (`graphshard.sharded_check`): tuples partitioned by
+  (namespace, object) hash across the mesh; each BFS level does local CSR
+  gathers, routes cross-shard children with `lax.all_to_all` over ICI, and
+  psum-merges the monotone found-bits — the capacity axis for graphs beyond
+  one chip's HBM (BASELINE config #5).
 """
 
-from ketotpu.parallel.mesh import make_mesh, shard_batch_check
+from ketotpu.parallel.graphshard import build_sharded_snapshot, sharded_check
+from ketotpu.parallel.mesh import make_mesh, shard_batch_check, shard_fast_check
 
-__all__ = ["make_mesh", "shard_batch_check"]
+__all__ = [
+    "build_sharded_snapshot",
+    "make_mesh",
+    "shard_batch_check",
+    "shard_fast_check",
+    "sharded_check",
+]

@@ -1,0 +1,248 @@
+"""Graph-sharded batch checks: the CSR partitioned across the device mesh.
+
+BASELINE config #5: a 10M-tuple graph object-sharded over a mesh, with
+cross-namespace subject-set / tuple-to-userset hops routed over ICI.  The
+reference has no analog — it scales out with stateless replicas over one SQL
+database (SURVEY §2 parallelism checklist); this layout is the TPU-native
+replacement.
+
+Partitioning: a tuple row lives on shard ``hash(namespace, object) % n``
+(hashtab's mix, salt 0).  Keying by (namespace, object) — not the full node
+key — keeps every relation of an object co-resident, so
+
+* direct membership probes,
+* the batched computed-subject-set shortcut (same object, other relation),
+* tuple-to-userset via-rows (same object, via relation)
+
+are all shard-local.  Only *children* can cross shards: subject-set
+expansion targets and TTU computed targets.  Each BFS level therefore runs
+
+    expand (local gathers)  →  all-to-all (route children to owners)
+    →  pack (dedup on arrival)  →  psum (merge found/over bits)
+
+inside one `jax.shard_map`, with `fastpath.expand_phase(sharded=True)`
+providing exact EXISTS-bit semantics across shards: expansion children carry
+a forced membership probe executed by their owner on arrival, and
+width-truncated children ship as probe-only items (depth 0) so the
+pre-truncation EXISTS check of `engine.go:131-139` survives sharding.
+
+The all-to-all uses fixed per-destination buckets (capacity = arena / n per
+peer); bucket overflow sets the affected queries' ``q_over`` bits — the same
+monotone overflow contract as the single-chip engine.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ketotpu.engine import fastpath as fp
+from ketotpu.engine import hashtab
+from ketotpu.engine.snapshot import Snapshot, build_snapshot
+from ketotpu.storage.memory import InMemoryTupleStore
+from ketotpu.storage.namespaces import NamespaceManager
+from ketotpu.engine.vocab import Vocab
+from ketotpu.api.types import RelationTuple
+
+
+def shard_of_np(ns_ids: np.ndarray, obj_ids: np.ndarray, n_shards: int) -> np.ndarray:
+    """Owner shard of (namespace, object) — host side."""
+    h = hashtab._mix_np(
+        np.asarray(ns_ids, np.int64), np.asarray(obj_ids, np.int64),
+        hashtab._SALTS[0],
+    )
+    return (h % np.uint32(n_shards)).astype(np.int32)
+
+
+def shard_of_device(ns_ids, obj_ids, n_shards: int):
+    h = hashtab.mix_device(ns_ids, obj_ids, jnp.uint32(hashtab._SALTS[0]))
+    return (h % jnp.uint32(n_shards)).astype(jnp.int32)
+
+
+def build_sharded_snapshot(
+    store: InMemoryTupleStore,
+    manager: Optional[NamespaceManager],
+    n_shards: int,
+    vocab: Optional[Vocab] = None,
+) -> Tuple[List[Snapshot], Dict[str, np.ndarray]]:
+    """Partition the store by owner shard and build one snapshot per shard.
+
+    All shards share one vocabulary (ids are global) and are padded to
+    common array shapes, so the stacked dict (leading axis = shard) can be
+    fed through `shard_map` with the graph partitioned on that axis.
+    """
+    vocab = vocab if vocab is not None else Vocab()
+    for t in store.all_tuples():
+        vocab.intern_tuple(t)
+
+    parts: List[List[RelationTuple]] = [[] for _ in range(n_shards)]
+    for t in store.all_tuples():
+        ns_id = vocab.namespaces.lookup(t.namespace)
+        obj_id = vocab.objects.lookup(t.object)
+        s = int(shard_of_np(np.array([ns_id]), np.array([obj_id]), n_shards)[0])
+        parts[s].append(t)
+
+    snaps: List[Snapshot] = []
+    for part in parts:
+        sub = InMemoryTupleStore()
+        if part:
+            sub.write_relation_tuples(*part)
+        snaps.append(build_snapshot(sub, manager, vocab))
+
+    # pad every per-shard array to the maximum shape, then stack
+    keys = snaps[0].arrays().keys()
+    stacked: Dict[str, np.ndarray] = {}
+    for k in keys:
+        arrs = [np.asarray(s.arrays()[k]) for s in snaps]
+        shape = tuple(max(a.shape[i] for a in arrs) for i in range(arrs[0].ndim))
+        padded = []
+        for a in arrs:
+            pad = [(0, shape[i] - a.shape[i]) for i in range(a.ndim)]
+            fill = 0 if k.endswith("ptr") else (False if a.dtype == bool else -1)
+            b = np.pad(a, pad, constant_values=fill)
+            if k.endswith("ptr") and a.shape[0] < shape[0]:
+                b[a.shape[0]:] = a[-1]  # CSR tail rows stay empty
+            padded.append(b)
+        stacked[k] = np.stack(padded)
+    return snaps, stacked
+
+
+def _route(children: Dict, n: int, cap: int, q_over, axis: str):
+    """Bucket children by owner shard and all-to-all them to owners.
+
+    ``cap`` slots per destination peer; overflow marks q_over (monotone).
+    """
+    Q = q_over.shape[0]
+    dest = shard_of_device(children["ns"], children["obj"], n)
+    alive = children["qid"] >= 0
+    dest = jnp.where(alive, dest, n)  # dead rows sort last
+
+    # stable sort by destination, then slot within each dest bucket
+    A = dest.shape[0]
+    order = jnp.argsort(dest * (A + 1) + jnp.arange(A, dtype=jnp.int32))
+    dsorted = dest[order]
+    # position within the destination run
+    pos_in_run = jnp.arange(A, dtype=jnp.int32) - jnp.searchsorted(
+        dsorted, dsorted, side="left"
+    )
+    over_b = (dsorted < n) & (pos_in_run >= cap)
+    srt = {k: v[order] for k, v in children.items()}
+    q_over = q_over.at[jnp.clip(srt["qid"], 0, Q - 1)].max(over_b & (srt["qid"] >= 0))
+
+    slot = jnp.where(dsorted < n, dsorted * cap + jnp.clip(pos_in_run, 0, cap - 1), n * cap)
+    slot = jnp.where(over_b, n * cap, slot)
+
+    def bucketize(col, fill):
+        return (
+            jnp.full((n * cap,), fill, col.dtype)
+            .at[slot]
+            .set(jnp.where(over_b | (dsorted >= n), fill, col), mode="drop")
+        )
+
+    send = jnp.stack(
+        [
+            bucketize(srt["qid"], -1),
+            bucketize(srt["ns"], -1),
+            bucketize(srt["obj"], -1),
+            bucketize(srt["rel"], -1),
+            bucketize(srt["d"], 0),
+            bucketize(srt["skip"].astype(jnp.int32), 1),
+            bucketize(srt["force"].astype(jnp.int32), 0),
+        ],
+        axis=1,
+    ).reshape(n, cap, 7)
+    recv = jax.lax.all_to_all(send, axis, 0, 0, tiled=False)
+    recv = recv.reshape(n * cap, 7)
+    out = dict(
+        qid=recv[:, 0],
+        ns=recv[:, 1],
+        obj=recv[:, 2],
+        rel=recv[:, 3],
+        d=recv[:, 4],
+        skip=recv[:, 5].astype(bool),
+        force=recv[:, 6].astype(bool),
+    )
+    return out, q_over
+
+
+def sharded_check(
+    stacked_g: Dict[str, np.ndarray],
+    queries: Sequence[np.ndarray],
+    mesh: Mesh,
+    *,
+    axis: str = "shard",
+    frontier: int = 2048,
+    arena: int = 8192,
+    max_depth: int = 5,
+    max_width: int = 100,
+    active=None,
+) -> fp.FastResult:
+    """Check a replicated query batch against the sharded graph.
+
+    Queries are visible to every shard; each root item activates only on its
+    owner.  Found/overflow bits are psum-merged every level so short-circuit
+    masking works across shards.
+    """
+    n = mesh.devices.size
+    q_ns, q_obj, q_rel, q_subj, q_depth = (
+        jnp.asarray(a, jnp.int32) for a in queries
+    )
+    Q = q_ns.shape[0]
+    act = (
+        jnp.ones((Q,), bool) if active is None else jnp.asarray(active, bool)
+    )
+    cap = max(arena // max(n, 1), 8)
+
+    @functools.partial(
+        jax.jit, static_argnames=("frontier", "arena", "max_width", "max_depth")
+    )
+    def run(g, q_ns, q_obj, q_rel, q_subj, q_depth, act, *, frontier, arena,
+            max_width, max_depth):
+        def local(g, q_ns, q_obj, q_rel, q_subj, q_depth, act):
+            # P(axis) leaves a leading block dim of 1 on this shard's slice
+            g = jax.tree_util.tree_map(lambda a: a[0], g)
+            me = jax.lax.axis_index(axis)
+            mine = shard_of_device(q_ns, q_obj, n) == me
+            s = fp._init_state(
+                q_ns, q_obj, q_rel, q_subj, q_depth, act & mine,
+                frontier=frontier,
+            )
+            for _ in range(max_depth):
+                children, q_found, q_over = fp.expand_phase(
+                    g, s, arena=arena, max_width=max_width, sharded=True
+                )
+                children, q_over = _route(children, n, cap, q_over, axis)
+                # merge found bits across shards before packing so arrived
+                # children of already-found queries die immediately
+                q_found = (
+                    jax.lax.psum(q_found.astype(jnp.int32), axis) > 0
+                )
+                nxt, q_over = pack = fp.pack_phase(
+                    children, q_found, q_over, frontier=frontier
+                )
+                s = dict(nxt, q_found=q_found, q_over=q_over, q_subj=s["q_subj"])
+            q_found = jax.lax.psum(s["q_found"].astype(jnp.int32), axis) > 0
+            q_over = jax.lax.psum(s["q_over"].astype(jnp.int32), axis) > 0
+            return q_found, q_over
+
+        return jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(
+                jax.tree_util.tree_map(lambda _: P(axis), g),
+                P(), P(), P(), P(), P(), P(),
+            ),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )(g, q_ns, q_obj, q_rel, q_subj, q_depth, act)
+
+    found, over = run(
+        stacked_g, q_ns, q_obj, q_rel, q_subj, q_depth, act,
+        frontier=frontier, arena=arena, max_width=max_width, max_depth=max_depth,
+    )
+    return fp.FastResult(found=found, over=over)

@@ -16,3 +16,10 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The env var alone does NOT win against the preinstalled TPU plugin in this
+# jax build (verified: a subprocess with JAX_PLATFORMS=cpu still gets the
+# axon TPU client); the config.update below does.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -28,7 +28,13 @@ def make_engines(namespaces, tuples, *, opl=None, **kw):
         namespaces = parsed
     nsm = StaticNamespaceManager(namespaces) if namespaces is not None else None
     oracle = CheckEngine(store, nsm, **{k.replace("strict_mode", "strict_mode"): v for k, v in kw.items()})
-    device = DeviceCheckEngine(store, nsm, **kw)
+    # small static capacities: toy graphs, and shared shapes keep the jit
+    # cache warm across tests
+    device = DeviceCheckEngine(
+        store, nsm,
+        frontier=512, arena=1024, cap=2048, gen_arena=2048, vcap=1024,
+        **kw,
+    )
     return oracle, device
 
 

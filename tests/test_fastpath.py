@@ -1,0 +1,173 @@
+"""Scale-honest differential tests for the pure-OR BFS fast path.
+
+VERDICT round-1 item 7: device-vs-oracle parity on graphs big enough that
+capacity handling matters, with the fallback excuse rate bounded, plus a
+randomized pure-OR fuzzer whose IS/NOT divergences are arbitrated against a
+visited-free oracle run (see fastpath.py docstring for why the sequential
+DFS oracle is a lower bound, not the unique reference verdict, on graphs
+where depth truncation meets the visited set).
+"""
+
+import numpy as np
+import pytest
+
+from ketotpu.api.types import RelationTuple, SubjectID, SubjectSet
+from ketotpu.engine import CheckEngine
+from ketotpu.engine.tpu import DeviceCheckEngine
+from ketotpu.opl.parser import parse
+from ketotpu.storage import InMemoryTupleStore, StaticNamespaceManager
+from ketotpu.utils.synth import build_synth, synth_queries
+
+T = RelationTuple.from_string
+
+
+def test_synth_parity_medium_scale():
+    """~7k tuples, 512 mixed queries, <5% fallback, full verdict parity."""
+    graph = build_synth(n_users=500, n_groups=30, n_folders=400, n_docs=4000, seed=3)
+    eng = DeviceCheckEngine(
+        graph.store, graph.manager, frontier=4096, arena=16384
+    )
+    queries = synth_queries(graph, 512, seed=4)
+    allowed, fallback = eng.batch_check_device_only(queries)
+    rate = float(np.mean(fallback))
+    assert rate < 0.05, f"fallback rate {rate:.1%}"
+    want = [eng.oracle.check_is_member(q) for q in queries]
+    for q, got, fb, w in zip(queries, allowed, fallback, want):
+        if not fb:
+            assert got == w, f"{q}: device={got} oracle={w}"
+    # the full path (with fallback executed) must be bit-exact
+    assert eng.batch_check(queries) == want
+
+
+def test_synth_parity_strict_mode():
+    graph = build_synth(n_users=200, n_groups=10, n_folders=100, n_docs=500, seed=5)
+    eng = DeviceCheckEngine(
+        graph.store, graph.manager, frontier=2048, arena=16384, strict_mode=True
+    )
+    queries = synth_queries(graph, 256, seed=6)
+    want = [eng.oracle.check_is_member(q) for q in queries]
+    assert eng.batch_check(queries) == want
+
+
+def test_found_is_monotone_under_overflow():
+    """A query proven IS before capacity runs out stays IS; only not-found
+    queries overflow to the host (round-1 weak #2 fix)."""
+    graph = build_synth(n_users=300, n_groups=20, n_folders=300, n_docs=2000, seed=7)
+    tiny = DeviceCheckEngine(graph.store, graph.manager, frontier=512, arena=512)
+    queries = synth_queries(graph, 256, seed=8)
+    allowed, fallback = tiny.batch_check_device_only(queries)
+    want = [tiny.oracle.check_is_member(q) for q in queries]
+    for q, got, fb, w in zip(queries, allowed, fallback, want):
+        if not fb:
+            assert got == w
+        if got and not fb:
+            assert w, f"{q}: device IS but oracle NOT"
+    # overflow must not corrupt the full path
+    assert tiny.batch_check(queries) == want
+
+
+def _pure_or_case(rng):
+    """Random pure-OR config + graph: unions of includes / traverse chains."""
+    n_ns = int(rng.integers(2, 4))
+    names = [f"N{i}" for i in range(n_ns)]
+    lines = ["import { Namespace, SubjectSet, Context } from '@ory/keto-namespace-types'"]
+    rels = ["r0", "r1"]
+    perms = ["p0", "p1"]
+    for name in names:
+        # only namespaces with permits in the types: traverse() type-checks
+        # against every declared type (typechecks.go); plain subject-id
+        # tuples need no type declaration at non-strict runtime
+        related = "\n".join(
+            f"    {r}: ({' | '.join(names)})[]" for r in rels
+        )
+        choices = [
+            "this.related.r0.includes(ctx.subject)",
+            "this.related.r1.includes(ctx.subject)",
+            "this.related.r0.traverse((x) => x.permits.p1(ctx))",
+            "this.related.r1.traverse((x) => x.permits.p0(ctx))",
+            "this.permits.p1(ctx)",
+        ]
+        e0 = " || ".join(
+            rng.choice(choices, size=int(rng.integers(1, 4)), replace=False).tolist()
+        )
+        e1 = " || ".join(
+            rng.choice(choices[:2], size=int(rng.integers(1, 3)), replace=False).tolist()
+        )
+        lines.append(
+            f"class {name} implements Namespace {{\n"
+            f"  related: {{\n{related}\n  }}\n"
+            f"  permits = {{\n"
+            f"    p0: (ctx: Context): boolean =>\n      {e0},\n"
+            f"    p1: (ctx: Context): boolean =>\n      {e1},\n"
+            f"  }}\n}}"
+        )
+    lines.insert(1, "class User implements Namespace {}")
+    source = "\n".join(lines)
+
+    objects = [f"o{i}" for i in range(5)]
+    users = [f"u{i}" for i in range(4)]
+    tuples = set()
+    for _ in range(int(rng.integers(8, 40))):
+        ns = str(rng.choice(names))
+        obj = str(rng.choice(objects))
+        rel = str(rng.choice(rels))
+        if rng.random() < 0.5:
+            subj = str(rng.choice(users))
+        else:
+            subj = f"{rng.choice(names)}:{rng.choice(objects)}#{rng.choice(rels)}"
+        tuples.add(f"{ns}:{obj}#{rel}@{subj}")
+
+    queries = [
+        f"{rng.choice(names)}:{rng.choice(objects)}"
+        f"#{rng.choice(rels + perms)}@{rng.choice(users)}"
+        for _ in range(25)
+    ]
+    return source, sorted(tuples), queries
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_fuzz_pure_or(seed):
+    rng = np.random.default_rng(seed + 100)
+    source, tuples, queries = _pure_or_case(rng)
+    namespaces, errs = parse(source)
+    assert not errs, errs
+    store = InMemoryTupleStore()
+    store.write_relation_tuples(*[T(s) for s in tuples])
+    nsm = StaticNamespaceManager(namespaces)
+    dev = DeviceCheckEngine(store, nsm, frontier=512, arena=2048)
+    oracle = CheckEngine(store, nsm)
+    closure = CheckEngine(store, nsm, track_visited=False)
+    snap = dev.snapshot()
+    assert not snap.flat.impure.any(), "pure-OR fuzz case produced AND/NOT"
+    for depth in (0, 2, 3, 5):
+        allowed, fallback = dev.batch_check_device_only(
+            [T(q) for q in queries], depth
+        )
+        for q, got, fb in zip(queries, allowed, fallback):
+            if fb:
+                continue
+            want = oracle.check_is_member(T(q), depth)
+            if got == want:
+                continue
+            # arbitrate: device IS beyond the DFS oracle is legitimate only
+            # within the visited-free closure (a schedule of the concurrent
+            # reference engine could reach it); device NOT below the oracle
+            # never is
+            assert got and not want, f"{q}@{depth}: device={got} oracle={want}"
+            assert closure.check_is_member(T(q), depth), (
+                f"{q}@{depth}: device IS outside the visited-free closure"
+            )
+
+
+def test_cycles_terminate_without_visited_log():
+    """Cyclic subject-set graphs finish in max_depth steps (depth strictly
+    decreases per level; no visited set needed for termination)."""
+    store = InMemoryTupleStore()
+    store.write_relation_tuples(
+        T("g:a#m@g:b#m"), T("g:b#m@g:c#m"), T("g:c#m@g:a#m"), T("g:c#m@u")
+    )
+    dev = DeviceCheckEngine(store, None, frontier=512, arena=1024)
+    oracle = CheckEngine(store, None)
+    for q in ("g:a#m@u", "g:b#m@u", "g:c#m@u", "g:a#m@ghost"):
+        assert dev.check(T(q)) == oracle.check_is_member(T(q)), q
+    assert dev.fallbacks == 0

@@ -1,0 +1,162 @@
+"""CPU-mesh tests for ketotpu/parallel (VERDICT round-1 items 2 and 4).
+
+conftest.py forces an 8-device virtual CPU platform; every test here builds
+a real `jax.sharding.Mesh` and runs the multi-device paths the driver's
+`dryrun_multichip` exercises:
+
+* `shard_fast_check` — query-data-parallel fast path (graph replicated),
+* `graphshard.sharded_check` — graph partitioned by (namespace, object)
+  hash with `lax.all_to_all` child routing and psum-merged found bits,
+* `shard_batch_check` — the general task-tree interpreter, data-parallel.
+"""
+
+import numpy as np
+import pytest
+
+from ketotpu.api.types import RelationTuple
+from ketotpu.engine.tpu import DeviceCheckEngine
+from ketotpu.parallel import (
+    build_sharded_snapshot,
+    make_mesh,
+    shard_batch_check,
+    shard_fast_check,
+    sharded_check,
+)
+from ketotpu.parallel.graphshard import shard_of_np
+from ketotpu.storage import InMemoryTupleStore
+from ketotpu.utils.synth import build_synth, synth_queries
+
+T = RelationTuple.from_string
+
+
+def _engine_and_queries(n_queries, **synth_kw):
+    graph = build_synth(**synth_kw)
+    eng = DeviceCheckEngine(graph.store, graph.manager, frontier=1024, arena=4096)
+    eng.snapshot()
+    queries = synth_queries(graph, n_queries)
+    enc = tuple(np.asarray(a) for a in eng._encode(queries, 0))
+    want = [eng.oracle.check_is_member(r) for r in queries]
+    return eng, graph, queries, enc, want
+
+
+def test_shard_fast_check_parity():
+    eng, _, _, enc, want = _engine_and_queries(
+        128, n_users=64, n_groups=8, n_folders=32, n_docs=128
+    )
+    mesh = make_mesh(8)
+    res = shard_fast_check(
+        eng._device_arrays, enc, mesh, frontier=1024, arena=4096
+    )
+    got = np.asarray(res.found).tolist()
+    over = np.asarray(res.over)
+    assert not over.any()
+    assert got == want
+
+
+def test_shard_fast_check_rejects_uneven_batch():
+    eng, _, _, enc, _ = _engine_and_queries(
+        128, n_users=16, n_groups=4, n_folders=8, n_docs=16
+    )
+    mesh = make_mesh(8)
+    bad = tuple(a[:100] for a in enc)
+    with pytest.raises(ValueError, match="not divisible"):
+        shard_fast_check(eng._device_arrays, bad, mesh)
+
+
+def test_graph_sharded_parity_with_cross_shard_edges():
+    eng, graph, queries, enc, want = _engine_and_queries(
+        128, n_users=64, n_groups=8, n_folders=32, n_docs=128
+    )
+    n = 8
+    mesh = make_mesh(n, axis="shard")
+    snaps, stacked = build_sharded_snapshot(
+        graph.store, graph.manager, n, eng._vocab
+    )
+    # the workload must actually cross shards for this test to mean anything
+    v = eng._vocab
+    crossings = 0
+    for t in graph.store.all_tuples():
+        from ketotpu.api.types import SubjectSet
+
+        if isinstance(t.subject, SubjectSet):
+            src = shard_of_np(
+                np.array([v.namespaces.lookup(t.namespace)]),
+                np.array([v.objects.lookup(t.object)]), n,
+            )[0]
+            dst = shard_of_np(
+                np.array([v.namespaces.lookup(t.subject.namespace)]),
+                np.array([v.objects.lookup(t.subject.object)]), n,
+            )[0]
+            crossings += int(src != dst)
+    assert crossings > 50, f"only {crossings} cross-shard subject-set edges"
+
+    res = sharded_check(stacked, enc, mesh, frontier=1024, arena=4096)
+    got = np.asarray(res.found).tolist()
+    over = np.asarray(res.over)
+    assert not over.any()
+    assert got == want
+
+    # per-shard graph memory actually drops: each shard holds a fraction
+    total = sum(s.n_tuples for s in snaps)
+    assert total == len(graph.store)
+    assert max(s.n_tuples for s in snaps) < len(graph.store) // 2
+
+
+def test_graph_sharded_overflow_is_monotone():
+    """Tiny capacities: overflow may void unfound queries, never found ones."""
+    eng, graph, queries, enc, want = _engine_and_queries(
+        64, n_users=64, n_groups=8, n_folders=64, n_docs=256
+    )
+    n = 8
+    mesh = make_mesh(n, axis="shard")
+    _, stacked = build_sharded_snapshot(graph.store, graph.manager, n, eng._vocab)
+    res = sharded_check(stacked, enc, mesh, frontier=64, arena=128)
+    got = np.asarray(res.found)
+    over = np.asarray(res.over)
+    for i, w in enumerate(want):
+        if got[i]:
+            assert w, f"query {i}: sharded IS but oracle NOT"
+        elif not over[i]:
+            assert got[i] == w, f"query {i}: clean NOT diverges"
+
+
+def test_shard_batch_check_general_path():
+    """The round-1 task-tree interpreter still runs data-parallel (AND/NOT)."""
+    store = InMemoryTupleStore()
+    store.write_relation_tuples(
+        *[T(f"d:o{i}#editors@u{i % 4}") for i in range(16)],
+        *[T(f"d:o{i}#signers@u{i % 3}") for i in range(16)],
+    )
+    from ketotpu.opl.parser import parse
+    from ketotpu.storage import StaticNamespaceManager
+
+    opl = """
+import { Namespace, Context } from "@ory/keto-namespace-types"
+class User implements Namespace {}
+class d implements Namespace {
+  related: { editors: User[], signers: User[] }
+  permits = {
+    finalize: (ctx: Context): boolean =>
+      this.related.editors.includes(ctx.subject) &&
+      this.related.signers.includes(ctx.subject),
+  }
+}
+"""
+    namespaces, errs = parse(opl)
+    assert not errs
+    nsm = StaticNamespaceManager(namespaces)
+    eng = DeviceCheckEngine(store, nsm, frontier=512, arena=1024,
+                            cap=2048, gen_arena=2048, vcap=1024)
+    eng.snapshot()
+    queries = [T(f"d:o{i}#finalize@u{i % 5}") for i in range(16)]
+    enc = tuple(np.asarray(a) for a in eng._encode(queries, 0))
+    mesh = make_mesh(8)
+    res = shard_batch_check(
+        eng._device_arrays, enc, mesh, cap=2048, arena=2048, vcap=1024
+    )
+    got = (np.asarray(res.result) == 1).tolist()
+    over = np.asarray(res.overflow)
+    want = [eng.oracle.check_is_member(r) for r in queries]
+    for i, w in enumerate(want):
+        if not over[i]:
+            assert got[i] == w
