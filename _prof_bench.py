@@ -1,0 +1,42 @@
+import time, numpy as np
+from ketotpu.engine.tpu import DeviceCheckEngine
+from ketotpu.utils.synth import build_synth, synth_queries
+import jax
+
+graph = build_synth(n_users=2000, n_groups=100, n_folders=2000, n_docs=20000, seed=0)
+eng = DeviceCheckEngine(graph.store, graph.manager, frontier=32768, arena=131072, max_batch=4096)
+t0=time.perf_counter(); eng.snapshot(); print("snapshot:", time.perf_counter()-t0)
+queries = synth_queries(graph, 4096*2, seed=2)
+b = queries[:4096]
+
+t0=time.perf_counter(); enc = eng._encode(b, 0); print("encode:", time.perf_counter()-t0)
+snap = eng.snapshot()
+err, general = eng._classify(snap, enc[0], enc[2])
+print("err:", err.sum(), "general:", general.sum(), "of", len(b))
+
+# fast path alone
+from ketotpu.engine import fastpath as fp
+q_ns,q_obj,q_rel,q_subj,q_depth = eng._pad(enc, len(b), 4096)
+fast_active = ~(err|general)
+for i in range(3):
+    t0=time.perf_counter()
+    res = fp.run_fast(eng._device_arrays, q_ns,q_obj,q_rel,q_subj,q_depth, fast_active,
+                      frontier=eng.frontier, arena=eng.arena, max_depth=eng.max_depth, max_width=eng.max_width)
+    jax.block_until_ready(res)
+    print("fast run", i, time.perf_counter()-t0)
+
+# general path if any
+if general.any():
+    from ketotpu.engine import device as dev
+    gi = np.flatnonzero(general)
+    gpad = 1
+    while gpad < len(gi): gpad *= 2
+    gpad = max(gpad, 32)
+    genc = eng._pad(tuple(a[gi] for a in enc), len(gi), gpad)
+    for i in range(2):
+        t0=time.perf_counter()
+        gres = dev.run_batch(eng._device_arrays, *genc, cap=eng.cap, arena=eng.gen_arena,
+                             vcap=eng.vcap, max_iters=eng.max_iters, max_width=eng.max_width, strict=eng.strict_mode)
+        print("general run", i, len(gi), "queries:", time.perf_counter()-t0)
+
+t0=time.perf_counter(); out = eng.batch_check(b); print("full batch_check:", time.perf_counter()-t0)

@@ -1,0 +1,135 @@
+"""Protobuf codec for the public API types (`ketoapi/enc_proto.go` parity).
+
+Converts between the dataclasses of `ketotpu.api.types` and the generated
+messages of the vendored wire contract.  Mirrors the reference's
+`RelationTuple.{FromDataProvider,ToProto,FromProto}` (`enc_proto.go:28-82`),
+`RelationQuery.{FromDataProvider,ToProto}` (`enc_proto.go:84-118`), and
+`Tree.ToProto`/`TreeFromProto` (`enc_proto.go:120-165`) including the
+deprecated `SubjectTree.subject` backwards-compat field.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ketotpu.api.types import (
+    ErrNilSubject,
+    RelationQuery,
+    RelationTuple,
+    Subject,
+    SubjectID,
+    SubjectSet,
+    Tree,
+    TreeNodeType,
+)
+from ketotpu.proto import relation_tuples_pb2 as rts
+
+
+def subject_to_proto(s: Subject) -> rts.Subject:
+    if isinstance(s, SubjectID):
+        return rts.Subject(id=s.id)
+    return rts.Subject(
+        set=rts.SubjectSet(namespace=s.namespace, object=s.object, relation=s.relation)
+    )
+
+
+def subject_from_proto(p: Optional[rts.Subject]) -> Optional[Subject]:
+    if p is None:
+        return None
+    which = p.WhichOneof("ref")
+    if which == "id":
+        return SubjectID(id=p.id)
+    if which == "set":
+        return SubjectSet(
+            namespace=p.set.namespace, object=p.set.object, relation=p.set.relation
+        )
+    return None  # nil subject (enc_proto.go:30-31)
+
+
+def tuple_to_proto(r: RelationTuple) -> rts.RelationTuple:
+    return rts.RelationTuple(
+        namespace=r.namespace,
+        object=r.object,
+        relation=r.relation,
+        subject=subject_to_proto(r.subject),
+    )
+
+
+def tuple_from_proto(p) -> RelationTuple:
+    """From any TupleData-shaped message (RelationTuple, CheckRequest legacy
+    fields — anything with namespace/object/relation/subject getters,
+    `enc_proto.go:14-47`).  Raises the nil-subject error like the reference."""
+    subject = subject_from_proto(p.subject if p.HasField("subject") else None)
+    if subject is None:
+        raise ErrNilSubject()
+    return RelationTuple(
+        namespace=p.namespace, object=p.object, relation=p.relation, subject=subject
+    )
+
+
+def query_to_proto(q: RelationQuery) -> rts.RelationQuery:
+    res = rts.RelationQuery()
+    if q.namespace is not None:
+        res.namespace = q.namespace
+    if q.object is not None:
+        res.object = q.object
+    if q.relation is not None:
+        res.relation = q.relation
+    subj = q.subject()
+    if subj is not None:
+        res.subject.CopyFrom(subject_to_proto(subj))
+    return res
+
+
+def query_from_proto(p: rts.RelationQuery) -> RelationQuery:
+    rq = RelationQuery(
+        namespace=p.namespace if p.HasField("namespace") else None,
+        object=p.object if p.HasField("object") else None,
+        relation=p.relation if p.HasField("relation") else None,
+    )
+    if p.HasField("subject"):
+        rq.with_subject(subject_from_proto(p.subject))
+    return rq
+
+
+_NODE_TO_PROTO = {
+    TreeNodeType.LEAF: rts.NodeType.NODE_TYPE_LEAF,
+    TreeNodeType.UNION: rts.NodeType.NODE_TYPE_UNION,
+    TreeNodeType.EXCLUSION: rts.NodeType.NODE_TYPE_EXCLUSION,
+    TreeNodeType.INTERSECTION: rts.NodeType.NODE_TYPE_INTERSECTION,
+}
+_NODE_FROM_PROTO = {v: k for k, v in _NODE_TO_PROTO.items()}
+
+
+def node_type_to_proto(t: TreeNodeType) -> int:
+    # extended node types (TTU/CSS/NOT) have no proto value: UNSPECIFIED,
+    # exactly like enc_proto.go:167-179
+    return _NODE_TO_PROTO.get(t, rts.NodeType.NODE_TYPE_UNSPECIFIED)
+
+
+def node_type_from_proto(p: int) -> TreeNodeType:
+    return _NODE_FROM_PROTO.get(p, TreeNodeType.UNSPECIFIED)
+
+
+def tree_to_proto(t: Tree) -> rts.SubjectTree:
+    res = rts.SubjectTree(node_type=node_type_to_proto(t.type))
+    if t.tuple is not None:
+        res.tuple.CopyFrom(tuple_to_proto(t.tuple))
+        # deprecated backwards-compat subject field (enc_proto.go:129-131)
+        res.subject.CopyFrom(res.tuple.subject)
+    for c in t.children:
+        res.children.append(tree_to_proto(c))
+    return res
+
+
+def tree_from_proto(p: rts.SubjectTree) -> Tree:
+    t = Tree(type=node_type_from_proto(p.node_type))
+    if p.HasField("tuple"):
+        t.tuple = tuple_from_proto(p.tuple)
+    elif p.HasField("subject"):
+        # legacy subject-only tree (enc_proto.go:141-153)
+        subj = subject_from_proto(p.subject)
+        if subj is not None:
+            t.tuple = RelationTuple("", "", "", subj)
+    t.children = [tree_from_proto(c) for c in p.children]
+    return t

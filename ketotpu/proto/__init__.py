@@ -1,0 +1,27 @@
+"""Generated protobuf bindings for the vendored Keto wire contract.
+
+The ``proto/`` tree at the repo root vendors the reference's `.proto` files
+unchanged (SURVEY §7 step 1; `proto/ory/keto/relation_tuples/v1alpha2/
+check_service.proto:18-21` etc.); `protoc --python_out` regenerates this
+package (see scripts/gen_proto.sh).  The generated modules import each other
+through the absolute ``ory.keto...`` package path protoc emits, so this
+package root goes on ``sys.path``.
+"""
+
+import os
+import sys
+
+_HERE = os.path.dirname(__file__)
+if _HERE not in sys.path:
+    sys.path.insert(0, _HERE)
+
+from ory.keto.opl.v1alpha1 import syntax_service_pb2  # noqa: E402,F401
+from ory.keto.relation_tuples.v1alpha2 import (  # noqa: E402,F401
+    check_service_pb2,
+    expand_service_pb2,
+    namespaces_service_pb2,
+    read_service_pb2,
+    relation_tuples_pb2,
+    version_pb2,
+    write_service_pb2,
+)

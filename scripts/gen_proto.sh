@@ -1,0 +1,8 @@
+#!/bin/sh
+# Regenerate Python protobuf bindings from the vendored wire contract.
+set -e
+cd "$(dirname "$0")/.."
+protoc -Iproto -I/usr/include \
+  --python_out=ketotpu/proto \
+  proto/ory/keto/relation_tuples/v1alpha2/*.proto \
+  proto/ory/keto/opl/v1alpha1/*.proto
