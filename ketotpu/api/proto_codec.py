@@ -22,6 +22,7 @@ from ketotpu.api.types import (
     Tree,
     TreeNodeType,
 )
+from ketotpu.proto import expand_service_pb2 as es
 from ketotpu.proto import relation_tuples_pb2 as rts
 
 
@@ -93,10 +94,10 @@ def query_from_proto(p: rts.RelationQuery) -> RelationQuery:
 
 
 _NODE_TO_PROTO = {
-    TreeNodeType.LEAF: rts.NodeType.NODE_TYPE_LEAF,
-    TreeNodeType.UNION: rts.NodeType.NODE_TYPE_UNION,
-    TreeNodeType.EXCLUSION: rts.NodeType.NODE_TYPE_EXCLUSION,
-    TreeNodeType.INTERSECTION: rts.NodeType.NODE_TYPE_INTERSECTION,
+    TreeNodeType.LEAF: es.NodeType.NODE_TYPE_LEAF,
+    TreeNodeType.UNION: es.NodeType.NODE_TYPE_UNION,
+    TreeNodeType.EXCLUSION: es.NodeType.NODE_TYPE_EXCLUSION,
+    TreeNodeType.INTERSECTION: es.NodeType.NODE_TYPE_INTERSECTION,
 }
 _NODE_FROM_PROTO = {v: k for k, v in _NODE_TO_PROTO.items()}
 
@@ -104,15 +105,15 @@ _NODE_FROM_PROTO = {v: k for k, v in _NODE_TO_PROTO.items()}
 def node_type_to_proto(t: TreeNodeType) -> int:
     # extended node types (TTU/CSS/NOT) have no proto value: UNSPECIFIED,
     # exactly like enc_proto.go:167-179
-    return _NODE_TO_PROTO.get(t, rts.NodeType.NODE_TYPE_UNSPECIFIED)
+    return _NODE_TO_PROTO.get(t, es.NodeType.NODE_TYPE_UNSPECIFIED)
 
 
 def node_type_from_proto(p: int) -> TreeNodeType:
     return _NODE_FROM_PROTO.get(p, TreeNodeType.UNSPECIFIED)
 
 
-def tree_to_proto(t: Tree) -> rts.SubjectTree:
-    res = rts.SubjectTree(node_type=node_type_to_proto(t.type))
+def tree_to_proto(t: Tree) -> es.SubjectTree:
+    res = es.SubjectTree(node_type=node_type_to_proto(t.type))
     if t.tuple is not None:
         res.tuple.CopyFrom(tuple_to_proto(t.tuple))
         # deprecated backwards-compat subject field (enc_proto.go:129-131)
@@ -122,7 +123,7 @@ def tree_to_proto(t: Tree) -> rts.SubjectTree:
     return res
 
 
-def tree_from_proto(p: rts.SubjectTree) -> Tree:
+def tree_from_proto(p: es.SubjectTree) -> Tree:
     t = Tree(type=node_type_from_proto(p.node_type))
     if p.HasField("tuple"):
         t.tuple = tuple_from_proto(p.tuple)
