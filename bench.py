@@ -26,8 +26,8 @@ import time
 import numpy as np
 
 BASELINE_NS_PER_OP = 81_280  # reference benchtest.new.txt:5
-BATCH = 4096
-ROUNDS = 8
+BATCH = 16384
+ROUNDS = 4
 
 
 def main() -> None:
@@ -40,8 +40,8 @@ def main() -> None:
     eng = DeviceCheckEngine(
         graph.store,
         graph.manager,
-        frontier=32768,
-        arena=131072,
+        frontier=6 * BATCH,
+        arena=12 * BATCH,
         max_batch=BATCH,
     )
     eng.snapshot()
@@ -76,6 +76,8 @@ def main() -> None:
                 "batch": BATCH,
                 "tuples": len(graph.store),
                 "device_fallback_rate": round(fallback_rate, 5),
+                "device_retries": eng.retries,
+                "oracle_fallbacks": eng.fallbacks,
                 "p50_batch_ms": round(1000 * sorted(times)[len(times) // 2], 1),
             }
         )

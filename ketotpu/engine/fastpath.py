@@ -154,6 +154,7 @@ def expand_phase(
     arena: int,
     max_width: int,
     sharded: bool = False,
+    probe_only: bool = False,
 ) -> Tuple[Dict[str, jax.Array], jax.Array, jax.Array]:
     """Probes + child construction.  Returns (children[A] cols + alive, found, over)."""
     A = arena
@@ -197,6 +198,26 @@ def expand_phase(
 
     q_found = q_found.at[qc].max(found)
     live2 = live & ~q_found[qc]
+
+    if probe_only:
+        # Probe-only level: the caller guarantees every item has d <= 1
+        # (only _run_fused's final level qualifies — depth strictly
+        # decreases per level and roots are clamped to the level count),
+        # so no child segment can be non-empty — skip the whole arena
+        # machinery and return an empty child set.  This must be an
+        # explicit flag, NOT inferred from a small arena: a legitimately
+        # tiny arena still needs the child path so capacity misses set
+        # q_over instead of silently dropping children.
+        empty = dict(
+            qid=jnp.full((A,), -1, jnp.int32),
+            ns=jnp.full((A,), -1, jnp.int32),
+            obj=jnp.full((A,), -1, jnp.int32),
+            rel=jnp.full((A,), -1, jnp.int32),
+            d=jnp.zeros((A,), jnp.int32),
+            skip=jnp.zeros((A,), bool),
+            force=jnp.zeros((A,), bool),
+        )
+        return empty, q_found, q_over
 
     # -- per-item child segments: [expansion | css 0..Kc | ttu 0..Kt] -------
     # expansion runs at depth-1 with a <=0 guard (engine.go:245,:102-110);
@@ -335,52 +356,125 @@ def pack_phase(
     the survivors into the next frontier.  Returns (frontier cols, q_over).
 
     When (qid, ns, rel) fit one int32 (pass ``ns_dim``/``rel_dim``, the
-    padded table dims), the sort runs on 2 packed keys + 1 packed payload
-    word instead of 4 keys + 3 payloads — the sort is the arena-sized cost
-    of the whole level, so fewer operands is a direct win.
+    padded table dims), dedup runs as **linear hash-scatter merge** instead
+    of a sort: every alive child scatters into a 2A-slot hash table; the
+    max-index child per slot becomes the slot *owner*, all children whose
+    key equals the owner's key merge elementwise into the owner
+    (max depth / min skip / max force — the merged item's exploration
+    supersets every contributor's), and hash-colliding children of *other*
+    keys simply pass through unmerged (capacity waste, never a drop).
+    Compaction is a prefix-sum scatter.  This replaces the arena-sized
+    multi-operand sort that dominated per-level device time; the sort path
+    remains as the fallback when the key does not pack into an int32.
     """
+    qb = _pack_bits(q_found.shape[0])
+    nsb = _pack_bits(ns_dim) if ns_dim else 31
+    relb = _pack_bits(rel_dim) if rel_dim else 31
+    if qb + nsb + relb <= 31:
+        return _pack_scatter(
+            children, q_found, q_over, frontier=frontier, nsb=nsb, relb=relb
+        )
+    return _pack_sort(children, q_found, q_over, frontier=frontier)
+
+
+def _pack_scatter(
+    children: Dict[str, jax.Array],
+    q_found: jax.Array,
+    q_over: jax.Array,
+    *,
+    frontier: int,
+    nsb: int,
+    relb: int,
+) -> Tuple[Dict[str, jax.Array], jax.Array]:
+    F = frontier
+    Q = q_found.shape[0]
+    A = children["qid"].shape[0]
+    H = 1 << max((2 * A - 1).bit_length(), 4)
+    alive = (children["qid"] >= 0) & ~q_found[jnp.clip(children["qid"], 0, Q - 1)]
+    k1 = (
+        (children["qid"] << (nsb + relb)) | (children["ns"] << relb) | children["rel"]
+    )
+    k2 = children["obj"]
+    idx = jnp.arange(A, dtype=jnp.int32)
+    h = (
+        hashtab.mix_device(k1, k2, jnp.uint32(0x9E3779B9)) & jnp.uint32(H - 1)
+    ).astype(jnp.int32)
+    hs = jnp.where(alive, h, H)  # dead children scatter out of bounds
+    own = jnp.full((H,), -1, jnp.int32).at[hs].max(idx, mode="drop")
+    owner = own[jnp.clip(h, 0, H - 1)]
+    oc = jnp.clip(owner, 0, A - 1)
+    same = alive & (k1[oc] == k1) & (k2[oc] == k2)
+    ms = jnp.where(same, h, H)  # merge scatters: same-key group only
+    d_tab = jnp.full((H,), -1, jnp.int32).at[ms].max(children["d"], mode="drop")
+    skip_tab = (
+        jnp.ones((H,), jnp.int32)
+        .at[ms]
+        .min(children["skip"].astype(jnp.int32), mode="drop")
+    )
+    force_tab = (
+        jnp.zeros((H,), jnp.int32)
+        .at[ms]
+        .max(children["force"].astype(jnp.int32), mode="drop")
+    )
+    is_owner = alive & (owner == idx)
+    survivor = is_owner | (alive & ~same)
+    hc = jnp.clip(h, 0, H - 1)
+    d_out = jnp.where(is_owner, d_tab[hc], children["d"])
+    skip_out = jnp.where(is_owner, skip_tab[hc].astype(bool), children["skip"])
+    force_out = jnp.where(is_owner, force_tab[hc].astype(bool), children["force"])
+
+    pos = jnp.cumsum(survivor.astype(jnp.int32)) - 1
+    drop = survivor & (pos >= F)
+    oq = jnp.where(drop, children["qid"], Q)
+    q_over = q_over.at[jnp.clip(oq, 0, Q - 1)].max(drop & (oq < Q))
+    spos = jnp.where(survivor & (pos < F), pos, F)
+
+    def scat(fill, val):
+        return jnp.full((F,), fill, val.dtype).at[spos].set(val, mode="drop")
+
+    out = dict(
+        f_qid=scat(-1, jnp.where(survivor, children["qid"], -1)),
+        f_ns=scat(-1, children["ns"]),
+        f_obj=scat(-1, children["obj"]),
+        f_rel=scat(-1, children["rel"]),
+        f_depth=scat(0, d_out),
+        f_skip=scat(False, skip_out),
+        f_force=scat(False, force_out),
+    )
+    return out, q_over
+
+
+def _pack_sort(
+    children: Dict[str, jax.Array],
+    q_found: jax.Array,
+    q_over: jax.Array,
+    *,
+    frontier: int,
+) -> Tuple[Dict[str, jax.Array], jax.Array]:
+    """Sort-based dedup/compaction (exact group merge, any key width)."""
     F = frontier
     Q = q_found.shape[0]
     A = children["qid"].shape[0]
     alive = (children["qid"] >= 0) & ~q_found[jnp.clip(children["qid"], 0, Q - 1)]
 
-    nsb = _pack_bits(ns_dim) if ns_dim else 31
-    relb = _pack_bits(rel_dim) if rel_dim else 31
-    qb = _pack_bits(Q)
     payload = (
         (children["d"] << 2)
         | (children["skip"].astype(jnp.int32) << 1)
         | children["force"].astype(jnp.int32)
     )
-    if qb + nsb + relb <= 31:
-        k1 = jnp.where(
-            alive,
-            (children["qid"] << (nsb + relb)) | (children["ns"] << relb)
-            | children["rel"],
-            _I32MAX,
-        )
-        k2 = jnp.where(alive, children["obj"], _I32MAX)
-        sk1, sk2, s_pay = jax.lax.sort((k1, k2, payload), num_keys=2)
-        valid = sk1 != _I32MAX
-        same_prev = (sk1 == jnp.roll(sk1, 1)) & (sk2 == jnp.roll(sk2, 1))
-        o_qid = jnp.where(valid, sk1 >> (nsb + relb), -1)
-        o_ns = jnp.where(valid, (sk1 >> relb) & ((1 << nsb) - 1), -1)
-        o_rel = jnp.where(valid, sk1 & ((1 << relb) - 1), -1)
-        o_obj = sk2
-    else:
-        k3 = jnp.where(alive, children["ns"], _I32MAX)
-        k4 = jnp.where(alive, children["rel"], _I32MAX)
-        k1 = jnp.where(alive, children["qid"], _I32MAX)
-        k2 = jnp.where(alive, children["obj"], _I32MAX)
-        sk1, k3s, k4s, sk2, s_pay = jax.lax.sort((k1, k3, k4, k2, payload), num_keys=4)
-        valid = sk1 != _I32MAX
-        same_prev = (
-            (sk1 == jnp.roll(sk1, 1))
-            & (k3s == jnp.roll(k3s, 1))
-            & (k4s == jnp.roll(k4s, 1))
-            & (sk2 == jnp.roll(sk2, 1))
-        )
-        o_qid, o_ns, o_rel, o_obj = sk1, k3s, k4s, sk2
+    k3 = jnp.where(alive, children["ns"], _I32MAX)
+    k4 = jnp.where(alive, children["rel"], _I32MAX)
+    k1 = jnp.where(alive, children["qid"], _I32MAX)
+    k2 = jnp.where(alive, children["obj"], _I32MAX)
+    sk1, k3s, k4s, sk2, s_pay = jax.lax.sort((k1, k3, k4, k2, payload), num_keys=4)
+    valid = sk1 != _I32MAX
+    same_prev = (
+        (sk1 == jnp.roll(sk1, 1))
+        & (k3s == jnp.roll(k3s, 1))
+        & (k4s == jnp.roll(k4s, 1))
+        & (sk2 == jnp.roll(sk2, 1))
+    )
+    o_qid, o_ns, o_rel, o_obj = sk1, k3s, k4s, sk2
 
     s_d = s_pay >> 2
     s_skip = (s_pay >> 1) & 1
@@ -442,18 +536,39 @@ fast_step = functools.partial(
 )(step_impl)
 
 
+PROBE_ONLY_ARENA = 8  # arena <= this: level runs probes only, no children
+
+
 def level_schedule(
-    q: int, frontier: int, arena: int, max_depth: int
+    q: int, frontier: int, arena: int, max_depth: int, boost: int = 1
 ) -> Tuple[Tuple[int, int], ...]:
     """Per-level (frontier, arena) sizes: level 0 holds exactly the roots,
     later levels grow geometrically up to the configured caps.  Early levels
     are the common case (short-circuit kills most queries fast), so sizing
-    them to the work instead of the worst case is most of the win."""
+    them to the work instead of the worst case is most of the win.
+
+    Growth is tuned to measured frontier shapes (chains with a mid-walk
+    bulge dominate, not explosions: a deny-verdict query walks ~1-2 children
+    per item per level until its closure is exhausted).  Capacity misses
+    surface as per-query ``over`` bits and the engine retries just those
+    queries at wider caps (tpu.py) — far cheaper than sizing every batch for
+    the worst case.  The final level cannot produce live children (depth
+    strictly decreases and a child needs d >= 1), so it runs probe-only
+    with a token arena.
+
+    ``boost`` scales the demand-driven per-query term (m*q), not just the
+    caps: a retry tier must grow the capacity a query's own fan-out gets,
+    and when levels are q-bound rather than cap-bound, scaling only the
+    caps would change nothing.
+    """
+    f_mult = (1, 4, 5, 6, 6)
     out = []
-    f = q
-    for _ in range(max_depth):
-        out.append((min(f, frontier), min(max(4 * f, q), arena)))
-        f *= 4
+    for lvl in range(max_depth):
+        last = lvl == max_depth - 1
+        m = f_mult[min(lvl, len(f_mult) - 1)]
+        fl = min(boost * m * q, frontier)
+        a = 4 * fl if lvl == 0 else 2 * fl  # root fan-out exceeds chain growth
+        out.append((fl, PROBE_ONLY_ARENA if last else min(a, arena)))
     return tuple(out)
 
 
@@ -474,10 +589,16 @@ def _run_fused(
     s = _init_state(
         q_ns, q_obj, q_rel, q_subj, q_depth, act, frontier=schedule[0][0]
     )
+    # The final level is probe-only, which is sound only if its items have
+    # d <= 1; root depth <= #levels guarantees that (depth strictly
+    # decreases per level).  Callers pass rest_depth <= max_depth anyway
+    # (engine.go:82-84 global-cap precedence); clamp defensively.
+    s["f_depth"] = jnp.minimum(s["f_depth"], len(schedule))
     for i, (f, a) in enumerate(schedule):
         nxt_f = schedule[i + 1][0] if i + 1 < len(schedule) else 1
         children, q_found, q_over = expand_phase(
-            g, s, arena=a, max_width=max_width, sharded=False
+            g, s, arena=a, max_width=max_width, sharded=False,
+            probe_only=(i == len(schedule) - 1),
         )
         nxt, q_over = pack_phase(
             children, q_found, q_over, frontier=nxt_f, ns_dim=NS, rel_dim=R
@@ -499,15 +620,19 @@ def run_fast(
     arena: int = 32768,
     max_depth: int = 5,
     max_width: int = 100,
+    boost: int = 1,
 ) -> FastResult:
     """Run a batch to completion in a single fused device dispatch.
 
     Exactly ``max_depth`` levels — depth strictly decreases per level, so
     the frontier is provably empty afterwards; no early-exit sync needed.
+    ``boost`` widens the per-query capacity schedule (retry tiers).
     """
     Q = q_ns.shape[0]
+    if Q > frontier:
+        raise ValueError(f"batch {Q} exceeds frontier capacity {frontier}")
     act = np.ones((Q,), bool) if active is None else np.asarray(active, bool)
-    sched = level_schedule(Q, frontier, arena, max_depth)
+    sched = level_schedule(Q, frontier, arena, max_depth, boost)
     return _run_fused(
         g, q_ns, q_obj, q_rel, q_subj, q_depth, act,
         schedule=sched, max_width=max_width,

@@ -20,8 +20,16 @@ answers allow/deny.  Internally it
      (namespace/definitions.go:61): the oracle raises the reference's
      exact typed error;
 
-4. falls back to the sequential oracle only for queries the device could
-   not finish (overflow on a not-yet-found query, or an error verdict).
+4. retries fast-path queries that overflowed the lean tier-1 capacity
+   schedule on the device at ``retry_scale``x wider caps (the overflow
+   tail is a few % of a batch, so the fat retry batch is small), and only
+   then falls back to the sequential oracle (remaining overflow, or an
+   error verdict the oracle must reproduce as a typed exception).
+
+Chunks of a large batch are dispatched asynchronously back-to-back and
+collected afterwards, so device execution and the host's result reads
+overlap across chunks (one blocking sync per chunk costs real host-link
+latency — on a tunneled TPU ~100ms).
 
 `check()` is the single-query API; `batch_check()` is the throughput surface
 (the BatchCheck of BASELINE config #4 — the reference has no batch RPC at
@@ -87,6 +95,7 @@ class DeviceCheckEngine:
         vcap: int = 4096,
         max_iters: int = 64,
         max_batch: int = 8192,
+        retry_scale: int = 4,
     ):
         self.store = store
         self.namespace_manager = namespace_manager
@@ -111,7 +120,9 @@ class DeviceCheckEngine:
         self._snap: Optional[Snapshot] = None
         self._snap_fingerprint: Optional[int] = None
         self._device_arrays = None
+        self.retry_scale = retry_scale
         self.fallbacks = 0  # observability: host-fallback counter
+        self.retries = 0  # observability: device-retry (tier-2) counter
 
     # -- snapshot lifecycle -------------------------------------------------
 
@@ -182,12 +193,17 @@ class DeviceCheckEngine:
     def batch_check(
         self, queries: Sequence[RelationTuple], rest_depth: int = 0
     ) -> List[bool]:
-        out: List[bool] = []
         queries = list(queries)
-        for lo in range(0, len(queries), self.max_batch):
-            out.extend(
-                self._batch_check_chunk(queries[lo : lo + self.max_batch], rest_depth)
-            )
+        chunks = [
+            queries[lo : lo + self.max_batch]
+            for lo in range(0, len(queries), self.max_batch)
+        ]
+        # dispatch everything before syncing on anything: device executions
+        # queue back-to-back while the host reads earlier chunks' results
+        handles = [self._dispatch(c, rest_depth) for c in chunks]
+        out: List[bool] = []
+        for c, h in zip(chunks, handles):
+            out.extend(self._finish_chunk(c, h, rest_depth))
         return out
 
     def _pad(self, arrays, n: int, qpad: int):
@@ -199,33 +215,29 @@ class DeviceCheckEngine:
             for a, f in zip(arrays, fills)
         )
 
-    def _device_verdicts(self, queries: Sequence[RelationTuple], rest_depth: int):
-        """(allowed, fallback) bool arrays for one chunk, no oracle calls."""
+    def _dispatch(self, queries: Sequence[RelationTuple], rest_depth: int):
+        """Enqueue one chunk's device work; returns an uncollected handle."""
         n = len(queries)
+        if n == 0:
+            return None
         snap = self.snapshot()
         enc = self._encode(queries, rest_depth)
         err, general = self._classify(snap, enc[0], enc[2])
-        qpad = _bucket(n)
-        q_ns, q_obj, q_rel, q_subj, q_depth = self._pad(enc, n, qpad)
-
-        allowed = np.zeros(n, bool)
-        fallback = err.copy()
-
+        # pad for compile-cache reuse, but never beyond the frontier cap
+        # (max_batch <= frontier guarantees n fits)
+        qpad = min(_bucket(n), self.frontier)
+        padded = self._pad(enc, n, qpad)
         fast_active = np.pad(~(err | general), (0, qpad - n))
         res = fp.run_fast(
             self._device_arrays,
-            q_ns,
-            q_obj,
-            q_rel,
-            q_subj,
-            q_depth,
+            *padded,
             fast_active,
             frontier=self.frontier,
             arena=self.arena,
             max_depth=self.max_depth,
             max_width=self.max_width,
         )
-
+        gres = gi = None
         if general.any():
             gi = np.flatnonzero(general)
             gpad = _bucket(len(gi), 32)
@@ -240,6 +252,17 @@ class DeviceCheckEngine:
                 max_width=self.max_width,
                 strict=self.strict_mode,
             )
+        return (enc, err, general, res, gi, gres)
+
+    def _collect(self, handle, retry: bool = True):
+        """Sync one chunk's results; device-retry the fast-path overflow
+        tail at ``retry_scale``x caps.  Returns (allowed, fallback)."""
+        enc, err, general, res, gi, gres = handle
+        n = err.shape[0]
+        allowed = np.zeros(n, bool)
+        fallback = err.copy()
+
+        if gres is not None:
             codes = np.asarray(gres.result)[: len(gi)]
             gover = np.asarray(gres.overflow)[: len(gi)]
             allowed[gi] = codes == dev.R_IS
@@ -250,15 +273,38 @@ class DeviceCheckEngine:
         fmask = ~(err | general)
         allowed[fmask] = found[fmask]
         # found is monotone: an overflow only voids not-yet-found queries
-        fallback[fmask] |= over[fmask] & ~found[fmask]
+        unres = fmask & over & ~found
+        if retry and unres.any() and self.retry_scale > 1:
+            ri = np.flatnonzero(unres)
+            rpad = min(_bucket(len(ri), 256), self.retry_scale * self.frontier)
+            renc = self._pad(tuple(a[ri] for a in enc), len(ri), rpad)
+            self.retries += len(ri)
+            rres = fp.run_fast(
+                self._device_arrays,
+                *renc,
+                np.arange(rpad) < len(ri),
+                frontier=self.retry_scale * self.frontier,
+                arena=self.retry_scale * self.arena,
+                max_depth=self.max_depth,
+                max_width=self.max_width,
+                # scale the per-query schedule too: the tail queries need
+                # retry_scale x the capacity their tier-1 share gave them,
+                # and with a small retry batch the caps alone don't bind
+                boost=self.retry_scale,
+            )
+            rfound = np.asarray(rres.found)[: len(ri)]
+            rover = np.asarray(rres.over)[: len(ri)]
+            allowed[ri] = rfound
+            unres[ri] = rover & ~rfound
+        fallback |= unres
         return allowed, fallback
 
-    def _batch_check_chunk(
-        self, queries: Sequence[RelationTuple], rest_depth: int
+    def _finish_chunk(
+        self, queries: Sequence[RelationTuple], handle, rest_depth: int
     ) -> List[bool]:
-        if not queries:
+        if handle is None:
             return []
-        allowed, fallback = self._device_verdicts(queries, rest_depth)
+        allowed, fallback = self._collect(handle)
         if fallback.any():
             for i in np.flatnonzero(fallback):
                 # oracle reproduces the exact verdict or typed error
@@ -267,9 +313,12 @@ class DeviceCheckEngine:
         return allowed.tolist()
 
     def batch_check_device_only(
-        self, queries: Sequence[RelationTuple], rest_depth: int = 0
+        self, queries: Sequence[RelationTuple], rest_depth: int = 0, retry: bool = True
     ):
-        """Device verdicts without fallback: (allowed[], fallback_needed[]).
+        """Device verdicts without oracle fallback: (allowed[], fallback_needed[]).
         Test/diagnostic surface."""
-        allowed, fallback = self._device_verdicts(queries, rest_depth)
+        handle = self._dispatch(list(queries), rest_depth)
+        if handle is None:
+            return [], []
+        allowed, fallback = self._collect(handle, retry=retry)
         return allowed.tolist(), fallback.tolist()

@@ -96,15 +96,16 @@ def arena_assign(counts: jax.Array, arena_size: int) -> Tuple[jax.Array, jax.Arr
     total = jnp.sum(counts)
     j = jnp.arange(arena_size, dtype=jnp.int32)
     # parent[j] = last t with offsets[t] <= j (only among counts>0 rows).
-    # searchsorted over "starts of occupied ranges": use offsets where count>0
-    # else a sentinel beyond the arena so empty tasks never win.
-    starts = jnp.where(counts > 0, offsets, jnp.iinfo(jnp.int32).max)
-    order = jnp.argsort(starts)
-    sorted_starts = starts[order]
-    pos = jnp.searchsorted(sorted_starts, j, side="right") - 1
-    parent = jnp.where(
-        (j < total) & (pos >= 0), order[jnp.clip(pos, 0, counts.shape[0] - 1)], -1
-    ).astype(jnp.int32)
+    # Occupied ranges have strictly increasing starts, so scattering each
+    # task index at its range start and forward-filling with a running max
+    # recovers the owner of every slot — linear scatter+scan instead of the
+    # argsort+searchsorted this used to do (the sort was the level cost).
+    t = jnp.arange(counts.shape[0], dtype=jnp.int32)
+    mark = jnp.full((arena_size,), -1, jnp.int32).at[
+        jnp.where(counts > 0, offsets, arena_size)
+    ].max(t, mode="drop")
+    parent = jax.lax.associative_scan(jnp.maximum, mark)
+    parent = jnp.where(j < total, parent, -1)
     safe_parent = jnp.clip(parent, 0, counts.shape[0] - 1)
     ordinal = jnp.where(parent >= 0, j - offsets[safe_parent], 0).astype(jnp.int32)
     return offsets.astype(jnp.int32), total.astype(jnp.int32), parent, ordinal
