@@ -1,0 +1,230 @@
+"""Tuple-level Mapper: public string tuples ⇄ internal UUID-typed tuples.
+
+Parity with `internal/relationtuple/uuid_mapping.go`:
+
+* ``from_tuple`` (`uuid_mapping.go:199-267`) — validates each tuple,
+  resolves its namespace (and a subject-set's namespace) through the
+  namespace manager — an unknown namespace raises ``NotFoundError``, which
+  the REST check handler swallows into ``allowed=false``
+  (`internal/check/handler.go:169-171`) while gRPC propagates it — and maps
+  object / subject strings to UUIDv5 in one batched call;
+* ``from_query`` (`uuid_mapping.go:69-148`) — the partial-fields variant
+  for list/delete queries;
+* ``to_tuple`` / ``to_query`` (`uuid_mapping.go:269-345`) — reverse mapping
+  with one batched UUID→string lookup;
+* ``to_tree`` (`uuid_mapping.go:347-399`) — recursive tree re-labelling.
+
+Internally the engine interns strings to dense int32 ids (engine/vocab.py);
+this layer exists for wire parity: the reference's SQL schema stores UUIDs
+and its SDKs round-trip them, so an embedder migrating storage sees the
+same deterministic UUIDv5 values (uuid5(network_id, value),
+`sql/uuid_mapping.go:35-74`).
+"""
+
+from __future__ import annotations
+
+import uuid
+from dataclasses import dataclass
+from typing import List, Optional, Union
+
+from ketotpu.api.types import (
+    ErrNilSubject,
+    RelationQuery,
+    RelationTuple,
+    SubjectID,
+    SubjectSet,
+    Tree,
+)
+from ketotpu.api.uuid_map import UUIDMapper
+from ketotpu.storage.namespaces import NamespaceManager
+
+
+@dataclass(frozen=True)
+class InternalSubjectID:
+    """`internal/relationtuple/definitions.go:34` — UUID-typed subject."""
+
+    id: uuid.UUID
+
+
+@dataclass(frozen=True)
+class InternalSubjectSet:
+    """`internal/relationtuple/definitions.go:61` — UUID-typed subject set."""
+
+    namespace: str
+    object: uuid.UUID
+    relation: str
+
+
+InternalSubject = Union[InternalSubjectID, InternalSubjectSet]
+
+
+@dataclass(frozen=True)
+class InternalRelationTuple:
+    """UUID-typed tuple (`internal/relationtuple/definitions.go:81-96`):
+    namespaces and relations stay strings, objects and subjects are UUIDs."""
+
+    namespace: str
+    object: uuid.UUID
+    relation: str
+    subject: InternalSubject
+
+
+@dataclass(frozen=True)
+class InternalRelationQuery:
+    namespace: Optional[str] = None
+    object: Optional[uuid.UUID] = None
+    relation: Optional[str] = None
+    subject: Optional[InternalSubject] = None
+
+
+class Mapper:
+    """String⇄UUID tuple mapping with namespace resolution."""
+
+    def __init__(self, uuid_mapper: UUIDMapper, namespace_manager: NamespaceManager):
+        self.uuids = uuid_mapper
+        self.namespaces = namespace_manager
+
+    # -- forward ------------------------------------------------------------
+
+    def from_tuple(
+        self, *tuples: RelationTuple
+    ) -> List[InternalRelationTuple]:
+        """Batched strings→UUIDs; raises NotFoundError on unknown namespaces
+        (tuple or subject-set), BadRequestError on invalid tuples."""
+        strings: List[str] = []
+        build = []
+        for t in tuples:
+            if t.subject is None:
+                raise ErrNilSubject()
+            ns = self.namespaces.get_namespace(t.namespace)
+            if isinstance(t.subject, SubjectSet):
+                sns = self.namespaces.get_namespace(t.subject.namespace)
+                si = len(strings)
+                strings.append(t.subject.object)
+                subj_build = ("set", sns.name, si, t.subject.relation)
+            else:
+                si = len(strings)
+                strings.append(t.subject.id)
+                subj_build = ("id", None, si, None)
+            oi = len(strings)
+            strings.append(t.object)
+            build.append((ns.name, t.relation, oi, subj_build))
+        mapped = self.uuids.to_uuids(strings)
+        out = []
+        for ns_name, relation, oi, (kind, sns_name, si, srel) in build:
+            subject: InternalSubject
+            if kind == "set":
+                subject = InternalSubjectSet(sns_name, mapped[si], srel)
+            else:
+                subject = InternalSubjectID(mapped[si])
+            out.append(
+                InternalRelationTuple(ns_name, mapped[oi], relation, subject)
+            )
+        return out
+
+    def from_query(self, q: RelationQuery) -> InternalRelationQuery:
+        strings: List[str] = []
+        obj_i = subj_i = None
+        ns_name = None
+        if q.namespace is not None:
+            ns_name = self.namespaces.get_namespace(q.namespace).name
+        if q.object is not None:
+            obj_i = len(strings)
+            strings.append(q.object)
+        subj = q.subject()
+        s_meta = None
+        if isinstance(subj, SubjectSet):
+            sns = self.namespaces.get_namespace(subj.namespace).name
+            subj_i = len(strings)
+            strings.append(subj.object)
+            s_meta = ("set", sns, subj.relation)
+        elif isinstance(subj, SubjectID):
+            subj_i = len(strings)
+            strings.append(subj.id)
+            s_meta = ("id", None, None)
+        mapped = self.uuids.to_uuids(strings)
+        subject: Optional[InternalSubject] = None
+        if s_meta is not None:
+            kind, sns, srel = s_meta
+            subject = (
+                InternalSubjectSet(sns, mapped[subj_i], srel)
+                if kind == "set"
+                else InternalSubjectID(mapped[subj_i])
+            )
+        return InternalRelationQuery(
+            namespace=ns_name,
+            object=None if obj_i is None else mapped[obj_i],
+            relation=q.relation,
+            subject=subject,
+        )
+
+    def from_subject_set(self, s: SubjectSet) -> InternalSubjectSet:
+        ns = self.namespaces.get_namespace(s.namespace)
+        (obj,) = self.uuids.to_uuids([s.object])
+        return InternalSubjectSet(ns.name, obj, s.relation)
+
+    # -- reverse ------------------------------------------------------------
+
+    def _resolve(self, u: uuid.UUID) -> str:
+        s = self.uuids.from_uuid(u)
+        if s is None:
+            # parity: unresolvable UUIDs surface as their string form, the
+            # behavior of a missing keto_uuid_mappings row
+            return str(u)
+        return s
+
+    def to_tuple(
+        self, *tuples: InternalRelationTuple
+    ) -> List[RelationTuple]:
+        out = []
+        for t in tuples:
+            if isinstance(t.subject, InternalSubjectSet):
+                subject = SubjectSet(
+                    t.subject.namespace,
+                    self._resolve(t.subject.object),
+                    t.subject.relation,
+                )
+            else:
+                subject = SubjectID(self._resolve(t.subject.id))
+            out.append(
+                RelationTuple(
+                    t.namespace, self._resolve(t.object), t.relation, subject
+                )
+            )
+        return out
+
+    def to_tree(self, tree: Optional[Tree]) -> Optional[Tree]:
+        """Re-label a UUID-keyed tree with strings (uuid_mapping.go:347-399).
+
+        The expand engine in this framework already produces string trees;
+        this is the seam kept for embedders that run the internal UUID
+        representation end to end: any tuple field that parses as a UUID is
+        resolved through the reverse store, everything else passes through.
+        """
+        if tree is None:
+            return None
+        t = tree.tuple
+        if t is not None:
+            obj = self._maybe_resolve(t.object)
+            subject = t.subject
+            if isinstance(subject, SubjectSet):
+                subject = SubjectSet(
+                    subject.namespace,
+                    self._maybe_resolve(subject.object),
+                    subject.relation,
+                )
+            elif isinstance(subject, SubjectID):
+                subject = SubjectID(self._maybe_resolve(subject.id))
+            t = RelationTuple(t.namespace, obj, t.relation, subject)
+        return Tree(
+            type=tree.type,
+            tuple=t,
+            children=[self.to_tree(c) for c in (tree.children or [])],
+        )
+
+    def _maybe_resolve(self, value: str) -> str:
+        try:
+            u = uuid.UUID(value)
+        except (ValueError, AttributeError, TypeError):
+            return value
+        return self._resolve(u)

@@ -1,24 +1,432 @@
-"""Command line interface (work in progress).
+"""Command line interface (`cmd/root.go:36-56` parity).
 
-Will mirror the reference's `cmd/` surface: serve, check, expand,
-relation-tuple {parse,create,get,delete,delete-all}, namespace validate,
-status, version.
+Verbs:
+
+* ``serve -c config.yml`` — boot the 4-port daemon (cmd/server/serve.go:26)
+* ``check <subject> <relation> <namespace> <object>`` — gRPC Check
+  (cmd/check/root.go:31-80, incl. subject-set ``ns:obj#rel`` parsing and
+  Allowed/Denied output)
+* ``expand <relation> <namespace> <object>`` — gRPC Expand, pretty tree
+  (cmd/expand/root.go:25-60)
+* ``relation-tuple parse|create|get|delete|delete-all``
+  (cmd/relationtuple/*.go: parse tuple-grammar to JSON, create/delete from
+  JSON files or dirs, get with query flags + pagination + table output,
+  delete-all guarded by --force)
+* ``namespace validate <file.ts>`` — OPL diagnostics (cmd/namespace/)
+* ``status [--block]`` — gRPC health watch (cmd/status/root.go:24-95)
+* ``version``
+
+Client commands talk gRPC to a running daemon, selected by ``--read-remote``
+/ ``--write-remote`` (cmd/client/grpc_client.go:28-35; defaults
+127.0.0.1:4466 / :4467).
 """
 
 from __future__ import annotations
 
+import argparse
+import json
+import pathlib
 import sys
+import time
 
 import ketotpu
+from ketotpu.api.types import KetoAPIError, RelationTuple
+
+READ_REMOTE = "127.0.0.1:4466"
+WRITE_REMOTE = "127.0.0.1:4467"
+
+
+def _channel(remote: str):
+    import grpc
+
+    return grpc.insecure_channel(remote)
+
+
+def _parse_subject(s: str):
+    from ketotpu.api.types import subject_from_string
+
+    return subject_from_string(s)
+
+
+# -- subcommands -------------------------------------------------------------
+
+
+def cmd_serve(args) -> int:
+    from ketotpu.driver import Provider, Registry
+    from ketotpu.server import serve_all
+
+    cfg = Provider(config_file=args.config) if args.config else Provider()
+    reg = Registry(cfg)
+    reg.logger().info("initializing registry (engine warmup)")
+    reg.init()
+    srv = serve_all(reg)
+    try:
+        srv.wait()
+    except KeyboardInterrupt:
+        reg.logger().info("shutting down gracefully")
+        srv.stop()
+    return 0
+
+
+def cmd_check(args) -> int:
+    from ketotpu.api.proto_codec import subject_to_proto
+    from ketotpu.proto import check_service_pb2 as cs
+    from ketotpu.proto import relation_tuples_pb2 as rts
+    from ketotpu.proto.services import CheckServiceStub
+
+    try:
+        subject = _parse_subject(args.subject)
+    except KetoAPIError as e:
+        print(f"Could not parse subject {args.subject!r}: {e}", file=sys.stderr)
+        return 1
+    with _channel(args.read_remote) as ch:
+        resp = CheckServiceStub(ch).Check(
+            cs.CheckRequest(
+                tuple=rts.RelationTuple(
+                    namespace=args.namespace,
+                    object=args.object,
+                    relation=args.relation,
+                    subject=subject_to_proto(subject),
+                ),
+                max_depth=args.max_depth,
+            )
+        )
+    print("Allowed" if resp.allowed else "Denied")
+    return 0 if resp.allowed else 1
+
+
+def cmd_expand(args) -> int:
+    from ketotpu.api.proto_codec import tree_from_proto
+    from ketotpu.proto import expand_service_pb2 as es
+    from ketotpu.proto import relation_tuples_pb2 as rts
+    from ketotpu.proto.services import ExpandServiceStub
+
+    with _channel(args.read_remote) as ch:
+        resp = ExpandServiceStub(ch).Expand(
+            es.ExpandRequest(
+                subject=rts.Subject(
+                    set=rts.SubjectSet(
+                        namespace=args.namespace,
+                        object=args.object,
+                        relation=args.relation,
+                    )
+                ),
+                max_depth=args.max_depth,
+            )
+        )
+    if not resp.HasField("tree"):
+        print("empty tree")
+        return 0
+    print(tree_from_proto(resp.tree))
+    return 0
+
+
+def _iter_tuple_files(paths):
+    for p in paths:
+        path = pathlib.Path(p)
+        if path.is_dir():
+            yield from sorted(path.glob("*.json"))
+        else:
+            yield path
+
+
+def _load_tuples(paths):
+    out = []
+    for f in _iter_tuple_files(paths):
+        data = json.loads(f.read_text())
+        items = data if isinstance(data, list) else [data]
+        for d in items:
+            d.pop("$schema", None)
+            out.append(RelationTuple.from_json(d))
+    return out
+
+
+def _transact(remote: str, tuples, action) -> None:
+    from ketotpu.api.proto_codec import tuple_to_proto
+    from ketotpu.proto import write_service_pb2 as ws
+    from ketotpu.proto.services import WriteServiceStub
+
+    with _channel(remote) as ch:
+        WriteServiceStub(ch).TransactRelationTuples(
+            ws.TransactRelationTuplesRequest(
+                relation_tuple_deltas=[
+                    ws.RelationTupleDelta(
+                        action=action, relation_tuple=tuple_to_proto(t)
+                    )
+                    for t in tuples
+                ]
+            )
+        )
+
+
+def cmd_rt_parse(args) -> int:
+    # tuple-grammar strings -> JSON (cmd/relationtuple/parse.go:18)
+    out = []
+    for s in args.tuples:
+        try:
+            out.append(RelationTuple.from_string(s).to_json())
+        except KetoAPIError as e:
+            print(f"could not parse {s!r}: {e}", file=sys.stderr)
+            return 1
+    print(json.dumps(out if len(out) != 1 else out[0], indent=2))
+    return 0
+
+
+def cmd_rt_create(args) -> int:
+    from ketotpu.proto import write_service_pb2 as ws
+
+    tuples = _load_tuples(args.files)
+    _transact(args.write_remote, tuples, ws.RelationTupleDelta.ACTION_INSERT)
+    print(f"created {len(tuples)} relation tuples")
+    return 0
+
+
+def cmd_rt_delete(args) -> int:
+    from ketotpu.proto import write_service_pb2 as ws
+
+    tuples = _load_tuples(args.files)
+    _transact(args.write_remote, tuples, ws.RelationTupleDelta.ACTION_DELETE)
+    print(f"deleted {len(tuples)} relation tuples")
+    return 0
+
+
+def _query_from_flags(args):
+    from ketotpu.api.proto_codec import subject_to_proto
+    from ketotpu.proto import relation_tuples_pb2 as rts
+
+    query = rts.RelationQuery()
+    if args.namespace:
+        query.namespace = args.namespace
+    if args.object:
+        query.object = args.object
+    if args.relation:
+        query.relation = args.relation
+    if args.subject_id:
+        query.subject.id = args.subject_id
+    elif args.subject_set:
+        query.subject.CopyFrom(subject_to_proto(_parse_subject(args.subject_set)))
+    return query
+
+
+def cmd_rt_get(args) -> int:
+    from ketotpu.api.proto_codec import tuple_from_proto
+    from ketotpu.proto import read_service_pb2 as rs
+    from ketotpu.proto.services import ReadServiceStub
+
+    with _channel(args.read_remote) as ch:
+        resp = ReadServiceStub(ch).ListRelationTuples(
+            rs.ListRelationTuplesRequest(
+                relation_query=_query_from_flags(args),
+                page_size=args.page_size,
+                page_token=args.page_token,
+            )
+        )
+    rows = [tuple_from_proto(t) for t in resp.relation_tuples]
+    if args.format == "json":
+        print(
+            json.dumps(
+                {
+                    "relation_tuples": [r.to_json() for r in rows],
+                    "next_page_token": resp.next_page_token,
+                },
+                indent=2,
+            )
+        )
+    else:
+        # cmdx table output analog (ketoapi/cmd_output.go)
+        print(f"{'NAMESPACE':<16}{'OBJECT':<24}{'RELATION NAME':<16}SUBJECT")
+        for r in rows:
+            print(f"{r.namespace:<16}{r.object:<24}{r.relation:<16}{r.subject}")
+        if resp.next_page_token:
+            print(f"\nnext page token: {resp.next_page_token}")
+    return 0
+
+
+def cmd_rt_delete_all(args) -> int:
+    from ketotpu.proto import write_service_pb2 as ws
+    from ketotpu.proto.services import WriteServiceStub
+
+    if not args.force:
+        print(
+            "This would delete all relation tuples matching the query. "
+            "Re-run with --force to proceed.",
+            file=sys.stderr,
+        )
+        return 1
+    with _channel(args.write_remote) as ch:
+        WriteServiceStub(ch).DeleteRelationTuples(
+            ws.DeleteRelationTuplesRequest(relation_query=_query_from_flags(args))
+        )
+    print("done")
+    return 0
+
+
+def cmd_ns_validate(args) -> int:
+    from ketotpu.opl.parser import parse
+
+    src = pathlib.Path(args.file).read_text()
+    namespaces, errors = parse(src)
+    for e in errors:
+        print(e, file=sys.stderr)
+    if errors:
+        print(f"{len(errors)} parse error(s)", file=sys.stderr)
+        return 1
+    print(
+        f"OK: {len(namespaces)} namespace(s): "
+        + ", ".join(n.name for n in namespaces)
+    )
+    return 0
+
+
+def cmd_status(args) -> int:
+    import grpc
+
+    from ketotpu.proto import health_pb2
+    from ketotpu.proto.services import _stub_class
+
+    deadline = time.monotonic() + args.timeout
+    with _channel(args.read_remote) as ch:
+        stub = _stub_class("grpc.health.v1.Health")(ch)
+        while True:
+            try:
+                resp = stub.Check(health_pb2.HealthCheckRequest())
+                if resp.status == health_pb2.HealthCheckResponse.SERVING:
+                    print("status: SERVING")
+                    return 0
+                print(f"status: {resp.status}")
+                if not args.block:
+                    return 1
+            except grpc.RpcError as e:
+                if not args.block:
+                    print(f"status: unreachable ({e.code()})", file=sys.stderr)
+                    return 1
+            if time.monotonic() > deadline:
+                print("status: timeout", file=sys.stderr)
+                return 1
+            time.sleep(1.0)
+
+
+def cmd_version(args) -> int:
+    print(ketotpu.__version__)
+    return 0
+
+
+# -- parser ------------------------------------------------------------------
+
+
+def _add_client_flags(p, write: bool = False) -> None:
+    p.add_argument(
+        "--read-remote",
+        default=READ_REMOTE,
+        help="read API gRPC remote (host:port)",
+    )
+    if write:
+        p.add_argument(
+            "--write-remote",
+            default=WRITE_REMOTE,
+            help="write API gRPC remote (host:port)",
+        )
+
+
+def _add_query_flags(p) -> None:
+    p.add_argument("--namespace", default="")
+    p.add_argument("--object", default="")
+    p.add_argument("--relation", default="")
+    p.add_argument("--subject-id", default="")
+    p.add_argument("--subject-set", default="")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="keto-tpu", description="TPU-native Zanzibar permission server"
+    )
+    sub = p.add_subparsers(dest="command", required=True)
+
+    serve = sub.add_parser("serve", help="run the 4-port server daemon")
+    serve.add_argument("-c", "--config", help="config file (yaml/json)")
+    serve.set_defaults(fn=cmd_serve)
+
+    check = sub.add_parser("check", help="check a permission")
+    check.add_argument("subject")
+    check.add_argument("relation")
+    check.add_argument("namespace")
+    check.add_argument("object")
+    check.add_argument("--max-depth", type=int, default=0)
+    _add_client_flags(check)
+    check.set_defaults(fn=cmd_check)
+
+    expand = sub.add_parser("expand", help="expand a subject set")
+    expand.add_argument("relation")
+    expand.add_argument("namespace")
+    expand.add_argument("object")
+    expand.add_argument("--max-depth", type=int, default=0)
+    _add_client_flags(expand)
+    expand.set_defaults(fn=cmd_expand)
+
+    rt = sub.add_parser("relation-tuple", help="relation tuple commands")
+    rtsub = rt.add_subparsers(dest="rt_command", required=True)
+
+    rt_parse = rtsub.add_parser("parse", help="tuple grammar -> JSON")
+    rt_parse.add_argument("tuples", nargs="+")
+    rt_parse.set_defaults(fn=cmd_rt_parse)
+
+    rt_create = rtsub.add_parser("create", help="create from JSON file(s)/dir")
+    rt_create.add_argument("files", nargs="+")
+    _add_client_flags(rt_create, write=True)
+    rt_create.set_defaults(fn=cmd_rt_create)
+
+    rt_delete = rtsub.add_parser("delete", help="delete from JSON file(s)/dir")
+    rt_delete.add_argument("files", nargs="+")
+    _add_client_flags(rt_delete, write=True)
+    rt_delete.set_defaults(fn=cmd_rt_delete)
+
+    rt_get = rtsub.add_parser("get", help="query relation tuples")
+    _add_query_flags(rt_get)
+    rt_get.add_argument("--page-size", type=int, default=100)
+    rt_get.add_argument("--page-token", default="")
+    rt_get.add_argument("--format", choices=("table", "json"), default="table")
+    _add_client_flags(rt_get)
+    rt_get.set_defaults(fn=cmd_rt_get)
+
+    rt_del_all = rtsub.add_parser("delete-all", help="delete matching tuples")
+    _add_query_flags(rt_del_all)
+    rt_del_all.add_argument("--force", action="store_true")
+    _add_client_flags(rt_del_all, write=True)
+    rt_del_all.set_defaults(fn=cmd_rt_delete_all)
+
+    ns = sub.add_parser("namespace", help="namespace commands")
+    nssub = ns.add_subparsers(dest="ns_command", required=True)
+    ns_validate = nssub.add_parser("validate", help="validate an OPL file")
+    ns_validate.add_argument("file")
+    ns_validate.set_defaults(fn=cmd_ns_validate)
+
+    status = sub.add_parser("status", help="server health status")
+    status.add_argument("--block", action="store_true", help="wait until SERVING")
+    status.add_argument("--timeout", type=float, default=30.0)
+    _add_client_flags(status)
+    status.set_defaults(fn=cmd_status)
+
+    version = sub.add_parser("version", help="print the version")
+    version.set_defaults(fn=cmd_version)
+    return p
 
 
 def main(argv=None) -> int:
-    argv = sys.argv[1:] if argv is None else argv
-    if argv and argv[0] == "version":
-        print(ketotpu.__version__)
-        return 0
-    print("keto-tpu: CLI under construction; available: version", file=sys.stderr)
-    return 2
+    args = build_parser().parse_args(argv)
+    try:
+        return args.fn(args)
+    except KetoAPIError as e:
+        print(str(e), file=sys.stderr)
+        return 1
+    except Exception as e:  # noqa: BLE001 - clean errors for RPC failures
+        import grpc
+
+        if isinstance(e, grpc.RpcError):
+            code = e.code().name if hasattr(e, "code") else "UNKNOWN"
+            details = e.details() if hasattr(e, "details") else str(e)
+            print(f"rpc error: {code}: {details}", file=sys.stderr)
+            return 1
+        raise
 
 
 if __name__ == "__main__":

@@ -1,0 +1,6 @@
+"""Config provider + registry/DI (`internal/driver/` analog)."""
+
+from ketotpu.driver.config import ConfigError, Provider
+from ketotpu.driver.registry import Registry
+
+__all__ = ["ConfigError", "Provider", "Registry"]

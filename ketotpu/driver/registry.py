@@ -1,0 +1,233 @@
+"""Registry: lazy dependency injection for every component.
+
+The reference's `RegistryDefault` (`internal/driver/registry_default.go:
+53-87`) is an interface-soup singleton factory; this is the same shape with
+Python duck typing:
+
+* every provider method (`store`, `namespace_manager`, `check_engine`,
+  `expand_engine`, `mapper`, `metrics`, `tracer`, `logger`) is a lazy
+  singleton;
+* the engine seam (`check.EngineProvider`, `internal/check/engine.go:29-31`)
+  is the ``engine.kind`` config key: ``tpu`` wires the batched device engine,
+  ``oracle`` the sequential host engine — handlers never know which;
+* `ketoctx`-style embedder options (`ketoctx/options.go:18-35`) are
+  constructor keyword arguments: a custom logger, tracer, metrics registry,
+  extra readiness checks, or a pre-built tuple store can be injected.
+
+`Registry.init()` mirrors `RegistryDefault.Init` (`registry_default.go:
+314-356`): resolve the namespace manager from config, build the store,
+determine the network id, warm the engine snapshot.
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+from ketotpu import __version__
+from ketotpu.api.mapper import Mapper
+from ketotpu.api.uuid_map import UUIDMapper
+from ketotpu.driver.config import Provider
+from ketotpu.engine.oracle import CheckEngine, ExpandEngine
+from ketotpu.engine.tpu import DeviceCheckEngine
+from ketotpu.observability import Metrics, Tracer, make_logger
+from ketotpu.opl.ast import Namespace
+from ketotpu.storage.memory import InMemoryTupleStore
+from ketotpu.storage.namespaces import (
+    OPLFileNamespaceManager,
+    StaticNamespaceManager,
+)
+
+# networkx DetermineNetwork analog: single-tenant default network id; a
+# Contextualizer can swap it per request (ketoctx/contextualizer.go)
+DEFAULT_NETWORK_ID = uuid.UUID("00000000-0000-0000-0000-000000000001")
+
+
+class Registry:
+    """Lazy singletons over a validated config (RegistryDefault analog)."""
+
+    def __init__(
+        self,
+        config: Optional[Provider] = None,
+        *,
+        logger=None,
+        tracer: Optional[Tracer] = None,
+        metrics: Optional[Metrics] = None,
+        store: Optional[InMemoryTupleStore] = None,
+        namespace_manager=None,
+        readiness_checks: Optional[Dict[str, Callable[[], None]]] = None,
+        network_id: uuid.UUID = DEFAULT_NETWORK_ID,
+    ):
+        self.config = config if config is not None else Provider()
+        self._lock = threading.RLock()
+        self._logger = logger
+        self._tracer = tracer
+        self._metrics = metrics
+        self._store = store
+        self._namespace_manager = namespace_manager
+        self._check_engine = None
+        self._expand_engine = None
+        self._oracle_engine = None
+        self._mapper = None
+        self._ro_mapper = None
+        self._uuid_mapper = None
+        self.network_id = network_id
+        self.readiness_checks = dict(readiness_checks or {})
+        self.version = __version__
+
+    # -- cross-cutting ------------------------------------------------------
+
+    def logger(self):
+        with self._lock:
+            if self._logger is None:
+                self._logger = make_logger(
+                    level=str(self.config.get("log.level", "info"))
+                )
+            return self._logger
+
+    def metrics(self) -> Metrics:
+        with self._lock:
+            if self._metrics is None:
+                self._metrics = Metrics()
+            return self._metrics
+
+    def tracer(self) -> Tracer:
+        with self._lock:
+            if self._tracer is None:
+                self._tracer = Tracer(self.metrics(), self.logger())
+            return self._tracer
+
+    # -- storage + namespaces ----------------------------------------------
+
+    def store(self) -> InMemoryTupleStore:
+        with self._lock:
+            if self._store is None:
+                self._store = InMemoryTupleStore()
+            return self._store
+
+    def namespace_manager(self):
+        """Resolve the polymorphic namespaces config (provider.go:311-342):
+        literal list | {location: opl-file} | URI string."""
+        with self._lock:
+            if self._namespace_manager is None:
+                ns_cfg = self.config.namespaces_config()
+                if isinstance(ns_cfg, dict):
+                    location = ns_cfg.get("location", "")
+                    self._namespace_manager = OPLFileNamespaceManager(
+                        _strip_file_uri(location)
+                    )
+                elif isinstance(ns_cfg, str):
+                    self._namespace_manager = OPLFileNamespaceManager(
+                        _strip_file_uri(ns_cfg)
+                    )
+                else:
+                    self._namespace_manager = StaticNamespaceManager(
+                        [_namespace_from_config(d) for d in (ns_cfg or [])]
+                    )
+            return self._namespace_manager
+
+    # -- engines (the EngineProvider seam) ----------------------------------
+
+    def check_engine(self):
+        with self._lock:
+            if self._check_engine is None:
+                kind = self.config.get("engine.kind")
+                if kind == "tpu":
+                    self._check_engine = DeviceCheckEngine(
+                        self.store(),
+                        self.namespace_manager(),
+                        max_depth=self.config.max_read_depth(),
+                        max_width=self.config.max_read_width(),
+                        strict_mode=self.config.strict_mode(),
+                        frontier=int(self.config.get("engine.frontier")),
+                        arena=int(self.config.get("engine.arena")),
+                        max_batch=int(self.config.get("engine.max_batch")),
+                        retry_scale=int(self.config.get("engine.retry_scale")),
+                    )
+                else:
+                    self._check_engine = self.oracle_engine()
+            return self._check_engine
+
+    def oracle_engine(self) -> CheckEngine:
+        with self._lock:
+            if self._oracle_engine is None:
+                self._oracle_engine = CheckEngine(
+                    self.store(),
+                    self.namespace_manager(),
+                    max_depth=self.config.max_read_depth(),
+                    max_width=self.config.max_read_width(),
+                    strict_mode=self.config.strict_mode(),
+                )
+            return self._oracle_engine
+
+    def expand_engine(self) -> ExpandEngine:
+        with self._lock:
+            if self._expand_engine is None:
+                self._expand_engine = ExpandEngine(
+                    self.store(), max_depth=self.config.max_read_depth()
+                )
+            return self._expand_engine
+
+    # -- mapping ------------------------------------------------------------
+
+    def uuid_mapper(self, read_only: bool = False) -> UUIDMapper:
+        with self._lock:
+            if self._uuid_mapper is None:
+                self._uuid_mapper = UUIDMapper(self.network_id)
+            if read_only:
+                return UUIDMapper(self.network_id, read_only=True)
+            return self._uuid_mapper
+
+    def mapper(self) -> Mapper:
+        """Writable mapper: interns strings into the reverse store (the
+        reference's Mapper(), used on write paths)."""
+        with self._lock:
+            if self._mapper is None:
+                self._mapper = Mapper(self.uuid_mapper(), self.namespace_manager())
+            return self._mapper
+
+    def read_only_mapper(self) -> Mapper:
+        """ReadOnlyMapper() analog (uuid_mapping.go:60-71): namespace checks
+        and forward hashing without populating the reverse store — the
+        check/expand/list paths must not grow process memory per request."""
+        with self._lock:
+            if self._ro_mapper is None:
+                self._ro_mapper = Mapper(
+                    self.uuid_mapper(read_only=True), self.namespace_manager()
+                )
+            return self._ro_mapper
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def init(self) -> "Registry":
+        """Eager init (RegistryDefault.Init analog): resolve config into
+        live components and warm the device snapshot."""
+        self.namespace_manager()
+        self.store()
+        eng = self.check_engine()
+        if isinstance(eng, DeviceCheckEngine):
+            eng.snapshot()
+        return self
+
+    def health(self) -> Dict[str, str]:
+        """Readiness probe results; "ok" or the error string per check."""
+        out = {}
+        for name, check in self.readiness_checks.items():
+            try:
+                check()
+                out[name] = "ok"
+            except Exception as e:  # noqa: BLE001 - reported, not raised
+                out[name] = str(e)
+        return out
+
+
+def _strip_file_uri(location: str) -> str:
+    if location.startswith("file://"):
+        return location[len("file://"):]
+    return location
+
+
+def _namespace_from_config(d: Dict[str, Any]) -> Namespace:
+    """Literal namespace entry: {"name": ..., ["id": legacy int]}."""
+    return Namespace(name=str(d["name"]), relations=[])

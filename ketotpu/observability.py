@@ -1,0 +1,176 @@
+"""Observability: span events, metrics, structured logging.
+
+The reference wires OpenTelemetry + Prometheus + logrus through every layer
+(SURVEY §5.1/§5.5).  This module is the dependency-free equivalent:
+
+* **Events** — the semconv span-event vocabulary of `x/events/events.go:14-20`
+  (``PermissionsChecked``, ``PermissionsExpanded``, ``RelationtuplesCreated/
+  Deleted/Changed``), emitted through ``Tracer.event`` at the same call sites
+  (check engine, expand engine, transact handler).
+* **Metrics** — a threadsafe counter/histogram registry with Prometheus text
+  exposition, served at ``/metrics/prometheus`` on every router and on the
+  dedicated metrics port (`registry_default.go:170-182`, `daemon.go:551-566`).
+  The device engine records per-batch gauges the SURVEY asks for (batches,
+  fallbacks, retries, snapshot rebuilds).
+* **Tracer** — span context manager: wall-time histograms per span name plus
+  an event sink; ``ketoctx.WithTracerWrapper`` parity = constructor injection
+  of a custom Tracer into the Registry.
+* **Logger** — stdlib logging with a structured key=value formatter (logrusx
+  analog), per-request request logs in the REST router.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, Iterable, List, Optional, Tuple
+
+# -- span events (x/events/events.go:14-20) ---------------------------------
+
+PERMISSIONS_CHECKED = "PermissionsChecked"
+PERMISSIONS_EXPANDED = "PermissionsExpanded"
+RELATIONTUPLES_CREATED = "RelationtuplesCreated"
+RELATIONTUPLES_DELETED = "RelationtuplesDeleted"
+RELATIONTUPLES_CHANGED = "RelationtuplesChanged"
+
+_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+            1.0, 2.5, 5.0, 10.0)
+
+
+class Metrics:
+    """Prometheus-style registry: counters + histograms, text exposition."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
+        self._hists: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], List] = {}
+        self._help: Dict[str, str] = {}
+
+    def counter(self, name: str, value: float = 1.0, help: str = "", **labels):
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            if help:
+                self._help.setdefault(name, help)
+            self._counters[key] = self._counters.get(key, 0.0) + value
+
+    def observe(self, name: str, value: float, help: str = "", **labels):
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            if help:
+                self._help.setdefault(name, help)
+            h = self._hists.get(key)
+            if h is None:
+                h = self._hists[key] = [[0] * (len(_BUCKETS) + 1), 0.0, 0]
+            buckets, _, _ = h
+            for i, ub in enumerate(_BUCKETS):
+                if value <= ub:
+                    buckets[i] += 1
+                    break
+            else:
+                buckets[-1] += 1
+            h[1] += value
+            h[2] += 1
+
+    def get_counter(self, name: str, **labels) -> float:
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            return self._counters.get(key, 0.0)
+
+    @staticmethod
+    def _fmt_labels(labels: Iterable[Tuple[str, str]], extra: str = "") -> str:
+        parts = [f'{k}="{v}"' for k, v in labels]
+        if extra:
+            parts.append(extra)
+        return "{" + ",".join(parts) + "}" if parts else ""
+
+    def exposition(self) -> str:
+        """Prometheus text format 0.0.4."""
+        lines: List[str] = []
+        with self._lock:
+            names = sorted({n for n, _ in self._counters} | {n for n, _ in self._hists})
+            for name in names:
+                if name in self._help:
+                    lines.append(f"# HELP {name} {self._help[name]}")
+                ctr_items = [(k, v) for k, v in self._counters.items() if k[0] == name]
+                if ctr_items:
+                    lines.append(f"# TYPE {name} counter")
+                    for (n, labels), v in sorted(ctr_items):
+                        fv = int(v) if float(v).is_integer() else v
+                        lines.append(f"{name}{self._fmt_labels(labels)} {fv}")
+                hist_items = [(k, v) for k, v in self._hists.items() if k[0] == name]
+                if hist_items:
+                    lines.append(f"# TYPE {name} histogram")
+                    for (n, labels), (buckets, total, count) in sorted(hist_items):
+                        acc = 0
+                        for i, ub in enumerate(_BUCKETS):
+                            acc += buckets[i]
+                            le = self._fmt_labels(labels, f'le="{ub}"')
+                            lines.append(f"{name}_bucket{le} {acc}")
+                        acc += buckets[-1]
+                        le = self._fmt_labels(labels, 'le="+Inf"')
+                        lines.append(f"{name}_bucket{le} {acc}")
+                        lab = self._fmt_labels(labels)
+                        lines.append(f"{name}_sum{lab} {total}")
+                        lines.append(f"{name}_count{lab} {count}")
+        return "\n".join(lines) + "\n"
+
+
+class Tracer:
+    """Span timings + events; inject a subclass for custom exporters
+    (the ketoctx.WithTracerWrapper seam, `ketoctx/options.go:42-45`)."""
+
+    def __init__(self, metrics: Optional[Metrics] = None,
+                 logger: Optional[logging.Logger] = None):
+        self.metrics = metrics
+        self.logger = logger
+
+    @contextmanager
+    def span(self, name: str, **attrs):
+        t0 = time.perf_counter()
+        try:
+            yield self
+        finally:
+            dt = time.perf_counter() - t0
+            if self.metrics is not None:
+                self.metrics.observe(
+                    "keto_span_duration_seconds", dt,
+                    help="span wall time", span=name,
+                )
+
+    def event(self, name: str, **attrs):
+        """Span-event emission (x/events/events.go AddEvent sites)."""
+        if self.metrics is not None:
+            self.metrics.counter(
+                "keto_events_total", 1, help="span events emitted", event=name
+            )
+        if self.logger is not None and self.logger.isEnabledFor(logging.DEBUG):
+            kv = " ".join(f"{k}={v}" for k, v in attrs.items())
+            self.logger.debug("event %s %s", name, kv)
+
+
+class _KVFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        base = super().format(record)
+        fields = getattr(record, "fields", None)
+        if fields:
+            kv = " ".join(f"{k}={v}" for k, v in sorted(fields.items()))
+            return f"{base} {kv}"
+        return base
+
+
+def make_logger(name: str = "ketotpu", level: str = "info") -> logging.Logger:
+    """Structured logger (logrusx analog): level from config, kv fields via
+    ``logger.info(..., extra={"fields": {...}})``."""
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        h = logging.StreamHandler()
+        h.setFormatter(
+            _KVFormatter("%(asctime)s %(levelname)s %(name)s %(message)s")
+        )
+        logger.addHandler(h)
+        logger.propagate = False
+    logger.setLevel(getattr(logging, level.upper(), logging.INFO))
+    return logger

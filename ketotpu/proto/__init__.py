@@ -15,6 +15,7 @@ _HERE = os.path.dirname(__file__)
 if _HERE not in sys.path:
     sys.path.insert(0, _HERE)
 
+from health.v1 import health_pb2  # noqa: E402,F401
 from ory.keto.opl.v1alpha1 import syntax_service_pb2  # noqa: E402,F401
 from ory.keto.relation_tuples.v1alpha2 import (  # noqa: E402,F401
     check_service_pb2,

@@ -26,6 +26,7 @@ import grpc
 from ketotpu.proto import (
     check_service_pb2,
     expand_service_pb2,
+    health_pb2,
     namespaces_service_pb2,
     read_service_pb2,
     syntax_service_pb2,
@@ -71,6 +72,12 @@ SERVICES: Dict[str, Dict[str, Tuple[Type, Type]]] = {
     },
     f"{_OPL}.SyntaxService": {
         "Check": (syntax_service_pb2.CheckRequest, syntax_service_pb2.CheckResponse),
+    },
+    "grpc.health.v1.Health": {
+        "Check": (
+            health_pb2.HealthCheckRequest,
+            health_pb2.HealthCheckResponse,
+        ),
     },
 }
 

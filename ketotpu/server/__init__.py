@@ -1,0 +1,5 @@
+"""Serving layer: handlers, REST router, multiplexed 4-port daemon."""
+
+from ketotpu.server.daemon import Server, serve_all
+
+__all__ = ["Server", "serve_all"]

@@ -1,0 +1,274 @@
+"""The serving daemon: 4 multiplexed ports, gRPC + REST on each.
+
+Parity with `internal/driver/daemon.go:105-151,230-315`: the reference
+listens on read (:4466), write (:4467), metrics (:4468) and opl (:4469),
+cmux-splitting each port into an HTTP/2 gRPC server and an HTTP/1 REST
+router.  Python's grpc server owns its listening socket, so the cmux here
+is a byte-level multiplexer: the public port accepts the connection, peeks
+the first bytes, and splices the stream to an internal gRPC or REST backend
+bound on localhost — protocol detection by the HTTP/2 client preface
+(``PRI * HTTP/2.0``), exactly what cmux matches on.
+
+gRPC service placement mirrors `daemon.go:488-543`:
+  read:   CheckService, ExpandService, ReadService, NamespacesService,
+          VersionService, grpc.health.v1.Health
+  write:  WriteService, VersionService, Health
+  opl:    SyntaxService, VersionService, Health
+  metrics: REST only (prometheus + health + version), like the reference's
+          plain-HTTP metrics port (daemon.go:189-228).
+
+Graceful shutdown closes acceptors first, then stops backends with a grace
+period (daemon.go:109-135).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from concurrent import futures
+from typing import Dict, List, Optional, Tuple
+
+import grpc
+
+from ketotpu.proto import health_pb2
+from ketotpu.proto.services import (
+    CHECK_SERVICE,
+    EXPAND_SERVICE,
+    NAMESPACES_SERVICE,
+    READ_SERVICE,
+    SYNTAX_SERVICE,
+    VERSION_SERVICE,
+    WRITE_SERVICE,
+    add_servicer_to_server,
+)
+from ketotpu.server import rest
+from ketotpu.server.handlers import (
+    CheckHandler,
+    ExpandHandler,
+    NamespaceHandler,
+    RelationTupleHandler,
+    SyntaxHandler,
+    VersionHandler,
+)
+
+HEALTH_SERVICE = "grpc.health.v1.Health"
+
+# the HTTP/2 client connection preface cmux matches on
+_H2_PREFACE = b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n"
+
+
+class HealthServicer:
+    """grpc.health.v1.Health/Check over the registry's readiness checks."""
+
+    def __init__(self, registry):
+        self.r = registry
+
+    def Check(self, request, context):
+        failing = [v for v in self.r.health().values() if v != "ok"]
+        status = (
+            health_pb2.HealthCheckResponse.NOT_SERVING
+            if failing
+            else health_pb2.HealthCheckResponse.SERVING
+        )
+        return health_pb2.HealthCheckResponse(status=status)
+
+
+def _pump(src: socket.socket, dst: socket.socket) -> None:
+    try:
+        while True:
+            data = src.recv(65536)
+            if not data:
+                break
+            dst.sendall(data)
+    except OSError:
+        pass
+    finally:
+        for s, how in ((dst, socket.SHUT_WR), (src, socket.SHUT_RD)):
+            try:
+                s.shutdown(how)
+            except OSError:
+                pass
+
+
+class _Mux(threading.Thread):
+    """One public port: sniff the preface, splice to gRPC or REST backend."""
+
+    def __init__(self, host: str, port: int, grpc_addr: Tuple[str, int],
+                 rest_addr: Tuple[str, int], logger):
+        super().__init__(daemon=True)
+        self.listener = socket.create_server(
+            (host, port), reuse_port=False, backlog=128
+        )
+        self.addr = self.listener.getsockname()[:2]
+        self.grpc_addr = grpc_addr
+        self.rest_addr = rest_addr
+        self.logger = logger
+        self._closing = threading.Event()
+
+    def run(self) -> None:
+        while not self._closing.is_set():
+            try:
+                conn, _ = self.listener.accept()
+            except OSError:
+                break
+            threading.Thread(
+                target=self._splice, args=(conn,), daemon=True
+            ).start()
+
+    def _splice(self, conn: socket.socket) -> None:
+        try:
+            conn.settimeout(10.0)
+            # cmux buffers until it can match; a fragmented preface may
+            # deliver fewer than 4 bytes first, so peek until decidable.
+            # MSG_PEEK returns immediately once any bytes exist, hence the
+            # tiny sleep between re-peeks of a still-matching partial head.
+            deadline = time.monotonic() + 10.0
+            while True:
+                head = conn.recv(len(_H2_PREFACE), socket.MSG_PEEK)
+                if (
+                    not head
+                    or len(head) >= 4
+                    or head != _H2_PREFACE[: len(head)]
+                    or time.monotonic() > deadline
+                ):
+                    break
+                time.sleep(0.005)
+            conn.settimeout(None)
+            target = (
+                self.grpc_addr if head.startswith(b"PRI ") else self.rest_addr
+            )
+            backend = socket.create_connection(target)
+        except OSError as e:
+            self.logger.debug("mux splice failed: %s", e)
+            conn.close()
+            return
+        t = threading.Thread(target=_pump, args=(conn, backend), daemon=True)
+        t.start()
+        _pump(backend, conn)
+
+    def close(self) -> None:
+        self._closing.set()
+        try:
+            self.listener.close()
+        except OSError:
+            pass
+
+
+class Server:
+    """ServeAll analog: boot every port, block until stop()."""
+
+    def __init__(self, registry):
+        self.registry = registry
+        self.logger = registry.logger()
+        self._grpc_servers: List[grpc.Server] = []
+        self._http_servers: List = []
+        self._muxes: List[_Mux] = []
+        self._threads: List[threading.Thread] = []
+        self.addresses: Dict[str, Tuple[str, int]] = {}
+        self._stopped = threading.Event()
+
+    # -- construction -------------------------------------------------------
+
+    def _grpc_backend(self, services: Dict[str, object]) -> Tuple[str, int]:
+        server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=16),
+            options=[("grpc.so_reuseport", 0)],
+        )
+        for name, servicer in services.items():
+            add_servicer_to_server(name, servicer, server)
+        port = server.add_insecure_port("127.0.0.1:0")
+        server.start()
+        self._grpc_servers.append(server)
+        return ("127.0.0.1", port)
+
+    def _rest_backend(self, router: rest.Router) -> Tuple[str, int]:
+        httpd = rest.make_http_server(router, "127.0.0.1", 0)
+        self._http_servers.append(httpd)
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        self._threads.append(t)
+        return httpd.server_address[:2]
+
+    def start(self) -> "Server":
+        r = self.registry
+        version = VersionHandler(r)
+        health = HealthServicer(r)
+        check = CheckHandler(r)
+        expand = ExpandHandler(r)
+        tuples = RelationTupleHandler(r)
+        namespaces = NamespaceHandler(r)
+        syntax = SyntaxHandler(r)
+
+        ports = {
+            "read": (
+                {
+                    CHECK_SERVICE: check,
+                    EXPAND_SERVICE: expand,
+                    READ_SERVICE: tuples,
+                    NAMESPACES_SERVICE: namespaces,
+                    VERSION_SERVICE: version,
+                    HEALTH_SERVICE: health,
+                },
+                rest.read_router(r),
+            ),
+            "write": (
+                {
+                    WRITE_SERVICE: tuples,
+                    VERSION_SERVICE: version,
+                    HEALTH_SERVICE: health,
+                },
+                rest.write_router(r),
+            ),
+            "opl": (
+                {
+                    SYNTAX_SERVICE: syntax,
+                    VERSION_SERVICE: version,
+                    HEALTH_SERVICE: health,
+                },
+                rest.opl_router(r),
+            ),
+        }
+        for name, (services, router) in ports.items():
+            host, port = r.config.listen_on(name)
+            grpc_addr = self._grpc_backend(services)
+            rest_addr = self._rest_backend(router)
+            mux = _Mux(host, port, grpc_addr, rest_addr, self.logger)
+            mux.start()
+            self._muxes.append(mux)
+            self.addresses[name] = mux.addr
+            self.logger.info(
+                "serving %s on %s:%d (gRPC+REST multiplexed)",
+                name, *mux.addr,
+            )
+
+        # metrics: plain HTTP, no gRPC, no mux (daemon.go:189-228)
+        host, port = r.config.listen_on("metrics")
+        httpd = rest.make_http_server(rest.metrics_router(r), host, port)
+        self._http_servers.append(httpd)
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        self._threads.append(t)
+        self.addresses["metrics"] = httpd.server_address[:2]
+        self.logger.info("serving metrics on %s:%d", *self.addresses["metrics"])
+        return self
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        self._stopped.wait(timeout)
+
+    def stop(self, grace: float = 5.0) -> None:
+        for mux in self._muxes:
+            mux.close()
+        for s in self._grpc_servers:
+            s.stop(grace)
+        for httpd in self._http_servers:
+            httpd.shutdown()
+            httpd.server_close()
+        self._stopped.set()
+
+
+def serve_all(registry) -> Server:
+    """Build + start the full 4-port daemon (Registry.ServeAll analog)."""
+    return Server(registry).start()

@@ -1,0 +1,327 @@
+"""API handlers: the transport-agnostic cores + gRPC servicer methods.
+
+One handler class per reference handler package, each holding a Registry
+(the `handlerDependencies` interface soup, e.g. `internal/check/handler.go:
+28-37`).  Methods named after gRPC RPCs are the servicer implementations
+registered via `ketotpu.proto.services.add_servicer_to_server`; the
+``*_core`` methods are shared by REST routes (server/rest.py).
+
+Behavioral parity notes (each encoded below, with the reference site):
+
+* unknown namespace on REST check ⇒ ``allowed=false`` with HTTP 200/403, not
+  404 (`check/handler.go:169-171`); on gRPC it propagates as NOT_FOUND;
+* ``/relation-tuples/check`` mirrors the verdict in the HTTP status (403 on
+  deny); ``/relation-tuples/check/openapi`` always answers 200
+  (`check/handler.go:54-59,141-154`);
+* Expand of a subject-id is a leaf tree without touching the engine
+  (`expand/handler.go:115-126`); an empty expansion is 404 on REST
+  (`expand/handler.go:98-101`);
+* snaptokens are real here (the snapshot epoch of the device engine),
+  where the reference returns "not yet implemented"
+  (`check/handler.go:329`, `transact_server.go:63-66`).
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from ketotpu.api.proto_codec import (
+    query_from_proto,
+    tree_to_proto,
+    tuple_from_proto,
+    tuple_to_proto,
+)
+from ketotpu.api.types import (
+    BadRequestError,
+    KetoAPIError,
+    NotFoundError,
+    RelationQuery,
+    RelationTuple,
+    SubjectID,
+    SubjectSet,
+)
+from ketotpu.observability import (
+    PERMISSIONS_CHECKED,
+    PERMISSIONS_EXPANDED,
+    RELATIONTUPLES_CHANGED,
+    RELATIONTUPLES_CREATED,
+    RELATIONTUPLES_DELETED,
+)
+from ketotpu.opl.parser import parse as opl_parse
+from ketotpu.proto import (
+    check_service_pb2,
+    expand_service_pb2,
+    namespaces_service_pb2,
+    read_service_pb2,
+    syntax_service_pb2,
+    version_pb2,
+    write_service_pb2,
+)
+
+_GRPC_CODES = {
+    400: grpc.StatusCode.INVALID_ARGUMENT,
+    403: grpc.StatusCode.PERMISSION_DENIED,
+    404: grpc.StatusCode.NOT_FOUND,
+    409: grpc.StatusCode.ALREADY_EXISTS,
+    500: grpc.StatusCode.INTERNAL,
+}
+
+
+def _abort(context, e: Exception):
+    """Map a typed API error onto the gRPC status surface (the herodot
+    error-unwrap interceptor, daemon.go:468-478)."""
+    if isinstance(e, KetoAPIError):
+        code = _GRPC_CODES.get(e.status_code or 500, grpc.StatusCode.UNKNOWN)
+        context.abort(code, str(e))
+    context.abort(grpc.StatusCode.INTERNAL, str(e))
+
+
+class CheckHandler:
+    """`internal/check/handler.go` — REST core + CheckService servicer."""
+
+    def __init__(self, registry):
+        self.r = registry
+
+    def check_core(self, tuple_: RelationTuple, max_depth: int) -> bool:
+        """Engine dispatch incl. the unknown-namespace probe the Mapper does
+        (uuid_mapping.go:199 via GetNamespaceByName); raises NotFoundError
+        for unknown namespaces — REST swallows it, gRPC propagates."""
+        with self.r.tracer().span("check.Engine.CheckIsMember"):
+            # ReadOnlyMapper: namespace checks + validation without interning
+            self.r.read_only_mapper().from_tuple(tuple_)
+            allowed = self.r.check_engine().check_is_member(tuple_, max_depth)
+        self.r.tracer().event(PERMISSIONS_CHECKED)
+        self.r.metrics().counter(
+            "keto_checks_total", 1, help="authorization checks served",
+            allowed=str(allowed).lower(),
+        )
+        return allowed
+
+    def check_rest(self, tuple_: RelationTuple, max_depth: int) -> bool:
+        try:
+            return self.check_core(tuple_, max_depth)
+        except NotFoundError:
+            return False  # check/handler.go:169-171
+
+    def snaptoken(self) -> str:
+        """A real snaptoken: the store version the verdict was computed at
+        (the Zanzibar zookie the reference stubs, check_service.proto:51-60)."""
+        return f"v{self.r.store().version}"
+
+    # gRPC CheckService.Check
+    def Check(self, request, context):
+        try:
+            src = request.tuple if request.HasField("tuple") else request
+            tuple_ = tuple_from_proto(src)
+            allowed = self.check_core(tuple_, int(request.max_depth))
+            return check_service_pb2.CheckResponse(
+                allowed=allowed, snaptoken=self.snaptoken()
+            )
+        except Exception as e:  # noqa: BLE001 - mapped to status codes
+            _abort(context, e)
+
+
+class ExpandHandler:
+    """`internal/expand/handler.go` — REST core + ExpandService servicer."""
+
+    def __init__(self, registry):
+        self.r = registry
+
+    def expand_core(self, subject, max_depth: int):
+        with self.r.tracer().span("expand.Engine.BuildTree"):
+            if isinstance(subject, SubjectSet):
+                self.r.read_only_mapper().from_subject_set(subject)  # ns check
+            tree = self.r.expand_engine().build_tree(subject, max_depth)
+        self.r.tracer().event(PERMISSIONS_EXPANDED)
+        return tree
+
+    # gRPC ExpandService.Expand
+    def Expand(self, request, context):
+        try:
+            which = request.subject.WhichOneof("ref")
+            if which == "id":
+                # subject-id expands to a leaf without the engine
+                # (expand/handler.go:115-126)
+                from ketotpu.proto import relation_tuples_pb2 as rts
+
+                return expand_service_pb2.ExpandResponse(
+                    tree=expand_service_pb2.SubjectTree(
+                        node_type=expand_service_pb2.NodeType.NODE_TYPE_LEAF,
+                        subject=rts.Subject(id=request.subject.id),
+                    )
+                )
+            s = request.subject.set
+            subject = SubjectSet(s.namespace, s.object, s.relation)
+            tree = self.expand_core(subject, int(request.max_depth))
+            if tree is None:
+                return expand_service_pb2.ExpandResponse()
+            return expand_service_pb2.ExpandResponse(tree=tree_to_proto(tree))
+        except Exception as e:  # noqa: BLE001
+            _abort(context, e)
+
+
+class RelationTupleHandler:
+    """`internal/relationtuple/{read_server,transact_server}.go` — tuple
+    CRUD over ReadService + WriteService and the REST admin routes."""
+
+    def __init__(self, registry):
+        self.r = registry
+
+    # -- cores --------------------------------------------------------------
+
+    def list_core(self, query, page_size: int, page_token: str):
+        with self.r.tracer().span("relationtuple.Manager.GetRelationTuples"):
+            if query is not None and query.namespace is not None:
+                # FromQuery namespace resolution (uuid_mapping.go:82-90)
+                self.r.read_only_mapper().from_query(query)
+            tuples, next_token = self.r.store().get_relation_tuples(
+                query, page_size=page_size or 100, page_token=page_token or ""
+            )
+        return tuples, next_token
+
+    def transact_core(self, inserts, deletes):
+        with self.r.tracer().span("relationtuple.Manager.TransactRelationTuples"):
+            if inserts or deletes:
+                self.r.mapper().from_tuple(*inserts, *deletes)  # validate + ns
+            self.r.store().transact_relation_tuples(inserts, deletes)
+        self.r.tracer().event(RELATIONTUPLES_CHANGED)
+        self.r.metrics().counter(
+            "keto_relationtuples_writes_total", 1, help="tuple transactions"
+        )
+
+    def delete_all_core(self, query) -> int:
+        with self.r.tracer().span("relationtuple.Manager.DeleteAllRelationTuples"):
+            if query is not None and query.namespace is not None:
+                self.r.read_only_mapper().from_query(query)
+            n = self.r.store().delete_all_relation_tuples(query)
+        self.r.tracer().event(RELATIONTUPLES_DELETED)
+        return n
+
+    # -- gRPC ReadService ---------------------------------------------------
+
+    def ListRelationTuples(self, request, context):
+        try:
+            if request.HasField("relation_query"):
+                query = query_from_proto(request.relation_query)
+            elif request.HasField("query"):
+                q = request.query
+                query = RelationQuery(
+                    namespace=q.namespace or None,
+                    object=q.object or None,
+                    relation=q.relation or None,
+                )
+                if q.HasField("subject"):
+                    from ketotpu.api.proto_codec import subject_from_proto
+
+                    query = query.with_subject(subject_from_proto(q.subject))
+            else:
+                raise BadRequestError("you must provide a query")
+            tuples, next_token = self.list_core(
+                query, int(request.page_size), request.page_token
+            )
+            return read_service_pb2.ListRelationTuplesResponse(
+                relation_tuples=[tuple_to_proto(t) for t in tuples],
+                next_page_token=next_token,
+            )
+        except Exception as e:  # noqa: BLE001
+            _abort(context, e)
+
+    # -- gRPC WriteService --------------------------------------------------
+
+    def TransactRelationTuples(self, request, context):
+        try:
+            inserts, deletes = [], []
+            for delta in request.relation_tuple_deltas:
+                t = tuple_from_proto(delta.relation_tuple)
+                if delta.action == write_service_pb2.RelationTupleDelta.ACTION_INSERT:
+                    inserts.append(t)
+                elif delta.action == write_service_pb2.RelationTupleDelta.ACTION_DELETE:
+                    deletes.append(t)
+            self.transact_core(inserts, deletes)
+            return write_service_pb2.TransactRelationTuplesResponse(
+                snaptokens=[f"v{self.r.store().version}"] * len(inserts)
+            )
+        except Exception as e:  # noqa: BLE001
+            _abort(context, e)
+
+    def DeleteRelationTuples(self, request, context):
+        try:
+            if request.HasField("relation_query"):
+                query = query_from_proto(request.relation_query)
+            elif request.HasField("query"):
+                q = request.query
+                query = RelationQuery(
+                    namespace=q.namespace or None,
+                    object=q.object or None,
+                    relation=q.relation or None,
+                )
+                if q.HasField("subject"):
+                    from ketotpu.api.proto_codec import subject_from_proto
+
+                    query = query.with_subject(subject_from_proto(q.subject))
+            else:
+                raise BadRequestError("invalid request")
+            self.delete_all_core(query)
+            return write_service_pb2.DeleteRelationTuplesResponse()
+        except Exception as e:  # noqa: BLE001
+            _abort(context, e)
+
+
+class NamespaceHandler:
+    """`internal/namespace/namespacehandler/handler.go` — list namespaces."""
+
+    def __init__(self, registry):
+        self.r = registry
+
+    def list_core(self):
+        return self.r.namespace_manager().namespaces()
+
+    def ListNamespaces(self, request, context):
+        try:
+            return namespaces_service_pb2.ListNamespacesResponse(
+                namespaces=[
+                    namespaces_service_pb2.Namespace(name=ns.name)
+                    for ns in self.list_core()
+                ]
+            )
+        except Exception as e:  # noqa: BLE001
+            _abort(context, e)
+
+
+class SyntaxHandler:
+    """`internal/schema/handler.go` — OPL syntax check."""
+
+    def __init__(self, registry):
+        self.r = registry
+
+    def check_core(self, content: bytes):
+        _, errors = opl_parse(content.decode("utf-8", errors="replace"))
+        return errors
+
+    def Check(self, request, context):
+        errors = self.check_core(request.content)
+        return syntax_service_pb2.CheckResponse(
+            parse_errors=[
+                syntax_service_pb2.ParseError(
+                    message=e.msg,
+                    start=syntax_service_pb2.SourcePosition(
+                        line=e.start.line, column=e.start.column
+                    ),
+                    end=syntax_service_pb2.SourcePosition(
+                        line=e.end.line, column=e.end.column
+                    ),
+                )
+                for e in errors
+            ]
+        )
+
+
+class VersionHandler:
+    """`rts.VersionServiceServer` registered on every gRPC port
+    (daemon.go:505,521,538)."""
+
+    def __init__(self, registry):
+        self.r = registry
+
+    def GetVersion(self, request, context):
+        return version_pb2.GetVersionResponse(version=self.r.version)

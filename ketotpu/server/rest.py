@@ -1,0 +1,395 @@
+"""REST routes over the handler cores (httprouter/negroni analog).
+
+Implements the reference's HTTP surface with its status-code quirks:
+
+read port (`daemon.go:329-366`):
+  GET/POST /relation-tuples/check            403-mirror (handler.go:121-154)
+  GET/POST /relation-tuples/check/openapi    always 200 (handler.go:99-110)
+  GET      /relation-tuples/expand           (expand/handler.go:62-111)
+  GET      /relation-tuples                  (read_server.go:110-199)
+  GET      /namespaces                       (namespacehandler/handler.go:39)
+write port (`daemon.go:367-403`):
+  PUT      /admin/relation-tuples            201 + Location (transact_server.go:134-176)
+  DELETE   /admin/relation-tuples            204, query-validated (:188-243)
+  PATCH    /admin/relation-tuples            204 (:245-309)
+opl port (`daemon.go:405-440`):
+  POST     /opl/syntax/check                 (schema/handler.go:38-45)
+every port (healthx + metrics, `registry_default.go:128-182`):
+  GET /health/alive, /health/ready, /version, /metrics/prometheus
+
+Errors are herodot-shaped JSON: ``{"error": {"code", "status", "message"}}``.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional, Tuple
+from urllib.parse import parse_qs, urlencode, urlparse
+
+from ketotpu.api.types import (
+    BadRequestError,
+    KetoAPIError,
+    NotFoundError,
+    RelationQuery,
+    RelationTuple,
+    SubjectID,
+    SubjectSet,
+)
+from ketotpu.observability import RELATIONTUPLES_CREATED
+
+_STATUS_TEXT = {
+    400: "Bad Request",
+    403: "Forbidden",
+    404: "Not Found",
+    405: "Method Not Allowed",
+    500: "Internal Server Error",
+}
+
+# admin DELETE rejects unknown query params (internal/x/validate, used at
+# transact_server.go:193-199); these are ketoapi.RelationQueryKeys
+_QUERY_KEYS = {
+    "namespace", "object", "relation",
+    "subject_id", "subject_set.namespace", "subject_set.object",
+    "subject_set.relation",
+}
+
+
+def _flatten_query(qs: Dict[str, list]) -> Dict[str, str]:
+    return {k: v[0] for k, v in qs.items() if v}
+
+
+def _max_depth(q: Dict[str, str]) -> int:
+    """x/max_depth.go:13-24 parity incl. the bad-request error text.
+
+    The reference parses with Go's base-0 syntax (strconv.ParseInt(s, 0, 0)):
+    hex "0x10" is 16 and bare leading-zero "010" is octal 8.  Python's
+    int(s, 0) matches except that it rejects the bare-leading-zero octal
+    form as ambiguous, so that case is handled explicitly."""
+    if "max-depth" not in q:
+        return 0
+    s = q["max-depth"]
+    try:
+        return int(s, 0)
+    except ValueError:
+        core = s.lstrip("+-")
+        if core.startswith("0") and core.isdigit():
+            try:
+                v = int(core, 8)
+            except ValueError:  # "089": invalid octal in Go base-0 too
+                pass
+            else:
+                return -v if s.startswith("-") else v
+        raise BadRequestError(
+            f"unable to parse 'max-depth' query parameter to int: "
+            f"invalid syntax {s!r}"
+        ) from None
+
+
+class Router:
+    """Method+path exact-match routing table shared by all ports."""
+
+    def __init__(self, registry, endpoint: str):
+        self.r = registry
+        self.endpoint = endpoint
+        self.routes: Dict[Tuple[str, str], Callable] = {}
+        self._register_common()
+
+    def add(self, method: str, path: str, fn: Callable) -> None:
+        self.routes[(method, path)] = fn
+
+    # -- common routes (healthx + metrics on every router) -------------------
+
+    def _register_common(self) -> None:
+        self.add("GET", "/health/alive", self._alive)
+        self.add("GET", "/health/ready", self._ready)
+        self.add("GET", "/version", self._version)
+        self.add("GET", "/metrics/prometheus", self._metrics)
+
+    def _alive(self, req) -> Tuple[int, object]:
+        return 200, {"status": "ok"}
+
+    def _ready(self, req) -> Tuple[int, object]:
+        health = self.r.health()
+        errors = {k: v for k, v in health.items() if v != "ok"}
+        if errors:
+            return 503, {"errors": errors}
+        return 200, {"status": "ok"}
+
+    def _version(self, req) -> Tuple[int, object]:
+        return 200, {"version": self.r.version}
+
+    def _metrics(self, req) -> Tuple[int, object]:
+        return 200, ("text/plain; version=0.0.4", self.r.metrics().exposition())
+
+    # -- dispatch ------------------------------------------------------------
+
+    def dispatch(self, method: str, path: str, req) -> Tuple[int, object, Dict]:
+        fn = self.routes.get((method, path))
+        if fn is None:
+            known_methods = [m for (m, p) in self.routes if p == path]
+            if known_methods:
+                return 405, _error_body(405, "method not allowed"), {}
+            return 404, _error_body(404, "route not found"), {}
+        try:
+            out = fn(req)
+            if len(out) == 2:
+                status, body = out
+                headers: Dict[str, str] = {}
+            else:
+                status, body, headers = out
+            return status, body, headers
+        except KetoAPIError as e:
+            code = e.status_code or 500
+            return code, _error_body(code, str(e)), {}
+        except Exception as e:  # noqa: BLE001 - the panic-recovery interceptor
+            self.r.logger().exception("handler panic: %s", e)
+            return 500, _error_body(500, str(e)), {}
+
+
+def _error_body(code: int, message: str) -> dict:
+    return {
+        "error": {
+            "code": code,
+            "status": _STATUS_TEXT.get(code, "error"),
+            "message": message,
+        }
+    }
+
+
+class Request:
+    """Parsed request handed to route functions."""
+
+    def __init__(self, query: Dict[str, str], body: bytes):
+        self.query = query
+        self.body = body
+
+    def json(self):
+        try:
+            return json.loads(self.body.decode("utf-8") or "null")
+        except (ValueError, UnicodeDecodeError) as e:
+            raise BadRequestError(f"could not unmarshal json: {e}") from None
+
+
+# -- route construction per port ---------------------------------------------
+
+
+def read_router(registry) -> Router:
+    from ketotpu.server.handlers import (
+        CheckHandler,
+        ExpandHandler,
+        NamespaceHandler,
+        RelationTupleHandler,
+    )
+
+    rt = Router(registry, "read")
+    check = CheckHandler(registry)
+    expand = ExpandHandler(registry)
+    tuples = RelationTupleHandler(registry)
+    namespaces = NamespaceHandler(registry)
+
+    def get_check(mirror: bool):
+        def handler(req):
+            tuple_ = RelationTuple.from_url_query(req.query)
+            allowed = check.check_rest(tuple_, _max_depth(req.query))
+            status = 403 if (mirror and not allowed) else 200
+            return status, {"allowed": allowed}
+
+        return handler
+
+    def post_check(mirror: bool):
+        def handler(req):
+            tuple_ = RelationTuple.from_json(req.json() or {})
+            allowed = check.check_rest(tuple_, _max_depth(req.query))
+            status = 403 if (mirror and not allowed) else 200
+            return status, {"allowed": allowed}
+
+        return handler
+
+    rt.add("GET", "/relation-tuples/check", get_check(mirror=True))
+    rt.add("POST", "/relation-tuples/check", post_check(mirror=True))
+    rt.add("GET", "/relation-tuples/check/openapi", get_check(mirror=False))
+    rt.add("POST", "/relation-tuples/check/openapi", post_check(mirror=False))
+
+    def get_expand(req):
+        subject = SubjectSet(
+            namespace=req.query.get("namespace", ""),
+            object=req.query.get("object", ""),
+            relation=req.query.get("relation", ""),
+        )
+        tree = expand.expand_core(subject, _max_depth(req.query))
+        if tree is None:
+            return 404, _error_body(404, "no relation tuple found")
+        return 200, tree.to_json()
+
+    rt.add("GET", "/relation-tuples/expand", get_expand)
+
+    def get_relations(req):
+        query = RelationQuery.from_url_query(req.query)
+        page_size = 0
+        if "page_size" in req.query:
+            try:
+                page_size = int(req.query["page_size"])
+            except ValueError as e:
+                raise BadRequestError(str(e)) from None
+        out, next_token = tuples.list_core(
+            query, page_size, req.query.get("page_token", "")
+        )
+        return 200, {
+            "relation_tuples": [t.to_json() for t in out],
+            "next_page_token": next_token,
+        }
+
+    rt.add("GET", "/relation-tuples", get_relations)
+
+    def get_namespaces(req):
+        return 200, {
+            "namespaces": [{"name": ns.name} for ns in namespaces.list_core()]
+        }
+
+    rt.add("GET", "/namespaces", get_namespaces)
+    return rt
+
+
+def write_router(registry) -> Router:
+    from ketotpu.server.handlers import RelationTupleHandler
+
+    rt = Router(registry, "write")
+    tuples = RelationTupleHandler(registry)
+
+    def put_tuple(req):
+        tuple_ = RelationTuple.from_json(req.json() or {})
+        tuples.transact_core([tuple_], [])
+        registry.tracer().event(RELATIONTUPLES_CREATED)
+        # urlencode: raw values in a header invite response splitting
+        location = "/relation-tuples?" + urlencode(tuple_.to_url_query())
+        return 201, tuple_.to_json(), {"Location": location}
+
+    def delete_tuples(req):
+        # validate.All parity (transact_server.go:193-199)
+        extra = set(req.query) - _QUERY_KEYS
+        if extra:
+            raise BadRequestError(
+                f"unexpected query parameters: {sorted(extra)}"
+            )
+        if "namespace" not in req.query:
+            raise BadRequestError("required query parameter 'namespace' is missing")
+        if req.body:
+            raise BadRequestError("the request body must be empty")
+        query = RelationQuery.from_url_query(req.query)
+        tuples.delete_all_core(query)
+        return 204, None
+
+    def patch_tuples(req):
+        deltas = req.json()
+        if not isinstance(deltas, list):
+            raise BadRequestError("expected a JSON list of patch deltas")
+        inserts, deletes = [], []
+        for d in deltas:
+            if not isinstance(d, dict) or d.get("relation_tuple") is None:
+                raise BadRequestError("relation_tuple is missing")
+            t = RelationTuple.from_json(d["relation_tuple"])
+            action = d.get("action")
+            if action == "insert":
+                inserts.append(t)
+            elif action == "delete":
+                deletes.append(t)
+            else:
+                raise BadRequestError(f"unknown action {action}")
+        tuples.transact_core(inserts, deletes)
+        return 204, None
+
+    rt.add("PUT", "/admin/relation-tuples", put_tuple)
+    rt.add("DELETE", "/admin/relation-tuples", delete_tuples)
+    rt.add("PATCH", "/admin/relation-tuples", patch_tuples)
+    return rt
+
+
+def opl_router(registry) -> Router:
+    from ketotpu.server.handlers import SyntaxHandler
+
+    rt = Router(registry, "opl")
+    syntax = SyntaxHandler(registry)
+
+    def post_syntax(req):
+        errors = syntax.check_core(req.body)
+        return 200, {"errors": [e.to_json() for e in errors]}
+
+    rt.add("POST", "/opl/syntax/check", post_syntax)
+    return rt
+
+
+def metrics_router(registry) -> Router:
+    return Router(registry, "metrics")
+
+
+# -- HTTP server ------------------------------------------------------------
+
+
+def make_http_server(router: Router, host: str, port: int) -> ThreadingHTTPServer:
+    registry = router.r
+    logger = registry.logger()
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def _serve(self, method: str):
+            t0 = time.perf_counter()
+            parsed = urlparse(self.path)
+            query = _flatten_query(parse_qs(parsed.query))
+            length = int(self.headers.get("Content-Length") or 0)
+            body = self.rfile.read(length) if length else b""
+            status, payload, extra = router.dispatch(
+                method, parsed.path, Request(query, body)
+            )
+            if payload is None:
+                data = b""
+                ctype = "application/json"
+            elif isinstance(payload, tuple):
+                ctype, text = payload
+                data = text.encode("utf-8")
+            else:
+                ctype = "application/json"
+                data = json.dumps(payload).encode("utf-8")
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(data)))
+            for k, v in extra.items():
+                self.send_header(k, v)
+            self.end_headers()
+            if data:
+                self.wfile.write(data)
+            dt = time.perf_counter() - t0
+            registry.metrics().observe(
+                "keto_http_request_duration_seconds", dt,
+                help="REST request latency",
+                endpoint=router.endpoint, method=method,
+                status=str(status),
+            )
+            if parsed.path not in ("/health/alive", "/health/ready"):
+                logger.debug(
+                    "%s %s -> %d (%.1fms)", method, parsed.path, status, dt * 1e3
+                )
+
+        def do_GET(self):
+            self._serve("GET")
+
+        def do_POST(self):
+            self._serve("POST")
+
+        def do_PUT(self):
+            self._serve("PUT")
+
+        def do_DELETE(self):
+            self._serve("DELETE")
+
+        def do_PATCH(self):
+            self._serve("PATCH")
+
+        def log_message(self, fmt, *args):  # route through the logger
+            pass
+
+    server = ThreadingHTTPServer((host, port), Handler)
+    server.daemon_threads = True
+    return server

@@ -5,4 +5,5 @@ cd "$(dirname "$0")/.."
 protoc -Iproto -I/usr/include \
   --python_out=ketotpu/proto \
   proto/ory/keto/relation_tuples/v1alpha2/*.proto \
-  proto/ory/keto/opl/v1alpha1/*.proto
+  proto/ory/keto/opl/v1alpha1/*.proto \
+  proto/health/v1/health.proto

@@ -1,0 +1,84 @@
+"""Acceptance test: the cat-videos example end to end (BASELINE config #1).
+
+Boots the daemon from the vendored `keto.yml` (legacy literal-namespace
+config flavor), loads the example's relation-tuple JSON files through the
+CLI transport, and checks the example's documented outcomes over REST —
+including the `*` wildcard subject tuple.
+"""
+
+import json
+import pathlib
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from ketotpu import cli
+from ketotpu.api.types import RelationTuple
+from ketotpu.driver import Provider, Registry
+from ketotpu.server import serve_all
+
+CAT_VIDEOS = pathlib.Path(__file__).parent / "fixtures" / "cat-videos"
+
+
+@pytest.fixture(scope="module")
+def server():
+    cfg = Provider(
+        {
+            "serve": {
+                n: {"host": "127.0.0.1", "port": 0}
+                for n in ("read", "write", "metrics", "opl")
+            },
+            "engine": {"kind": "oracle"},
+        },
+        config_file=str(CAT_VIDEOS / "keto.yml"),
+    )
+    assert cfg.namespaces_config() == [{"id": 0, "name": "videos"}]
+    srv = serve_all(Registry(cfg).init())
+    yield srv
+    srv.stop()
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def test_load_tuples_via_cli_and_check_via_rest(server):
+    write = "%s:%d" % tuple(server.addresses["write"])
+    read = "http://%s:%d" % tuple(server.addresses["read"])
+    rc = cli.main(
+        [
+            "relation-tuple", "create",
+            str(CAT_VIDEOS / "relation-tuples"),
+            "--write-remote", write,
+        ]
+    )
+    assert rc == 0
+
+    cases = [
+        ("videos:/cats/1.mp4#view@*", True),  # public wildcard subject
+        ("videos:/cats/1.mp4#owner@cat lady", True),  # via /cats#owner
+        ("videos:/cats/2.mp4#view@cat lady", True),  # owner subject-set chain
+        ("videos:/cats/2.mp4#view@dog lady", False),
+    ]
+    for case, want in cases:
+        t = RelationTuple.from_string(case)
+        q = urllib.parse.urlencode(t.to_url_query())
+        status, body = _get(f"{read}/relation-tuples/check/openapi?{q}")
+        assert status == 200, body
+        assert json.loads(body)["allowed"] is want, case
+
+
+def test_wildcard_is_literal_not_glob(server):
+    # '*' is a plain subject string at this version, not a glob: only
+    # tuples that literally contain it match
+    read = "http://%s:%d" % tuple(server.addresses["read"])
+    t = RelationTuple.from_string("videos:/cats/2.mp4#view@*")
+    q = urllib.parse.urlencode(t.to_url_query())
+    status, body = _get(f"{read}/relation-tuples/check/openapi?{q}")
+    assert json.loads(body)["allowed"] is False

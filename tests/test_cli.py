@@ -1,0 +1,202 @@
+"""CLI tests: the third transport of the e2e matrix (cmd/* parity).
+
+Runs `ketotpu.cli.main` in-process against a live daemon, like the
+reference e2e suite's cobra-executor client (`internal/e2e/cli_client.go`).
+"""
+
+import json
+import pathlib
+
+import pytest
+
+from ketotpu import cli
+from ketotpu.api.types import RelationTuple
+from ketotpu.driver import Provider, Registry
+from ketotpu.server import serve_all
+
+FIXTURES = pathlib.Path(__file__).parent / "fixtures"
+
+
+@pytest.fixture(scope="module")
+def server():
+    cfg = Provider(
+        {
+            "serve": {
+                n: {"host": "127.0.0.1", "port": 0}
+                for n in ("read", "write", "metrics", "opl")
+            },
+            "namespaces": {
+                "location": str(FIXTURES / "rewrites_namespaces.keto.ts")
+            },
+            "engine": {"kind": "oracle"},
+        }
+    )
+    reg = Registry(cfg).init()
+    reg.store().write_relation_tuples(
+        *[
+            RelationTuple.from_string(s)
+            for s in [
+                "Group:admin#members@alice",
+                "Folder:root#viewers@Group:admin#members",
+                "File:doc#parents@Folder:root",
+            ]
+        ]
+    )
+    srv = serve_all(reg)
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture
+def remotes(server):
+    read = "%s:%d" % tuple(server.addresses["read"])
+    write = "%s:%d" % tuple(server.addresses["write"])
+    return read, write
+
+
+def test_check_allowed_and_denied(server, remotes, capsys):
+    read, _ = remotes
+    rc = cli.main(
+        ["check", "alice", "view", "File", "doc", "--read-remote", read]
+    )
+    assert rc == 0
+    assert capsys.readouterr().out.strip() == "Allowed"
+    rc = cli.main(
+        ["check", "mallory", "view", "File", "doc", "--read-remote", read]
+    )
+    assert rc == 1
+    assert capsys.readouterr().out.strip() == "Denied"
+
+
+def test_check_subject_set_argument(server, remotes, capsys):
+    read, _ = remotes
+    rc = cli.main(
+        [
+            "check",
+            "Group:admin#members",
+            "viewers",
+            "Folder",
+            "root",
+            "--read-remote",
+            read,
+        ]
+    )
+    assert rc == 0
+    assert capsys.readouterr().out.strip() == "Allowed"
+
+
+def test_expand_prints_tree(server, remotes, capsys):
+    read, _ = remotes
+    rc = cli.main(
+        ["expand", "viewers", "Folder", "root", "--read-remote", read]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "alice" in out
+
+
+def test_relation_tuple_parse(capsys):
+    rc = cli.main(["relation-tuple", "parse", "Group:admin#members@alice"])
+    assert rc == 0
+    parsed = json.loads(capsys.readouterr().out)
+    assert parsed == {
+        "namespace": "Group",
+        "object": "admin",
+        "relation": "members",
+        "subject_id": "alice",
+    }
+
+
+def test_relation_tuple_create_get_delete(server, remotes, tmp_path, capsys):
+    read, write = remotes
+    f = tmp_path / "t.json"
+    f.write_text(
+        json.dumps(
+            {
+                "namespace": "Group",
+                "object": "cli",
+                "relation": "members",
+                "subject_id": "carl",
+            }
+        )
+    )
+    assert (
+        cli.main(
+            ["relation-tuple", "create", str(f), "--write-remote", write]
+        )
+        == 0
+    )
+    capsys.readouterr()
+    assert (
+        cli.main(
+            [
+                "relation-tuple", "get", "--namespace", "Group",
+                "--object", "cli", "--format", "json",
+                "--read-remote", read,
+            ]
+        )
+        == 0
+    )
+    got = json.loads(capsys.readouterr().out)
+    assert len(got["relation_tuples"]) == 1
+    assert (
+        cli.main(
+            ["relation-tuple", "delete", str(f), "--write-remote", write]
+        )
+        == 0
+    )
+    capsys.readouterr()
+    cli.main(
+        [
+            "relation-tuple", "get", "--namespace", "Group",
+            "--object", "cli", "--format", "json", "--read-remote", read,
+        ]
+    )
+    assert json.loads(capsys.readouterr().out)["relation_tuples"] == []
+
+
+def test_relation_tuple_delete_all_requires_force(server, remotes, capsys):
+    _, write = remotes
+    rc = cli.main(
+        [
+            "relation-tuple", "delete-all", "--namespace", "Group",
+            "--object", "nope", "--write-remote", write,
+        ]
+    )
+    assert rc == 1  # refused without --force
+    rc = cli.main(
+        [
+            "relation-tuple", "delete-all", "--namespace", "Group",
+            "--object", "nope", "--force", "--write-remote", write,
+        ]
+    )
+    assert rc == 0
+
+
+def test_namespace_validate(capsys):
+    rc = cli.main(
+        ["namespace", "validate", str(FIXTURES / "rewrites_namespaces.keto.ts")]
+    )
+    assert rc == 0
+    assert "OK" in capsys.readouterr().out
+
+
+def test_namespace_validate_reports_errors(tmp_path, capsys):
+    bad = tmp_path / "bad.ts"
+    bad.write_text("class {{ nope")
+    rc = cli.main(["namespace", "validate", str(bad)])
+    assert rc == 1
+
+
+def test_status(server, remotes, capsys):
+    read, _ = remotes
+    rc = cli.main(["status", "--read-remote", read])
+    assert rc == 0
+    assert "SERVING" in capsys.readouterr().out
+
+
+def test_version(capsys):
+    import ketotpu
+
+    assert cli.main(["version"]) == 0
+    assert capsys.readouterr().out.strip() == ketotpu.__version__

@@ -1,0 +1,103 @@
+"""Config provider + registry tests (`internal/driver/config/provider_test.go`
+and `registry_default.go` behaviors)."""
+
+import pytest
+
+from ketotpu.driver import ConfigError, Provider, Registry
+from ketotpu.engine.oracle import CheckEngine
+from ketotpu.engine.tpu import DeviceCheckEngine
+
+
+def test_defaults_match_reference_schema():
+    # embedx/config.schema.json:368-383 defaults
+    p = Provider()
+    assert p.max_read_depth() == 5
+    assert p.max_read_width() == 100
+    assert p.listen_on("read") == ("127.0.0.1", 4466)
+    assert p.listen_on("write") == ("127.0.0.1", 4467)
+    assert p.listen_on("metrics") == ("127.0.0.1", 4468)
+    assert p.listen_on("opl") == ("127.0.0.1", 4469)
+    assert p.dsn() == "memory"
+    assert p.strict_mode() is False
+
+
+def test_validation_errors_carry_key_paths():
+    with pytest.raises(ConfigError) as e:
+        Provider({"serve": {"read": {"port": "nope"}}})
+    assert "serve.read.port" in str(e.value)
+    with pytest.raises(ConfigError) as e:
+        Provider({"limit": {"max_read_depth": 0}})
+    assert "limit.max_read_depth" in str(e.value)
+    with pytest.raises(ConfigError) as e:
+        Provider({"engine": {"kind": "gpu"}})
+    assert "engine.kind" in str(e.value)
+    with pytest.raises(ConfigError):
+        Provider({"namespaces": [{"nope": 1}]})
+
+
+def test_immutable_keys_refuse_runtime_set():
+    # provider.go:92-111: dsn and serve are immutable
+    p = Provider()
+    with pytest.raises(ConfigError):
+        p.set("dsn", "other")
+    with pytest.raises(ConfigError):
+        p.set("serve.read.port", 1)
+    p.set("limit.max_read_depth", 7)
+    assert p.max_read_depth() == 7
+
+
+def test_change_listener_fires():
+    p = Provider()
+    seen = []
+    p.on_change(seen.append)
+    p.set("limit.max_read_width", 50)
+    assert seen == ["limit.max_read_width"]
+
+
+def test_env_overrides(monkeypatch):
+    p = Provider(env={"KETO_SERVE_READ_PORT": "14466",
+                      "KETO_LIMIT_MAX_READ_DEPTH": "9"})
+    assert p.listen_on("read") == ("127.0.0.1", 14466)
+    assert p.max_read_depth() == 9
+
+
+def test_yaml_config_file(tmp_path):
+    f = tmp_path / "keto.yml"
+    f.write_text(
+        "namespaces:\n  - id: 0\n    name: videos\ndsn: memory\n"
+        "serve:\n  read:\n    port: 14466\n"
+    )
+    p = Provider(config_file=str(f))
+    assert p.listen_on("read") == ("127.0.0.1", 14466)
+    assert p.namespaces_config() == [{"id": 0, "name": "videos"}]
+
+
+def test_registry_engine_seam():
+    # the check.EngineProvider seam (engine.go:29-31): config swaps engines
+    r = Registry(Provider({"engine": {"kind": "oracle"}}))
+    assert isinstance(r.check_engine(), CheckEngine)
+    r2 = Registry(Provider())
+    assert isinstance(r2.check_engine(), DeviceCheckEngine)
+    # lazy singletons
+    assert r2.check_engine() is r2.check_engine()
+    assert r2.store() is r2.store()
+
+
+def test_registry_namespace_flavors(tmp_path):
+    # literal list flavor
+    r = Registry(Provider({"namespaces": [{"name": "videos"}]}))
+    assert [n.name for n in r.namespace_manager().namespaces()] == ["videos"]
+    # OPL file flavor ({location} mapping, provider.go:311-342)
+    opl = tmp_path / "ns.ts"
+    opl.write_text(
+        'import { Namespace } from "@ory/keto-namespace-types"\n'
+        "class User implements Namespace {}\n"
+    )
+    r2 = Registry(Provider({"namespaces": {"location": f"file://{opl}"}}))
+    assert [n.name for n in r2.namespace_manager().namespaces()] == ["User"]
+
+
+def test_registry_readiness_checks():
+    boom = {"db": lambda: (_ for _ in ()).throw(RuntimeError("down"))}
+    r = Registry(Provider(), readiness_checks=boom)
+    assert r.health() == {"db": "down"}

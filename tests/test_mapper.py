@@ -1,0 +1,101 @@
+"""Tuple-level Mapper tests (`internal/relationtuple/uuid_mapping_test.go`
+behaviors: batched round-trips, unknown-namespace NotFound)."""
+
+import uuid
+
+import pytest
+
+from ketotpu.api.mapper import (
+    InternalSubjectID,
+    InternalSubjectSet,
+    Mapper,
+)
+from ketotpu.api.types import (
+    NotFoundError,
+    RelationQuery,
+    RelationTuple,
+    SubjectID,
+    SubjectSet,
+    Tree,
+    TreeNodeType,
+)
+from ketotpu.api.uuid_map import UUIDMapper, reset_shared_stores
+from ketotpu.opl.ast import Namespace
+from ketotpu.storage.namespaces import StaticNamespaceManager
+
+NET = uuid.UUID("00000000-0000-0000-0000-000000000001")
+
+
+@pytest.fixture
+def mapper():
+    reset_shared_stores()
+    nm = StaticNamespaceManager([Namespace("files"), Namespace("groups")])
+    return Mapper(UUIDMapper(NET), nm)
+
+
+def test_from_tuple_round_trip(mapper):
+    t = RelationTuple("files", "f1", "view", SubjectID("alice"))
+    (it,) = mapper.from_tuple(t)
+    assert it.namespace == "files" and it.relation == "view"
+    assert isinstance(it.object, uuid.UUID)
+    assert isinstance(it.subject, InternalSubjectID)
+    # deterministic UUIDv5 (sql/uuid_mapping.go:44)
+    assert it.object == uuid.uuid5(NET, "f1")
+    (back,) = mapper.to_tuple(it)
+    assert back == t
+
+
+def test_from_tuple_subject_set_and_batching(mapper):
+    ts = [
+        RelationTuple(
+            "files", "f1", "view", SubjectSet("groups", "admin", "member")
+        ),
+        RelationTuple("files", "f2", "edit", SubjectID("bob")),
+    ]
+    its = mapper.from_tuple(*ts)
+    assert isinstance(its[0].subject, InternalSubjectSet)
+    assert its[0].subject.namespace == "groups"
+    assert mapper.to_tuple(*its) == ts
+
+
+def test_from_tuple_unknown_namespace_raises_not_found(mapper):
+    # the herodot.ErrNotFound the REST check handler swallows
+    # (check/handler.go:169-171)
+    with pytest.raises(NotFoundError):
+        mapper.from_tuple(
+            RelationTuple("nope", "o", "r", SubjectID("s"))
+        )
+    with pytest.raises(NotFoundError):
+        mapper.from_tuple(
+            RelationTuple("files", "o", "r", SubjectSet("nope", "x", "y"))
+        )
+
+
+def test_from_query_partial_fields(mapper):
+    q = RelationQuery(namespace="files", relation="view")
+    iq = mapper.from_query(q)
+    assert iq.namespace == "files" and iq.object is None
+    q2 = RelationQuery(namespace="files", object="f1").with_subject(
+        SubjectSet("groups", "admin", "member")
+    )
+    iq2 = mapper.from_query(q2)
+    assert iq2.object == uuid.uuid5(NET, "f1")
+    assert isinstance(iq2.subject, InternalSubjectSet)
+
+
+def test_to_tree_resolves_uuid_labels(mapper):
+    u_obj = str(mapper.uuids.to_uuid("f1"))
+    u_subj = str(mapper.uuids.to_uuid("alice"))
+    tree = Tree(
+        type=TreeNodeType.LEAF,
+        tuple=RelationTuple("files", u_obj, "view", SubjectID(u_subj)),
+    )
+    out = mapper.to_tree(tree)
+    assert out.tuple.object == "f1"
+    assert out.tuple.subject == SubjectID("alice")
+    # non-UUID strings pass through
+    plain = Tree(
+        type=TreeNodeType.LEAF,
+        tuple=RelationTuple("files", "f1", "view", SubjectID("alice")),
+    )
+    assert mapper.to_tree(plain).tuple.object == "f1"

@@ -18,11 +18,9 @@ from ketotpu.opl import (
 )
 from ketotpu.opl.parser import simplify_expression
 
-REFERENCE = Path("/root/reference")
-
-pytestmark_needs_reference = pytest.mark.skipif(
-    not REFERENCE.exists(), reason="reference checkout not mounted"
-)
+# Acceptance fixtures are vendored into tests/fixtures (SURVEY §2 Examples
+# row) so this suite never skips when the reference checkout is unmounted.
+FIXTURES = Path(__file__).parent / "fixtures"
 
 
 def parse_ok(src):
@@ -32,9 +30,8 @@ def parse_ok(src):
 
 
 class TestFixtures:
-    @pytestmark_needs_reference
     def test_rewrites_example(self):
-        src = (REFERENCE / "contrib/rewrites-example/namespaces.keto.ts").read_text()
+        src = (FIXTURES / "rewrites_namespaces.keto.ts").read_text()
         ns = parse_ok(src)
         assert set(ns) == {"User", "Group", "Folder", "File"}
 
@@ -70,9 +67,8 @@ class TestFixtures:
             ComputedSubjectSet("owners")
         ]
 
-    @pytestmark_needs_reference
     def test_project_opl_fixture(self):
-        src = (REFERENCE / "internal/check/testfixtures/project_opl.ts").read_text()
+        src = (FIXTURES / "project_opl.ts").read_text()
         ns = parse_ok(src)
         assert set(ns) == {"User", "Project"}
         project = ns["Project"]

@@ -1,0 +1,382 @@
+"""E2E serving tests: boot the real daemon, same cases over REST and gRPC.
+
+The reference's e2e suite runs one case list through four transports
+(`internal/e2e/full_suit_test.go:51-130`); here the matrix is REST + gRPC
+(the CLI transport is exercised in tests/test_cli.py).  Fixtures are the
+vendored cat-videos example (direct tuples + wildcard subject) and the
+rewrites example OPL (subject-set rewrites), the two acceptance configs of
+BASELINE.json.
+"""
+
+import json
+import pathlib
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import grpc
+import pytest
+
+from ketotpu.api.types import RelationTuple
+from ketotpu.driver import Provider, Registry
+from ketotpu.proto import (
+    check_service_pb2 as cs,
+)
+from ketotpu.proto import (
+    expand_service_pb2 as es,
+)
+from ketotpu.proto import (
+    read_service_pb2 as rs,
+)
+from ketotpu.proto import (
+    relation_tuples_pb2 as rts,
+)
+from ketotpu.proto import (
+    write_service_pb2 as ws,
+)
+from ketotpu.proto.services import (
+    CheckServiceStub,
+    ExpandServiceStub,
+    ReadServiceStub,
+    WriteServiceStub,
+)
+from ketotpu.server import serve_all
+
+FIXTURES = pathlib.Path(__file__).parent / "fixtures"
+
+
+def _http(method, url, body=None, headers=None):
+    req = urllib.request.Request(
+        url, data=body, method=method, headers=headers or {}
+    )
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+@pytest.fixture(scope="module")
+def server():
+    cfg = Provider(
+        {
+            "serve": {
+                n: {"host": "127.0.0.1", "port": 0}
+                for n in ("read", "write", "metrics", "opl")
+            },
+            "namespaces": {
+                "location": str(FIXTURES / "rewrites_namespaces.keto.ts")
+            },
+            "engine": {
+                "kind": "tpu",
+                "frontier": 1024,
+                "arena": 4096,
+                "max_batch": 256,
+                "retry_scale": 4,
+                "mesh_devices": 0,
+                "mesh_axis": "shard",
+            },
+        }
+    )
+    reg = Registry(cfg).init()
+    srv = serve_all(reg)
+    # seed the rewrites-example graph shape (contrib/rewrites-example)
+    reg.store().write_relation_tuples(
+        *[
+            RelationTuple.from_string(s)
+            for s in [
+                "Group:admin#members@alice",
+                "Group:dev#members@bob",
+                "Folder:keto#viewers@Group:dev#members",
+                "File:keto/README.md#parents@Folder:keto",
+                "File:private#owners@alice",
+            ]
+        ]
+    )
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture(scope="module")
+def read_addr(server):
+    return "http://%s:%d" % tuple(server.addresses["read"])
+
+
+@pytest.fixture(scope="module")
+def write_addr(server):
+    return "http://%s:%d" % tuple(server.addresses["write"])
+
+
+@pytest.fixture(scope="module")
+def read_channel(server):
+    ch = grpc.insecure_channel("%s:%d" % tuple(server.addresses["read"]))
+    yield ch
+    ch.close()
+
+
+@pytest.fixture(scope="module")
+def write_channel(server):
+    ch = grpc.insecure_channel("%s:%d" % tuple(server.addresses["write"]))
+    yield ch
+    ch.close()
+
+
+# the shared case list (testcases_test.go analog): (tuple string, allowed)
+CASES = [
+    ("File:keto/README.md#view@bob", True),  # TTU parents -> Folder viewers
+    ("File:keto/README.md#view@alice", False),
+    ("Folder:keto#view@bob", True),  # viewers expansion through Group
+    ("File:private#view@alice", True),  # owners computed userset
+    ("File:private#view@bob", False),
+    ("File:nonexistent#view@bob", False),
+]
+
+
+def _parse_case(s):
+    r = RelationTuple.from_string(s)
+    return r
+
+
+class TestTransportParity:
+    def test_rest_and_grpc_agree(self, read_addr, read_channel):
+        stub = CheckServiceStub(read_channel)
+        for case, want in CASES:
+            r = _parse_case(case)
+            q = urllib.parse.urlencode(r.to_url_query())
+            status, body = _http(
+                "GET", f"{read_addr}/relation-tuples/check/openapi?{q}"
+            )
+            assert status == 200, body
+            rest_allowed = json.loads(body)["allowed"]
+
+            from ketotpu.api.proto_codec import tuple_to_proto
+
+            resp = stub.Check(cs.CheckRequest(tuple=tuple_to_proto(r)))
+            assert rest_allowed == resp.allowed == want, case
+
+    def test_mirror_status_variant(self, read_addr):
+        # /relation-tuples/check mirrors the verdict as 200/403
+        r = _parse_case("File:keto/README.md#view@bob")
+        q = urllib.parse.urlencode(r.to_url_query())
+        status, body = _http("GET", f"{read_addr}/relation-tuples/check?{q}")
+        assert status == 200 and json.loads(body)["allowed"] is True
+        r2 = _parse_case("File:private#view@bob")
+        q2 = urllib.parse.urlencode(r2.to_url_query())
+        status2, body2 = _http("GET", f"{read_addr}/relation-tuples/check?{q2}")
+        assert status2 == 403 and json.loads(body2)["allowed"] is False
+
+    def test_unknown_namespace_rest_false_grpc_not_found(
+        self, read_addr, read_channel
+    ):
+        q = "namespace=Nope&object=o&relation=r&subject_id=s"
+        status, body = _http(
+            "GET", f"{read_addr}/relation-tuples/check/openapi?{q}"
+        )
+        assert status == 200 and json.loads(body)["allowed"] is False
+        stub = CheckServiceStub(read_channel)
+        with pytest.raises(grpc.RpcError) as e:
+            stub.Check(
+                cs.CheckRequest(
+                    tuple=rts.RelationTuple(
+                        namespace="Nope",
+                        object="o",
+                        relation="r",
+                        subject=rts.Subject(id="s"),
+                    )
+                )
+            )
+        assert e.value.code() == grpc.StatusCode.NOT_FOUND
+
+    def test_post_check_json(self, read_addr):
+        body = json.dumps(
+            _parse_case("Folder:keto#view@bob").to_json()
+        ).encode()
+        status, out = _http(
+            "POST",
+            f"{read_addr}/relation-tuples/check/openapi",
+            body,
+            {"Content-Type": "application/json"},
+        )
+        assert status == 200 and json.loads(out)["allowed"] is True
+
+
+class TestExpand:
+    def test_rest_expand_tree(self, read_addr):
+        status, body = _http(
+            "GET",
+            f"{read_addr}/relation-tuples/expand?"
+            "namespace=Folder&object=keto&relation=viewers&max-depth=3",
+        )
+        assert status == 200
+        tree = json.loads(body)
+        assert tree["type"] == "union"
+        labels = json.dumps(tree)
+        assert "bob" in labels
+
+    def test_rest_expand_404_when_empty(self, read_addr):
+        status, _ = _http(
+            "GET",
+            f"{read_addr}/relation-tuples/expand?"
+            "namespace=Folder&object=none&relation=viewers",
+        )
+        assert status == 404
+
+    def test_grpc_expand_subject_id_leaf(self, read_channel):
+        stub = ExpandServiceStub(read_channel)
+        resp = stub.Expand(
+            es.ExpandRequest(subject=rts.Subject(id="alice"), max_depth=2)
+        )
+        assert resp.tree.node_type == es.NodeType.NODE_TYPE_LEAF
+
+    def test_grpc_expand_tree(self, read_channel):
+        stub = ExpandServiceStub(read_channel)
+        resp = stub.Expand(
+            es.ExpandRequest(
+                subject=rts.Subject(
+                    set=rts.SubjectSet(
+                        namespace="Folder", object="keto", relation="viewers"
+                    )
+                ),
+                max_depth=3,
+            )
+        )
+        assert resp.tree.node_type == es.NodeType.NODE_TYPE_UNION
+
+
+class TestReadWrite:
+    def test_list_with_pagination(self, read_addr, read_channel):
+        status, body = _http(
+            "GET", f"{read_addr}/relation-tuples?namespace=Group&page_size=1"
+        )
+        assert status == 200
+        page = json.loads(body)
+        assert len(page["relation_tuples"]) == 1
+        assert page["next_page_token"]
+        # gRPC agrees
+        stub = ReadServiceStub(read_channel)
+        resp = stub.ListRelationTuples(
+            rs.ListRelationTuplesRequest(
+                relation_query=rts.RelationQuery(namespace="Group"),
+                page_size=10,
+            )
+        )
+        assert len(resp.relation_tuples) == 2
+
+    def test_rest_write_delete_cycle(self, read_addr, write_addr):
+        t = {
+            "namespace": "Group",
+            "object": "tmp",
+            "relation": "members",
+            "subject_id": "zoe",
+        }
+        status, body = _http(
+            "PUT",
+            f"{write_addr}/admin/relation-tuples",
+            json.dumps(t).encode(),
+            {"Content-Type": "application/json"},
+        )
+        assert status == 201, body
+        status, body = _http(
+            "GET", f"{read_addr}/relation-tuples?namespace=Group&object=tmp"
+        )
+        assert len(json.loads(body)["relation_tuples"]) == 1
+        # delete validates query params (transact_server.go:193-199)
+        status, body = _http(
+            "DELETE", f"{write_addr}/admin/relation-tuples?object=tmp"
+        )
+        assert status == 400  # namespace required
+        status, _ = _http(
+            "DELETE",
+            f"{write_addr}/admin/relation-tuples?namespace=Group&object=tmp",
+        )
+        assert status == 204
+        status, body = _http(
+            "GET", f"{read_addr}/relation-tuples?namespace=Group&object=tmp"
+        )
+        assert json.loads(body)["relation_tuples"] == []
+
+    def test_rest_patch_deltas(self, read_addr, write_addr):
+        deltas = [
+            {
+                "action": "insert",
+                "relation_tuple": {
+                    "namespace": "Group",
+                    "object": "patchgrp",
+                    "relation": "members",
+                    "subject_id": "pat",
+                },
+            }
+        ]
+        status, _ = _http(
+            "PATCH",
+            f"{write_addr}/admin/relation-tuples",
+            json.dumps(deltas).encode(),
+            {"Content-Type": "application/json"},
+        )
+        assert status == 204
+        deltas[0]["action"] = "delete"
+        status, _ = _http(
+            "PATCH",
+            f"{write_addr}/admin/relation-tuples",
+            json.dumps(deltas).encode(),
+            {"Content-Type": "application/json"},
+        )
+        assert status == 204
+
+    def test_grpc_transact_returns_real_snaptokens(self, write_channel):
+        stub = WriteServiceStub(write_channel)
+        resp = stub.TransactRelationTuples(
+            ws.TransactRelationTuplesRequest(
+                relation_tuple_deltas=[
+                    ws.RelationTupleDelta(
+                        action=ws.RelationTupleDelta.ACTION_INSERT,
+                        relation_tuple=rts.RelationTuple(
+                            namespace="Group",
+                            object="grpcgrp",
+                            relation="members",
+                            subject=rts.Subject(id="gal"),
+                        ),
+                    )
+                ]
+            )
+        )
+        assert len(resp.snaptokens) == 1
+        assert resp.snaptokens[0].startswith("v")
+        stub.DeleteRelationTuples(
+            ws.DeleteRelationTuplesRequest(
+                relation_query=rts.RelationQuery(
+                    namespace="Group", object="grpcgrp"
+                )
+            )
+        )
+
+
+class TestAuxSurfaces:
+    def test_health_version_metrics(self, server):
+        met = "http://%s:%d" % tuple(server.addresses["metrics"])
+        assert _http("GET", f"{met}/health/alive")[0] == 200
+        assert _http("GET", f"{met}/health/ready")[0] == 200
+        status, body = _http("GET", f"{met}/version")
+        assert status == 200 and "version" in json.loads(body)
+        status, text = _http("GET", f"{met}/metrics/prometheus")
+        assert status == 200
+        assert "keto_checks_total" in text
+        assert "keto_http_request_duration_seconds" in text
+
+    def test_opl_syntax_check(self, server):
+        opl = "http://%s:%d" % tuple(server.addresses["opl"])
+        status, body = _http(
+            "POST", f"{opl}/opl/syntax/check",
+            b"class X implements Namespace {}",
+        )
+        assert status == 200 and json.loads(body)["errors"] == []
+        status, body = _http(
+            "POST", f"{opl}/opl/syntax/check", b"class {{ nope"
+        )
+        errors = json.loads(body)["errors"]
+        assert status == 200 and errors
+        assert {"message", "start", "end"} <= set(errors[0])
+
+    def test_unknown_route_404_known_route_wrong_method_405(self, read_addr):
+        assert _http("GET", f"{read_addr}/nope")[0] == 404
+        assert _http("POST", f"{read_addr}/relation-tuples")[0] == 405
