@@ -1,0 +1,250 @@
+"""Batched device Expand: frontier traversal on TPU, exact DFS replay on host.
+
+The reference's Expand (`internal/expand/engine.go:43-124`) walks one
+subject set's membership recursively, with a *global* visited set shared
+across the whole tree (first DFS occurrence of a subject expands, later
+occurrences render as leaves) and depth truncation.  The shape of the
+output tree therefore depends on DFS order — which a data-parallel BFS
+cannot reproduce directly.
+
+Split the work instead:
+
+* **device** (`run_expand`) — all roots in one fused dispatch: per level,
+  every live item's full member list (the membership CSR built at snapshot
+  time — leaf subjects included, unlike the subject-set-only check CSR) is
+  gathered into arena slots with per-item parent pointers.  Expansion is
+  bounded only by *ancestor* cycles (a per-item ancestor column stack,
+  depth <= max_depth, so the check is a handful of compares) and by depth;
+  no global visited set.  The result is a superset forest: every DFS-
+  reachable subtree is present.
+* **host** (`assemble`) — replays the reference's exact recursion over the
+  device records: global visited set in DFS order, `None`-pruning of empty
+  rows, depth-1 leaf truncation (engine.go:102-106), children in row
+  (insertion/pagination) order.  Ancestor-cycle items the device did not
+  expand are exactly the items the DFS replay prunes via its visited set
+  before looking at their children, so the superset is always sufficient.
+
+Per-root arena overflow surfaces as an ``over`` bit; the engine answers
+those roots with the sequential oracle.  Trees produced here are
+bit-identical to `oracle.ExpandEngine.build_tree` (tests/test_expand_device.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ketotpu.api.types import (
+    RelationTuple,
+    Subject,
+    SubjectID,
+    SubjectSet,
+    Tree,
+    TreeNodeType,
+)
+from ketotpu.engine import fastpath as fp
+from ketotpu.engine.vocab import Vocab
+from ketotpu.engine.xutil import arena_assign
+
+
+def _mem_deg(g, node):
+    ptr = g["mem_row_ptr"]
+    safe = jnp.clip(node, 0, ptr.shape[0] - 2)
+    deg = ptr[safe + 1] - ptr[safe]
+    return jnp.where(node >= 0, deg, 0).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("schedule",))
+def _run_expand(
+    g: Dict[str, jax.Array],
+    r_ns, r_obj, r_rel, r_subj, r_depth,
+    *,
+    schedule: Tuple[int, ...],
+):
+    """One fused dispatch for all levels.  ``schedule[l]`` is the item
+    capacity of level l (level 0 must hold all roots).  Returns per-level
+    item records + per-root overflow flags."""
+    R = r_ns.shape[0]
+    C0 = schedule[0]
+
+    def pad_to(x, n, fill):
+        return jnp.pad(jnp.asarray(x, jnp.int32), (0, n - x.shape[0]),
+                       constant_values=fill)
+
+    node = pad_to(fp._node_lookup(g, r_ns, r_obj, r_rel), C0, -1)
+    d = pad_to(r_depth, C0, 0)
+    subj = pad_to(r_subj, C0, -1)
+    root = pad_to(jnp.arange(R, dtype=jnp.int32), C0, -1)
+    parent = jnp.full((C0,), -1, jnp.int32)
+    live = jnp.arange(C0) < R
+    anc: List[jax.Array] = [jnp.where(live, subj, -2)]  # -2: never matches
+
+    over = jnp.zeros((R,), bool)
+    levels = []
+    for l, cap in enumerate(schedule):
+        deg = jnp.where(live, _mem_deg(g, node), 0)
+        levels.append(dict(parent=parent, subj=subj, node=node, d=d, deg=deg,
+                           root=root, live=live))
+        if l == len(schedule) - 1:
+            break
+        A = schedule[l + 1]
+        counts = jnp.where(live & (d >= 2), deg, 0)
+        offsets, _total, ap, ao = arena_assign(counts, A)
+        fits = offsets + counts <= A
+        rc = jnp.clip(root, 0, R - 1)
+        over = over.at[rc].max(live & (counts > 0) & ~fits)
+
+        C = counts.shape[0]
+        aps = jnp.clip(ap, 0, C - 1)
+        src_ok = (ap >= 0) & fits[aps]
+        mbase = g["mem_row_ptr"][jnp.clip(node[aps], 0,
+                                          g["mem_row_ptr"].shape[0] - 2)]
+        midx = jnp.clip(mbase + ao, 0, g["mem_ord_subj"].shape[0] - 1)
+        c_subj = jnp.where(src_ok, g["mem_ord_subj"][midx], -1)
+        sc = jnp.clip(c_subj, 0, g["sub_ns"].shape[0] - 1)
+        s_ns = jnp.where(c_subj >= 0, g["sub_ns"][sc], -1)
+        c_is_set = s_ns >= 0
+        c_node = fp._node_lookup(g, s_ns, g["sub_obj"][sc], g["sub_rel"][sc])
+        c_d = jnp.maximum(d[aps] - 1, 0)
+        cyc = jnp.zeros((A,), bool)
+        for a in anc:
+            cyc = cyc | (a[aps] == c_subj)
+        cyc = cyc & c_is_set
+        expandable = src_ok & c_is_set & ~cyc
+
+        parent = jnp.where(src_ok, ap, -1)
+        subj = c_subj
+        node = jnp.where(expandable, c_node, -1)
+        d = c_d
+        root = jnp.where(src_ok, root[aps], -1)
+        live = expandable
+        anc = [jnp.where(src_ok, a[aps], -2) for a in anc]
+        anc.append(jnp.where(src_ok & c_is_set, c_subj, -2))
+    return levels, over
+
+
+def expand_schedule(n_roots: int, fanout: int, max_depth: int,
+                    cap: int) -> Tuple[int, ...]:
+    """Item capacities per level: geometric in the expected fan-out,
+    clamped to ``cap``; misses surface as per-root overflow bits."""
+    out = [n_roots]
+    for _ in range(max_depth - 1):
+        out.append(min(out[-1] * fanout, cap))
+    return tuple(out)
+
+
+class _Decoder:
+    """Reverse vocab: dense ids back to API strings/subjects."""
+
+    def __init__(self, vocab: Vocab):
+        self.ns = vocab.namespaces.strings()
+        self.obj = vocab.objects.strings()
+        self.rel = vocab.relations.strings()
+        self.sub = vocab.subjects.strings()
+
+    def subject(self, subj_id: int, s_ns: int, s_obj: int, s_rel: int) -> Subject:
+        if s_ns >= 0:
+            return SubjectSet(self.ns[s_ns], self.obj[s_obj], self.rel[s_rel])
+        uid = self.sub[subj_id]
+        # unique_id format "id:<subject id>" (api/types.py)
+        return SubjectID(uid[3:] if uid.startswith("id:") else uid)
+
+
+def _leaf(subject: Subject) -> Tree:
+    return Tree(type=TreeNodeType.LEAF,
+                tuple=RelationTuple("", "", "", subject))
+
+
+def assemble(
+    levels: List[Dict[str, np.ndarray]],
+    sub_dec: Tuple[np.ndarray, np.ndarray, np.ndarray],
+    vocab: Vocab,
+    roots: List[SubjectSet],
+) -> List[Optional[Tree]]:
+    """Exact DFS replay of expand/engine.go:54-124 over the device records."""
+    dec = _Decoder(vocab)
+    sub_ns, sub_obj, sub_rel = sub_dec
+    # children of item i at level l: slots of level l+1 with parent == i,
+    # in slot (row insertion) order
+    kids: List[Dict[int, List[int]]] = []
+    for nxt in levels[1:]:
+        by_parent: Dict[int, List[int]] = {}
+        for slot in np.flatnonzero(nxt["parent"] >= 0):
+            by_parent.setdefault(int(nxt["parent"][slot]), []).append(int(slot))
+        kids.append(by_parent)
+
+    out: List[Optional[Tree]] = []
+    for r, root_subject in enumerate(roots):
+        visited = set()
+
+        def build(level: int, slot: int, subject: Subject, depth: int):
+            if isinstance(subject, SubjectID):
+                return _leaf(subject)
+            if subject.unique_id() in visited:
+                return None
+            visited.add(subject.unique_id())
+            if levels[level]["deg"][slot] == 0:
+                return None
+            tree = Tree(type=TreeNodeType.UNION,
+                        tuple=RelationTuple("", "", "", subject))
+            if depth <= 1:
+                tree.type = TreeNodeType.LEAF
+                return tree
+            for cslot in kids[level].get(slot, ()):  # row order
+                rec = levels[level + 1]
+                sid = int(rec["subj"][cslot])
+                child_subject = dec.subject(
+                    sid, int(sub_ns[sid]), int(sub_obj[sid]), int(sub_rel[sid])
+                )
+                child = build(level + 1, cslot, child_subject,
+                              int(rec["d"][cslot]))
+                if child is None:
+                    child = _leaf(child_subject)
+                tree.children.append(child)
+            return tree
+
+        out.append(build(0, r, root_subject, int(levels[0]["d"][r])))
+    return out
+
+
+def run_expand(
+    g: Dict[str, jax.Array],
+    snap,
+    roots: List[SubjectSet],
+    rest_depth: int,
+    *,
+    max_depth: int = 5,
+    fanout: int = 16,
+    cap: int = 65536,
+):
+    """Device traversal + host assembly for a batch of subject-set roots.
+
+    Returns ``(trees, over)``: per-root Optional[Tree] (None = prune/404)
+    and per-root overflow flags (True = answer with the oracle instead).
+    """
+    vocab = snap.vocab
+    if rest_depth <= 0 or max_depth < rest_depth:
+        rest_depth = max_depth
+    R = len(roots)
+    r_ns = np.fromiter((vocab.namespaces.lookup(s.namespace) for s in roots),
+                       np.int32, R)
+    r_obj = np.fromiter((vocab.objects.lookup(s.object) for s in roots),
+                        np.int32, R)
+    r_rel = np.fromiter((vocab.relations.lookup(s.relation) for s in roots),
+                        np.int32, R)
+    r_subj = np.fromiter((vocab.subject_key(s) for s in roots), np.int32, R)
+    r_depth = np.full(R, rest_depth, np.int32)
+    sched = expand_schedule(R, fanout, rest_depth, cap)
+    levels, over = _run_expand(
+        g, r_ns, r_obj, r_rel, r_subj, r_depth, schedule=sched
+    )
+    levels = [{k: np.asarray(v) for k, v in lvl.items()} for lvl in levels]
+    over = np.asarray(over)
+    trees = assemble(
+        levels, (snap.sub_ns, snap.sub_obj, snap.sub_rel), vocab, roots
+    )
+    return trees, over

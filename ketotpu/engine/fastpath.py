@@ -39,12 +39,14 @@ The step is split into two phases so the graph-sharded runner
 * ``pack_phase`` — per-(query, node) dedup/merge + compaction into the next
   frontier.
 
-In sharded mode the expansion EXISTS bit cannot be tested at the parent (the
-target row lives on the owner shard of the child's object), so expansion
-children carry a ``force`` flag: the owner probes membership on arrival,
-regardless of depth — including width-truncated children, which ship as
-probe-only items (depth 0) so the pre-truncation EXISTS semantics survive
-sharding exactly.
+The expansion EXISTS bit is tested at the CHILD's level, not the parent's:
+expansion children carry a ``force`` flag and their own self-membership
+probe fires on arrival regardless of depth — including width-truncated
+children, which ship as probe-only items (depth 0) so the pre-truncation
+EXISTS semantics survive.  This replaces an arena-sized member probe at
+the parent with a frontier-sized one a level later (cheaper), and it is
+the only formulation that shards: the target row lives on the owner shard
+of the child's object, so only the owner can probe it.
 
 Exploration order differs from the sequential oracle in one deliberate way:
 instead of the oracle's per-expansion-subtree visited sets (DFS order,
@@ -63,13 +65,14 @@ oracle run (tests/test_fastpath.py).
 from __future__ import annotations
 
 import functools
-from typing import Dict, NamedTuple, Tuple
+from typing import Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ketotpu.engine import hashtab
+from ketotpu.engine.delta import OV_ADDED, OV_DELETED
 from ketotpu.engine.xutil import arena_assign
 
 _I32MAX = jnp.iinfo(jnp.int32).max
@@ -80,6 +83,9 @@ ITEM_COLS = ("qid", "ns", "obj", "rel", "d", "skip", "force")
 class FastResult(NamedTuple):
     found: jax.Array  # bool[Q]: membership established (monotone)
     over: jax.Array  # bool[Q]: capacity overflow touched this query
+    # bool[Q]: exploration read a CSR row the delta overlay marked dirty —
+    # the verdict must come from the host oracle (None without an overlay)
+    dirty: Optional[jax.Array] = None
 
 
 def _tab(g: Dict[str, jax.Array], prefix: str) -> Dict[str, jax.Array]:
@@ -87,18 +93,42 @@ def _tab(g: Dict[str, jax.Array], prefix: str) -> Dict[str, jax.Array]:
 
 
 def _node_lookup(g: Dict[str, jax.Array], ns, obj, rel):
-    """(ns, obj, rel) -> node id or -1.  Stride = padded relation count."""
+    """(ns, obj, rel) -> node id or -1.  Stride = padded relation count.
+    With a delta overlay, nodes created since the base snapshot resolve to
+    virtual ids (>= base node count) through the ``ovt_`` table."""
     num_rels = g["f_direct_ok"].shape[1]
     hi = ns * num_rels + rel
+    ok = (ns >= 0) & (obj >= 0) & (rel >= 0)
     idx, found = hashtab.lookup(_tab(g, "nt_"), hi, obj)
-    found = found & (ns >= 0) & (obj >= 0) & (rel >= 0)
-    return jnp.where(found, idx, -1).astype(jnp.int32)
+    found = found & ok
+    res = jnp.where(found, idx, -1)
+    if "ovt_ptr" in g:
+        vid, vfound = hashtab.lookup(
+            _tab(g, "ovt_"), hi, obj, probe=hashtab.PROBE_SHALLOW
+        )
+        res = jnp.where(ok & vfound & ~found, vid, res)
+    return res.astype(jnp.int32)
 
 
 def _member(g: Dict[str, jax.Array], node, subj):
-    """Does tuple (node, subject) exist?  ExistsRelationTuples equivalent."""
+    """Does tuple (node, subject) exist?  ExistsRelationTuples equivalent.
+    Overlay-exact: base OR added-since-base AND NOT deleted-since-base, so
+    probe verdicts always reflect the latest write."""
     _, found = hashtab.lookup(_tab(g, "mt_"), node, subj)
+    if "om_ptr" in g:
+        v, vf = hashtab.lookup(
+            _tab(g, "om_"), node, subj, probe=hashtab.PROBE_SHALLOW
+        )
+        found = (found | (vf & (v == OV_ADDED))) & ~(vf & (v == OV_DELETED))
     return found
+
+
+def _node_dirty(g: Dict[str, jax.Array], node):
+    """Did this node's subject-set edge list change since the base?"""
+    if "ov_dirty" not in g:
+        return jnp.zeros(jnp.shape(node), bool)
+    dsz = g["ov_dirty"].shape[0]
+    return g["ov_dirty"][jnp.clip(node, 0, dsz - 1)] & (node >= 0)
 
 
 def _row_deg(g, node):
@@ -143,6 +173,7 @@ def _init_state(
         f_force=jnp.zeros((frontier,), bool),
         q_found=jnp.zeros((Q,), bool),
         q_over=jnp.zeros((Q,), bool),
+        q_dirty=jnp.zeros((Q,), bool),
         q_subj=jnp.asarray(q_subj, jnp.int32),
     )
 
@@ -153,7 +184,6 @@ def expand_phase(
     *,
     arena: int,
     max_width: int,
-    sharded: bool = False,
     probe_only: bool = False,
 ) -> Tuple[Dict[str, jax.Array], jax.Array, jax.Array]:
     """Probes + child construction.  Returns (children[A] cols + alive, found, over)."""
@@ -167,6 +197,7 @@ def expand_phase(
     qid, ns, obj, rel = s["f_qid"], s["f_ns"], s["f_obj"], s["f_rel"]
     d, skip, force = s["f_depth"], s["f_skip"], s["f_force"]
     q_found, q_over, q_subj = s["q_found"], s["q_over"], s["q_subj"]
+    q_dirty = s.get("q_dirty", jnp.zeros(q_found.shape, bool))
 
     qc = jnp.clip(qid, 0, Q - 1)
     live = (qid >= 0) & ~q_found[qc]  # short-circuit: found queries stop
@@ -217,13 +248,21 @@ def expand_phase(
             skip=jnp.zeros((A,), bool),
             force=jnp.zeros((A,), bool),
         )
-        return empty, q_found, q_over
+        return empty, q_found, q_over, q_dirty
 
     # -- per-item child segments: [expansion | css 0..Kc | ttu 0..Kt] -------
     # expansion runs at depth-1 with a <=0 guard (engine.go:245,:102-110);
     # the full row degree is gathered so found-bits cover pre-truncation
     # results (engine.go:131-139 checks found before the width cut)
-    exp_deg = jnp.where(live2 & eok & (d >= 2), _row_deg(g, node), 0)
+    exp_read = live2 & eok & (d >= 2)
+    exp_deg = jnp.where(exp_read, _row_deg(g, node), 0)
+    if "ov_dirty" in g:
+        # a dirty row's base edges are stale: don't expand them, flag the
+        # query for the host oracle instead; virtual nodes (>= the base
+        # node count) have no base CSR row at all
+        nd = _node_dirty(g, node)
+        q_dirty = q_dirty.at[qc].max(exp_read & nd)
+        exp_deg = jnp.where(nd | (node >= g["ov_nbase"]), 0, exp_deg)
     css_need = (css_ok & live2[:, None] & (d[:, None] - css_dec - 1 >= 1)).astype(
         jnp.int32
     )
@@ -239,7 +278,12 @@ def expand_phase(
     for k in range(Kt):
         tn = _node_lookup(g, ns, obj, ttu_via[:, k])
         ttu_node_cols.append(tn)
-        ttu_deg_cols.append(jnp.where(ttu_ok[:, k], _row_deg(g, tn), 0))
+        deg_k = jnp.where(ttu_ok[:, k], _row_deg(g, tn), 0)
+        if "ov_dirty" in g:
+            nd = _node_dirty(g, tn)
+            q_dirty = q_dirty.at[qc].max(ttu_ok[:, k] & nd)
+            deg_k = jnp.where(nd | (tn >= g["ov_nbase"]), 0, deg_k)
+        ttu_deg_cols.append(deg_k)
     ttu_nodes = jnp.stack(ttu_node_cols, axis=1)  # [F,Kt]
 
     seg_len = jnp.stack(
@@ -271,8 +315,6 @@ def expand_phase(
 
     p_ns, p_obj, p_d = ns[aps], obj[aps], d[aps]
     p_qid = qid[aps]
-    pqc = jnp.clip(p_qid, 0, Q - 1)
-    psubj = q_subj[pqc]
 
     is_exp = src_ok & (seg_idx == 0)
     is_css = src_ok & (seg_idx >= 1) & (seg_idx <= Kc)
@@ -289,7 +331,6 @@ def expand_phase(
         jnp.where(is_ttu, base_ttu, base_exp) + off, 0, g["edge_ns"].shape[0] - 1
     )
     e_ns, e_obj, e_rel = g["edge_ns"][eidx], g["edge_obj"][eidx], g["edge_rel"][eidx]
-    e_node = g["edge_node"][eidx]
 
     css_rel_p = jnp.take_along_axis(css_rel[aps], css_k[:, None], 1)[:, 0]
     css_dec_p = jnp.take_along_axis(css_dec[aps], css_k[:, None], 1)[:, 0]
@@ -314,17 +355,17 @@ def expand_phase(
     p_exp_deg = exp_deg[aps]
     trunc = is_exp & (p_exp_deg > max_width) & (off >= max_width - 1)
 
-    if sharded:
-        # the EXISTS probe happens on the child's owner shard: force-flag
-        # every expansion child; width-truncated ones ship probe-only (d=0)
-        ch_force = is_exp
-        ch_d = jnp.where(trunc, 0, ch_d)
-        alive = src_ok & (is_exp | (ch_d >= 1))
-    else:
-        ch_force = jnp.zeros_like(is_exp)
-        exp_found = is_exp & _member(g, e_node, psubj)
-        q_found = q_found.at[pqc].max(exp_found)
-        alive = src_ok & ~trunc & (ch_d >= 1)
+    # The expansion EXISTS bit (engine.go:131-139) is tested at the CHILD's
+    # level via the force flag, not with an arena-sized member probe at the
+    # parent: the child's own self_member probe fires regardless of depth
+    # when forced, and width-truncated children ship probe-only (d=0) so
+    # the pre-truncation EXISTS semantics survive.  One frontier-sized
+    # probe next level replaces the largest gather site of the whole step,
+    # and single-shard and sharded execution share one child construction
+    # (the owner shard does the probe in the sharded runner).
+    ch_force = is_exp
+    ch_d = jnp.where(trunc, 0, ch_d)
+    alive = src_ok & (is_exp | (ch_d >= 1))
     alive = alive & ~q_found[jnp.clip(ch_qid, 0, Q - 1)]
 
     children = dict(
@@ -336,7 +377,7 @@ def expand_phase(
         skip=ch_skip,
         force=ch_force,
     )
-    return children, q_found, q_over
+    return children, q_found, q_over, q_dirty
 
 
 def _pack_bits(n: int) -> int:
@@ -522,13 +563,16 @@ def step_impl(
 ) -> Dict[str, jax.Array]:
     """One whole level: expand + pack (single-shard path)."""
     NS, R = g["f_direct_ok"].shape
-    children, q_found, q_over = expand_phase(
-        g, s, arena=arena, max_width=max_width, sharded=False
+    children, q_found, q_over, q_dirty = expand_phase(
+        g, s, arena=arena, max_width=max_width
     )
     nxt, q_over = pack_phase(
         children, q_found, q_over, frontier=frontier, ns_dim=NS, rel_dim=R
     )
-    return dict(nxt, q_found=q_found, q_over=q_over, q_subj=s["q_subj"])
+    return dict(
+        nxt, q_found=q_found, q_over=q_over, q_dirty=q_dirty,
+        q_subj=s["q_subj"],
+    )
 
 
 fast_step = functools.partial(
@@ -596,15 +640,20 @@ def _run_fused(
     s["f_depth"] = jnp.minimum(s["f_depth"], len(schedule))
     for i, (f, a) in enumerate(schedule):
         nxt_f = schedule[i + 1][0] if i + 1 < len(schedule) else 1
-        children, q_found, q_over = expand_phase(
-            g, s, arena=a, max_width=max_width, sharded=False,
+        children, q_found, q_over, q_dirty = expand_phase(
+            g, s, arena=a, max_width=max_width,
             probe_only=(i == len(schedule) - 1),
         )
         nxt, q_over = pack_phase(
             children, q_found, q_over, frontier=nxt_f, ns_dim=NS, rel_dim=R
         )
-        s = dict(nxt, q_found=q_found, q_over=q_over, q_subj=s["q_subj"])
-    return FastResult(found=s["q_found"], over=s["q_over"])
+        s = dict(
+            nxt, q_found=q_found, q_over=q_over, q_dirty=q_dirty,
+            q_subj=s["q_subj"],
+        )
+    return FastResult(
+        found=s["q_found"], over=s["q_over"], dirty=s["q_dirty"]
+    )
 
 
 def run_fast(

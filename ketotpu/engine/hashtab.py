@@ -29,7 +29,8 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
-PROBE = 8  # fixed probe depth; the build guarantees max bucket <= PROBE
+PROBE = 8  # default probe depth; the build guarantees max bucket <= probe
+PROBE_SHALLOW = 4  # for small side tables on hot probe paths (delta overlay)
 
 _SALTS = np.array(
     [0x243F6A88, 0x85A308D3, 0x13198A2E, 0x03707344,
@@ -76,25 +77,48 @@ def build_table(
     val: Optional[np.ndarray] = None,
     *,
     min_buckets: int = 16,
+    probe: int = PROBE,
+    fixed_shape: Optional[Tuple[int, int]] = None,
 ) -> Dict[str, np.ndarray]:
-    """Vectorized build; returns the device-array dict for `lookup`."""
+    """Vectorized build; returns the device-array dict for `lookup`.
+
+    ``probe`` bounds the max bucket size the build accepts — lookups must
+    then pass the same (or larger) probe depth.  Small hot-path side tables
+    (the delta overlay) build shallow so their lookups unroll to fewer
+    gather rounds.
+
+    ``fixed_shape=(buckets, cap)`` pins the array shapes: callers that
+    re-ship a table with changing content (the delta overlay) pass their
+    size thresholds so every rebuild has identical shapes and the jitted
+    consumer never recompiles.  If the content cannot satisfy the probe
+    bound in the fixed bucket count (after the salt schedule) the build
+    raises ``ValueError`` — the caller falls back to a full rebuild."""
     key_a = np.asarray(key_a, np.int64)
     key_b = np.asarray(key_b, np.int64)
     n = key_a.shape[0]
-    buckets = _bucket_pow2(max(2 * n, 1), min_buckets)
+    if fixed_shape is not None:
+        buckets = fixed_shape[0]
+        if n > fixed_shape[1]:
+            raise ValueError(f"{n} entries exceed fixed cap {fixed_shape[1]}")
+    else:
+        buckets = _bucket_pow2(max(2 * n, 1), min_buckets)
     salt_i = 0
     while True:
         h = _mix_np(key_a, key_b, _SALTS[salt_i]) & np.uint32(buckets - 1)
         counts = np.bincount(h.astype(np.int64), minlength=buckets)
-        if n == 0 or counts.max() <= PROBE:
+        if n == 0 or counts.max() <= probe:
             break
         if salt_i + 1 < len(_SALTS):
             salt_i += 1
+        elif fixed_shape is not None:
+            raise ValueError(
+                f"no salt fits {n} entries in {buckets} buckets at probe {probe}"
+            )
         else:
             salt_i = 0
             buckets *= 2
     order = np.argsort(h, kind="stable") if n else np.zeros(0, np.int64)
-    cap = _bucket_pow2(max(n, 1), 16)
+    cap = fixed_shape[1] if fixed_shape is not None else _bucket_pow2(max(n, 1), 16)
     ta = np.full(cap, -1, np.int32)
     tb = np.full(cap, -1, np.int32)
     ta[:n] = key_a[order]
@@ -114,12 +138,13 @@ def build_table(
     return out
 
 
-def lookup(t: Dict, a, b) -> Tuple:
+def lookup(t: Dict, a, b, *, probe: int = PROBE) -> Tuple:
     """Device probe: (val_or_index, found).  Negative queries never match.
 
     With ``val`` built, returns the payload of the first match; otherwise
-    the entry index.  At most PROBE static gather rounds — no data-dependent
-    control flow, safe anywhere in a jitted program.
+    the entry index.  At most ``probe`` static gather rounds (the table
+    must have been built with the same bound) — no data-dependent control
+    flow, safe anywhere in a jitted program.
     """
     import jax.numpy as jnp
 
@@ -132,12 +157,14 @@ def lookup(t: Dict, a, b) -> Tuple:
     cap = t["key_a"].shape[0]
     ok = (a >= 0) & (b >= 0)
     found = jnp.zeros(jnp.shape(a), bool)
-    res = jnp.full(jnp.shape(a), -1, jnp.int32)
+    res_j = jnp.zeros(jnp.shape(a), jnp.int32)
     vals = t.get("val", None)
-    for i in range(PROBE):
+    for i in range(probe):
         j = jnp.clip(base + i, 0, cap - 1)
         hit = ok & (i < cnt) & (t["key_a"][j] == a) & (t["key_b"][j] == b)
-        payload = vals[j] if vals is not None else j
-        res = jnp.where(hit & ~found, payload, res)
+        res_j = jnp.where(hit & ~found, j, res_j)
         found = found | hit
-    return res, found
+    # one payload gather at the matched index instead of one per round:
+    # each avoided gather is a real cost at arena-sized call sites
+    payload = vals[res_j] if vals is not None else res_j
+    return jnp.where(found, payload, -1), found

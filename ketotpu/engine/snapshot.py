@@ -77,6 +77,19 @@ class Snapshot:
     node_tab: Dict[str, np.ndarray] = None  # hash table (hi, lo) -> node id
     mem_tab: Dict[str, np.ndarray] = None  # hash set of (node, subject)
 
+    # membership CSR over nodes (device Expand: a row's full member list,
+    # leaf subjects included — the CSR above holds only subject-set edges).
+    # mem_ord_subj is grouped by node in INSERTION order within each row
+    # (children order parity with the store's pagination, engine.go:84-121),
+    # unlike mem_subj which is sorted for binary search.
+    mem_row_ptr: np.ndarray = None  # int32[N'+1]
+    mem_ord_subj: np.ndarray = None  # int32[M']
+    # subject decode table over the subject-id space: the (ns, obj, rel)
+    # triple for subject-set subjects, -1 for plain SubjectIDs
+    sub_ns: np.ndarray = None  # int32[S']
+    sub_obj: np.ndarray = None  # int32[S']
+    sub_rel: np.ndarray = None  # int32[S']
+
     def arrays(self) -> Dict[str, np.ndarray]:
         """The pytree of device arrays the jitted step consumes."""
         return {
@@ -92,6 +105,11 @@ class Snapshot:
             "edge_node": self.edge_node,
             "mem_node": self.mem_node,
             "mem_subj": self.mem_subj,
+            "mem_row_ptr": self.mem_row_ptr,
+            "mem_ord_subj": self.mem_ord_subj,
+            "sub_ns": self.sub_ns,
+            "sub_obj": self.sub_obj,
+            "sub_rel": self.sub_rel,
             "p_kind": self.op.p_kind,
             "p_a": self.op.p_a,
             "p_b": self.op.p_b,
@@ -254,6 +272,28 @@ def build_snapshot(
     if n_tuples:
         mem_node[:n_tuples] = [p[0] for p in pairs]
         mem_subj[:n_tuples] = [p[1] for p in pairs]
+    mem_row_ptr = np.searchsorted(
+        mem_node[:n_tuples], np.arange(npad + 1)
+    ).astype(np.int32)
+    # insertion-ordered member list per node (tuples iterate in seq order)
+    mem_ord_subj = np.full(mpad, -1, np.int32)
+    fill = mem_row_ptr[: max(n_nodes, 1)].copy()
+    for k, t in zip(triples, tuples):
+        n = node_id[k]
+        mem_ord_subj[fill[n]] = vocab.subjects.lookup(t.subject.unique_id())
+        fill[n] += 1
+
+    spad = _bucket(max(len(vocab.subjects), 1))
+    sub_ns = np.full(spad, -1, np.int32)
+    sub_obj = np.full(spad, -1, np.int32)
+    sub_rel = np.full(spad, -1, np.int32)
+    for t in tuples:
+        s = t.subject
+        if isinstance(s, SubjectSet):
+            k = vocab.subjects.lookup(s.unique_id())
+            sub_ns[k] = vocab.namespaces.lookup(s.namespace)
+            sub_obj[k] = vocab.objects.lookup(s.object)
+            sub_rel[k] = vocab.relations.lookup(s.relation)
 
     num_ns = op.prog_root.shape[0]
     flat = compile_flat_tables(
@@ -272,7 +312,7 @@ def build_snapshot(
         np.fromiter((p[1] for p in pairs), np.int64, n_tuples),
     )
 
-    return Snapshot(
+    snap = Snapshot(
         vocab=vocab,
         op=op,
         flat=flat,
@@ -287,6 +327,11 @@ def build_snapshot(
         edge_node=edge_node,
         mem_node=mem_node,
         mem_subj=mem_subj,
+        mem_row_ptr=mem_row_ptr,
+        mem_ord_subj=mem_ord_subj,
+        sub_ns=sub_ns,
+        sub_obj=sub_obj,
+        sub_rel=sub_rel,
         n_nodes=n_nodes,
         n_edges=n_edges,
         n_tuples=n_tuples,
@@ -294,3 +339,7 @@ def build_snapshot(
         node_tab=node_tab,
         mem_tab=mem_tab,
     )
+    # relation-level edge pairs: the delta overlay consults this to decide
+    # whether a new subject-set write could extend the taint closure
+    snap.dyn_pairs = dyn_pairs
+    return snap

@@ -44,6 +44,7 @@ import jax
 import numpy as np
 
 from ketotpu.api.types import RelationTuple
+from ketotpu.engine import delta as dl
 from ketotpu.engine import device as dev
 from ketotpu.engine import fastpath as fp
 from ketotpu.engine.oracle import (
@@ -51,7 +52,7 @@ from ketotpu.engine.oracle import (
     DEFAULT_MAX_WIDTH,
     CheckEngine,
 )
-from ketotpu.engine.snapshot import Snapshot, build_snapshot
+from ketotpu.engine.snapshot import Snapshot
 from ketotpu.engine.vocab import Vocab
 from ketotpu.storage.memory import InMemoryTupleStore
 from ketotpu.storage.namespaces import NamespaceManager
@@ -120,28 +121,114 @@ class DeviceCheckEngine:
         self._snap: Optional[Snapshot] = None
         self._snap_fingerprint: Optional[int] = None
         self._device_arrays = None
+        self._cols: Optional[dl.TupleColumns] = None
+        self._log_cursor = 0
+        self._overlay: Optional[dl.OverlayState] = None
+        self._overlay_active = False
+        self.max_overlay_pairs = 4096
+        self.max_overlay_dirty = 512
         self.retry_scale = retry_scale
         self.fallbacks = 0  # observability: host-fallback counter
         self.retries = 0  # observability: device-retry (tier-2) counter
+        self.rebuilds = 0  # observability: full snapshot rebuilds
+        self.overlay_applies = 0  # observability: O(delta) write applications
 
     # -- snapshot lifecycle -------------------------------------------------
+    #
+    # Writes reach the device through two tiers (engine/delta.py): O(delta)
+    # overlay application for the common case, amortized full (vectorized)
+    # rebuilds when the overlay hits its thresholds, cannot represent a
+    # change, or the namespace config changed.  Probe verdicts under an
+    # overlay are exact; queries whose exploration touches a changed CSR
+    # row come back `dirty` and are answered by the host oracle.
+
+    def _sync_cols(self) -> None:
+        """Bring the column mirror up to date with the store.  Incremental
+        when the change log still covers our cursor; otherwise a full rescan
+        (tuples + log head read under one store lock, so no write can land
+        between the scan and the cursor)."""
+        if self._cols is not None:
+            changes, head = self.store.changes_since(self._log_cursor)
+            if changes is not None:
+                for op, t in changes:
+                    self._cols.apply(op, t)
+                self._log_cursor = head
+                return
+            self._cols = None  # change log overflowed past our cursor
+        tuples, head = self.store.tuples_and_head()
+        self._cols = dl.TupleColumns(self._vocab)
+        for t in tuples:
+            self._cols.apply(1, t)
+        self._log_cursor = head
+
+    def _rebuild(self, fingerprint: int) -> None:
+        self._sync_cols()
+        self._cols.compact()
+        self._snap = dl.build_snapshot_cols(
+            self._cols,
+            self.namespace_manager,
+            strict=self.strict_mode,
+            version=self.store.version,
+        )
+        self._snap_fingerprint = fingerprint
+        self._overlay = dl.OverlayState()
+        self._overlay_active = False
+        # base arrays transfer once per rebuild; overlay updates later merge
+        # over this dict so a write re-ships only the (small) overlay.
+        # EMPTY overlay arrays ship from the start so the jitted program's
+        # pytree structure is identical before and after the first write —
+        # overlay activation must never trigger a recompile.
+        self._base_device = jax.device_put(self._snap.arrays())
+        self._device_arrays = dict(
+            self._base_device,
+            **jax.device_put(
+                dl.overlay_arrays(
+                    self._overlay, self._snap, pair_cap=self.max_overlay_pairs
+                )
+            ),
+        )
+        self.rebuilds += 1
 
     def snapshot(self) -> Snapshot:
         fingerprint = config_fingerprint(self.namespace_manager)
-        if (
-            self._snap is None
-            or self._snap.version != self.store.version
-            or self._snap_fingerprint != fingerprint
-        ):
-            self._snap = build_snapshot(
-                self.store,
-                self.namespace_manager,
-                self._vocab,
-                strict=self.strict_mode,
+        if self._snap is None or self._snap_fingerprint != fingerprint:
+            self._rebuild(fingerprint)
+            return self._snap
+        changes, head = self.store.changes_since(self._log_cursor)
+        if changes is None:
+            self._rebuild(fingerprint)
+            return self._snap
+        if changes:
+            for op, t in changes:
+                self._cols.apply(op, t)
+            self._log_cursor = head
+            try:
+                dl.apply_changes(self._overlay, self._snap, self._vocab, changes)
+            except dl.OverlayRejected:
+                self._rebuild(fingerprint)
+                return self._snap
+            pairs, dirty = self._overlay.size()
+            if pairs > self.max_overlay_pairs or dirty > self.max_overlay_dirty:
+                self._rebuild(fingerprint)
+                return self._snap
+            try:
+                ov = dl.overlay_arrays(
+                    self._overlay, self._snap, pair_cap=self.max_overlay_pairs
+                )
+            except ValueError:  # fixed-shape table could not fit the content
+                self._rebuild(fingerprint)
+                return self._snap
+            self._device_arrays = dict(
+                self._base_device, **jax.device_put(ov)
             )
-            self._snap_fingerprint = fingerprint
-            self._device_arrays = jax.device_put(self._snap.arrays())
+            self._overlay_active = True
+            self.overlay_applies += 1
         return self._snap
+
+    def refresh(self) -> None:
+        """Force a full rebuild (the CheckRequest.latest consistency knob —
+        stronger than needed, since overlay probes are already exact)."""
+        self._rebuild(config_fingerprint(self.namespace_manager))
 
     # -- query encoding -----------------------------------------------------
 
@@ -238,6 +325,12 @@ class DeviceCheckEngine:
             max_width=self.max_width,
         )
         gres = gi = None
+        if general.any() and self._overlay_active:
+            # the general-path interpreter reads the stale base arrays; with
+            # an overlay pending its verdicts could miss writes, so those
+            # (rare: AND/NOT-reachable) queries go to the oracle directly
+            err = err | general
+            general = np.zeros_like(general)
         if general.any():
             gi = np.flatnonzero(general)
             gpad = _bucket(len(gi), 32)
@@ -270,10 +363,22 @@ class DeviceCheckEngine:
 
         found = np.asarray(res.found)[:n]
         over = np.asarray(res.over)[:n]
+        dirty = (
+            np.asarray(res.dirty)[:n]
+            if res.dirty is not None
+            else np.zeros(n, bool)
+        )
         fmask = ~(err | general)
         allowed[fmask] = found[fmask]
+        # dirty queries touched a CSR row with pending writes: the oracle
+        # (live store) must answer *unless* membership was already
+        # established — found-bits are overlay-exact and monotone, so a
+        # found verdict stands even when the exploration brushed a dirty
+        # row.  A device retry would see the same stale base, so dirty
+        # queries are excluded from the retry tier.
+        fallback |= fmask & dirty & ~found
         # found is monotone: an overflow only voids not-yet-found queries
-        unres = fmask & over & ~found
+        unres = fmask & over & ~found & ~dirty
         if retry and unres.any() and self.retry_scale > 1:
             ri = np.flatnonzero(unres)
             rpad = min(_bucket(len(ri), 256), self.retry_scale * self.frontier)
@@ -294,8 +399,13 @@ class DeviceCheckEngine:
             )
             rfound = np.asarray(rres.found)[: len(ri)]
             rover = np.asarray(rres.over)[: len(ri)]
+            rdirty = (
+                np.asarray(rres.dirty)[: len(ri)]
+                if rres.dirty is not None
+                else np.zeros(len(ri), bool)
+            )
             allowed[ri] = rfound
-            unres[ri] = rover & ~rfound
+            unres[ri] = (rover | rdirty) & ~rfound
         fallback |= unres
         return allowed, fallback
 
@@ -311,6 +421,52 @@ class DeviceCheckEngine:
                 self.fallbacks += 1
                 allowed[i] = self.oracle.check_is_member(queries[i], rest_depth)
         return allowed.tolist()
+
+    def batch_expand(
+        self, subjects, rest_depth: int = 0, *, fanout: int = 16,
+        cap: int = 65536,
+    ):
+        """Batched device Expand (SURVEY §7 step 5): one fused dispatch for
+        all subject-set roots, host-side exact DFS reassembly.  SubjectID
+        roots are leaves without touching the engine (expand/handler.go:
+        115-126); overlay-pending or overflowed roots fall back to the
+        sequential oracle expand (live store, exact)."""
+        from ketotpu.api.types import SubjectID, SubjectSet, Tree, TreeNodeType
+        from ketotpu.engine import expand_device as xd
+        from ketotpu.engine.oracle import ExpandEngine
+
+        snap = self.snapshot()
+        oracle = ExpandEngine(self.store, max_depth=self.max_depth)
+        subjects = list(subjects)
+        out: List = [None] * len(subjects)
+        set_idx = [i for i, s in enumerate(subjects) if isinstance(s, SubjectSet)]
+        for i, s in enumerate(subjects):
+            if isinstance(s, SubjectID):
+                out[i] = Tree(
+                    type=TreeNodeType.LEAF,
+                    tuple=RelationTuple("", "", "", s),
+                )
+        if not set_idx:
+            return out
+        if self._overlay_active:
+            # the device membership CSR is stale between rebuilds; expand
+            # reads every member, so answer on the live store
+            for i in set_idx:
+                self.fallbacks += 1
+                out[i] = oracle.build_tree(subjects[i], rest_depth)
+            return out
+        roots = [subjects[i] for i in set_idx]
+        trees, over = xd.run_expand(
+            self._device_arrays, snap, roots, rest_depth,
+            max_depth=self.max_depth, fanout=fanout, cap=cap,
+        )
+        for k, i in enumerate(set_idx):
+            if over[k]:
+                self.fallbacks += 1
+                out[i] = oracle.build_tree(subjects[i], rest_depth)
+            else:
+                out[i] = trees[k]
+        return out
 
     def batch_check_device_only(
         self, queries: Sequence[RelationTuple], rest_depth: int = 0, retry: bool = True

@@ -213,8 +213,8 @@ def sharded_check(
                 frontier=frontier,
             )
             for _ in range(max_depth):
-                children, q_found, q_over = fp.expand_phase(
-                    g, s, arena=arena, max_width=max_width, sharded=True
+                children, q_found, q_over, _ = fp.expand_phase(
+                    g, s, arena=arena, max_width=max_width
                 )
                 children, q_over = _route(children, n, cap, q_over, axis)
                 # merge found bits across shards before packing so arrived

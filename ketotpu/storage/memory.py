@@ -47,6 +47,12 @@ class InMemoryTupleStore:
         self._by_subject: Dict[str, List[int]] = {}
         self.version = 0
         self._listeners: List[Callable[[int], None]] = []
+        # append-only change log for incremental snapshot projection
+        # (SURVEY §7 step 8): entries are (+1|-1, tuple) effective mutations.
+        # Bounded: readers that fall behind log_start must full-rebuild.
+        self._log: List[Tuple[int, RelationTuple]] = []
+        self._log_start = 0  # index of _log[0] in the all-time sequence
+        self._log_cap = 65536
 
     # -- change notification -------------------------------------------------
 
@@ -112,6 +118,13 @@ class InMemoryTupleStore:
         with self._lock:
             return list(self._rows.values())
 
+    def tuples_and_head(self) -> Tuple[List[RelationTuple], int]:
+        """All tuples plus the log head, read atomically — a snapshot
+        builder that seeds from the scan and later drains `changes_since`
+        from the returned head cannot miss a concurrent write."""
+        with self._lock:
+            return list(self._rows.values()), self._log_start + len(self._log)
+
     # -- writes --------------------------------------------------------------
 
     def write_relation_tuples(self, *tuples: RelationTuple) -> None:
@@ -157,6 +170,7 @@ class InMemoryTupleStore:
         self._rows[seq] = t
         self._by_userset.setdefault((t.namespace, t.object, t.relation), []).append(seq)
         self._by_subject.setdefault(t.subject.unique_id(), []).append(seq)
+        self._log_locked(1, t)
 
     def _delete_exact_locked(self, t: RelationTuple) -> int:
         key = (t.namespace, t.object, t.relation)
@@ -177,6 +191,33 @@ class InMemoryTupleStore:
         self._by_subject[sid].remove(seq)
         if not self._by_subject[sid]:
             del self._by_subject[sid]
+        self._log_locked(-1, t)
+
+    # -- change log ----------------------------------------------------------
+
+    def _log_locked(self, op: int, t: RelationTuple) -> None:
+        self._log.append((op, t))
+        if len(self._log) > self._log_cap:
+            drop = len(self._log) - self._log_cap
+            del self._log[:drop]
+            self._log_start += drop
+
+    @property
+    def log_head(self) -> int:
+        """All-time index just past the newest change-log entry."""
+        with self._lock:
+            return self._log_start + len(self._log)
+
+    def changes_since(self, cursor: int):
+        """Effective mutations [(op, tuple)] since ``cursor`` (a previous
+        ``log_head`` value), plus the new cursor.  Returns ``None`` for the
+        entries when the cursor predates the bounded log (reader must
+        rebuild from a full scan)."""
+        with self._lock:
+            head = self._log_start + len(self._log)
+            if cursor < self._log_start:
+                return None, head
+            return list(self._log[cursor - self._log_start:]), head
 
 
 def _matches(t: RelationTuple, q: Optional[RelationQuery]) -> bool:

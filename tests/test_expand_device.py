@@ -1,0 +1,151 @@
+"""Device Expand tests: bit-exact tree parity with the oracle engine.
+
+The device pass produces an ancestor-cycle-bounded superset forest; the
+host DFS replay with a global visited set must reproduce
+`oracle.ExpandEngine.build_tree` exactly — including cycle leaves, diamond
+sharing (first DFS occurrence expands, later ones are leaves), depth-1
+truncation, and empty-row pruning (engine.go:54-124 semantics).
+"""
+
+import numpy as np
+import pytest
+
+from ketotpu.api.types import RelationTuple, SubjectID, SubjectSet
+from ketotpu.engine import expand_device as xd
+from ketotpu.engine.oracle import ExpandEngine
+from ketotpu.engine.tpu import DeviceCheckEngine
+from ketotpu.storage.memory import InMemoryTupleStore
+from ketotpu.utils.synth import build_synth
+
+
+def _trees_equal(got, want):
+    g = got.to_json() if got else None
+    w = want.to_json() if want else None
+    return g == w
+
+
+def _parity(store, manager, roots, rest_depth=0, **kw):
+    eng = DeviceCheckEngine(store, manager)
+    snap = eng.snapshot()
+    oracle = ExpandEngine(store, max_depth=eng.max_depth)
+    trees, over = xd.run_expand(
+        eng._device_arrays, snap, roots, rest_depth,
+        max_depth=eng.max_depth, **kw,
+    )
+    assert not over.any(), "unexpected overflow"
+    for root, got in zip(roots, trees):
+        want = oracle.build_tree(root, rest_depth)
+        assert _trees_equal(got, want), (root, got, want)
+    return trees
+
+
+def _store(lines):
+    store = InMemoryTupleStore()
+    store.write_relation_tuples(*[RelationTuple.from_string(s) for s in lines])
+    return store
+
+
+class TestParity:
+    def test_synth_graph_all_usersets(self):
+        graph = build_synth(n_users=48, n_groups=6, n_folders=24, n_docs=96)
+        roots = sorted(
+            {(t.namespace, t.object, t.relation) for t in graph.store.all_tuples()}
+        )
+        _parity(
+            graph.store, graph.manager,
+            [SubjectSet(*r) for r in roots] + [SubjectSet("Doc", "none", "x")],
+        )
+
+    def test_cycle_becomes_leaf(self):
+        store = _store([
+            "g:a#m@g:b#m",
+            "g:b#m@g:a#m",
+            "g:b#m@alice",
+        ])
+        trees = _parity(store, None, [SubjectSet("g", "a", "m")])
+        js = trees[0].to_json()
+        assert "alice" in str(js)
+
+    def test_diamond_first_occurrence_expands(self):
+        # shared child: DFS expands it under the first parent only
+        store = _store([
+            "g:root#m@g:left#m",
+            "g:root#m@g:right#m",
+            "g:left#m@g:shared#m",
+            "g:right#m@g:shared#m",
+            "g:shared#m@bob",
+        ])
+        _parity(store, None, [SubjectSet("g", "root", "m")])
+
+    def test_depth_truncation_leaf(self):
+        store = _store([
+            "g:a#m@g:b#m",
+            "g:b#m@g:c#m",
+            "g:c#m@carol",
+        ])
+        for depth in (1, 2, 3, 4):
+            _parity(store, None, [SubjectSet("g", "a", "m")], rest_depth=depth)
+
+    def test_empty_row_prunes_to_none(self):
+        store = _store(["g:a#m@alice"])
+        eng = DeviceCheckEngine(store, None)
+        snap = eng.snapshot()
+        trees, over = xd.run_expand(
+            eng._device_arrays, snap, [SubjectSet("g", "none", "m")], 0,
+            max_depth=eng.max_depth,
+        )
+        assert trees == [None] and not over.any()
+
+    def test_mixed_leaf_and_set_children_in_insertion_order(self):
+        store = _store([
+            "g:a#m@zed",
+            "g:a#m@g:b#m",
+            "g:a#m@amy",
+            "g:b#m@bob",
+        ])
+        trees = _parity(store, None, [SubjectSet("g", "a", "m")])
+        labels = [str(c.tuple.subject) for c in trees[0].children]
+        assert labels == ["zed", "g:b#m", "amy"]  # insertion order
+
+
+class TestEngineSurface:
+    def test_batch_expand_with_subject_ids_and_fallback(self):
+        graph = build_synth(n_users=32, n_groups=4, n_folders=16, n_docs=64)
+        eng = DeviceCheckEngine(graph.store, graph.manager)
+        oracle = ExpandEngine(graph.store, max_depth=eng.max_depth)
+        some = next(
+            t for t in graph.store.all_tuples() if t.relation == "viewers"
+        )
+        subjects = [
+            SubjectID("alice"),
+            SubjectSet(some.namespace, some.object, some.relation),
+        ]
+        out = eng.batch_expand(subjects)
+        assert out[0].type.value == "leaf"
+        assert _trees_equal(out[1], oracle.build_tree(subjects[1]))
+
+    def test_batch_expand_overflow_falls_back(self):
+        graph = build_synth(n_users=32, n_groups=4, n_folders=16, n_docs=64)
+        eng = DeviceCheckEngine(graph.store, graph.manager)
+        oracle = ExpandEngine(graph.store, max_depth=eng.max_depth)
+        some = next(
+            t for t in graph.store.all_tuples() if t.relation == "viewers"
+        )
+        s = SubjectSet(some.namespace, some.object, some.relation)
+        out = eng.batch_expand([s], cap=1)  # force per-root overflow
+        assert eng.fallbacks >= 0
+        assert _trees_equal(out[0], oracle.build_tree(s))
+
+    def test_batch_expand_under_overlay_uses_oracle(self):
+        graph = build_synth(n_users=32, n_groups=4, n_folders=16, n_docs=64)
+        eng = DeviceCheckEngine(graph.store, graph.manager)
+        eng.snapshot()
+        doc = next(t for t in graph.store.all_tuples() if t.relation == "viewers")
+        graph.store.write_relation_tuples(
+            RelationTuple.from_string(
+                f"{doc.namespace}:{doc.object}#viewers@newbie"
+            )
+        )
+        s = SubjectSet(doc.namespace, doc.object, "viewers")
+        out = eng.batch_expand([s])
+        assert "newbie" in str(out[0].to_json())  # fresh against the write
