@@ -306,6 +306,28 @@ def cmd_status(args) -> int:
             time.sleep(1.0)
 
 
+def cmd_migrate(args) -> int:
+    """Schema migrations for durable dsns (cmd/migrate/, popx analog).
+    Runs locally against the configured dsn — no server required."""
+    from ketotpu.driver import Provider, Registry
+
+    cfg = Provider(config_file=args.config) if args.config else Provider()
+    store = Registry(cfg).store()
+    if not hasattr(store, "migrate_up"):
+        print("dsn 'memory' has no migrations", file=sys.stderr)
+        return 1
+    if args.migrate_command == "up":
+        n = store.migrate_up()
+        print(f"applied {n} migration(s)")
+    elif args.migrate_command == "down":
+        n = store.migrate_down(args.steps)
+        print(f"rolled back {n} migration(s)")
+    else:
+        for version, state in store.migration_status():
+            print(f"{version:<44}{state}")
+    return 0
+
+
 def cmd_version(args) -> int:
     print(ketotpu.__version__)
     return 0
@@ -399,6 +421,15 @@ def build_parser() -> argparse.ArgumentParser:
     ns_validate = nssub.add_parser("validate", help="validate an OPL file")
     ns_validate.add_argument("file")
     ns_validate.set_defaults(fn=cmd_ns_validate)
+
+    migrate = sub.add_parser("migrate", help="schema migrations (durable dsn)")
+    migrate.add_argument("-c", "--config", help="config file (yaml/json)")
+    migsub = migrate.add_subparsers(dest="migrate_command", required=True)
+    migsub.add_parser("up", help="apply pending migrations")
+    mig_down = migsub.add_parser("down", help="roll back migrations")
+    mig_down.add_argument("--steps", type=int, default=1)
+    migsub.add_parser("status", help="list migration status")
+    migrate.set_defaults(fn=cmd_migrate)
 
     status = sub.add_parser("status", help="server health status")
     status.add_argument("--block", action="store_true", help="wait until SERVING")

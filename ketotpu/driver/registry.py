@@ -28,7 +28,7 @@ from typing import Any, Callable, Dict, List, Optional
 from ketotpu import __version__
 from ketotpu.api.mapper import Mapper
 from ketotpu.api.uuid_map import UUIDMapper
-from ketotpu.driver.config import Provider
+from ketotpu.driver.config import ConfigError, Provider
 from ketotpu.engine.oracle import CheckEngine, ExpandEngine
 from ketotpu.engine.tpu import DeviceCheckEngine
 from ketotpu.observability import Metrics, Tracer, make_logger
@@ -100,10 +100,26 @@ class Registry:
 
     # -- storage + namespaces ----------------------------------------------
 
-    def store(self) -> InMemoryTupleStore:
+    def store(self):
+        """Build the tuple store from ``dsn`` (pop_connection.go analog):
+        ``memory`` | ``sqlite://<path>`` (durable, WAL; migrate with
+        `keto-tpu migrate up` unless the path is ``:memory:``)."""
         with self._lock:
             if self._store is None:
-                self._store = InMemoryTupleStore()
+                dsn = self.config.dsn()
+                if dsn == "memory":
+                    self._store = InMemoryTupleStore()
+                elif dsn.startswith(("sqlite://", "sqlite:")):
+                    from ketotpu.storage.sqlite import SQLiteTupleStore
+
+                    path = dsn.split("://", 1)[-1] if "://" in dsn \
+                        else dsn.split(":", 1)[1]
+                    self._store = SQLiteTupleStore(
+                        path or ":memory:",
+                        network_id=str(self.network_id),
+                    )
+                else:
+                    raise ConfigError("dsn", f"unsupported dsn {dsn!r}")
             return self._store
 
     def namespace_manager(self):

@@ -6,6 +6,7 @@ projected CSR snapshots of it, not the store directly.
 """
 
 from ketotpu.storage.memory import ErrMalformedPageToken, InMemoryTupleStore
+from ketotpu.storage.sqlite import MIGRATIONS, SQLiteTupleStore
 from ketotpu.storage.namespaces import (
     OPLFileNamespaceManager,
     StaticNamespaceManager,
@@ -20,6 +21,8 @@ from ketotpu.storage.traverser import (
 __all__ = [
     "ErrMalformedPageToken",
     "InMemoryTupleStore",
+    "MIGRATIONS",
+    "SQLiteTupleStore",
     "OPLFileNamespaceManager",
     "StaticNamespaceManager",
     "TraversalDirection",

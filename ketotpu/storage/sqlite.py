@@ -1,0 +1,525 @@
+"""Durable relation-tuple store on SQLite (stdlib ``sqlite3``).
+
+The durable analog of the reference's SQL persister
+(`internal/persistence/sql/persister.go:54`, `relationtuples.go:207-287`):
+
+* same row shape as `keto_relation_tuples` (migration
+  `20210623162417000001_relationtuple.postgres.up.sql`): nullable
+  ``subject_id`` XOR subject-set triple, forward userset index and a
+  reverse-subject index;
+* ``nid`` multi-tenancy on every row and every statement
+  (`persister.go:91-101`) — stores opened on the same file with different
+  network ids are fully isolated;
+* opaque-token pagination by row sequence (`relationtuples.go:216-219`);
+* versioned schema **migrations** with up/down/status
+  (`internal/persistence/sql/migrations/`, `popx` MigrationBox) — the CLI
+  exposes them as ``keto-tpu migrate {up,down,status}``;
+* a bounded change log so the TPU engine's incremental projection
+  (engine/delta.py) can drain effective mutations without rescanning —
+  this is the durable replacement for Keto's read-committed visibility:
+  cross-process writes surface at the next ``changes_since`` drain.
+
+Duck-type compatible with `storage.memory.InMemoryTupleStore`; the shared
+conformance suite in tests/test_storage.py runs over both backends (the
+reference exports its persister suite the same way,
+`manager_requirements.go:25`).
+"""
+
+from __future__ import annotations
+
+import sqlite3
+import threading
+import time
+from contextlib import contextmanager
+from typing import Callable, Iterable, List, Optional, Tuple
+
+from ketotpu.api.types import (
+    BadRequestError,
+    RelationQuery,
+    RelationTuple,
+    SubjectID,
+    SubjectSet,
+)
+from ketotpu.storage.memory import DEFAULT_PAGE_SIZE, ErrMalformedPageToken
+
+DEFAULT_NID = "default"
+
+# -- migrations --------------------------------------------------------------
+# Ordered (version, up_sql[], down_sql[]).  Mirrors the reference's
+# versioned-migration discipline; new schema changes append a new entry.
+
+MIGRATIONS: List[Tuple[str, List[str], List[str]]] = [
+    (
+        "20240101000001_relation_tuples",
+        [
+            """CREATE TABLE keto_relation_tuples (
+                seq INTEGER PRIMARY KEY AUTOINCREMENT,
+                nid TEXT NOT NULL,
+                namespace TEXT NOT NULL,
+                object TEXT NOT NULL,
+                relation TEXT NOT NULL,
+                subject_id TEXT,
+                subject_set_namespace TEXT,
+                subject_set_object TEXT,
+                subject_set_relation TEXT,
+                commit_time REAL NOT NULL
+            )""",
+            """CREATE INDEX keto_rt_userset
+               ON keto_relation_tuples (nid, namespace, object, relation)""",
+            """CREATE INDEX keto_rt_subject_id
+               ON keto_relation_tuples (nid, subject_id)
+               WHERE subject_id IS NOT NULL""",
+            """CREATE INDEX keto_rt_subject_set
+               ON keto_relation_tuples (nid, subject_set_namespace,
+                   subject_set_object, subject_set_relation)
+               WHERE subject_set_namespace IS NOT NULL""",
+        ],
+        ["DROP TABLE keto_relation_tuples"],
+    ),
+    (
+        "20240101000002_change_log",
+        [
+            """CREATE TABLE keto_change_log (
+                id INTEGER PRIMARY KEY AUTOINCREMENT,
+                nid TEXT NOT NULL,
+                op INTEGER NOT NULL,
+                namespace TEXT NOT NULL,
+                object TEXT NOT NULL,
+                relation TEXT NOT NULL,
+                subject_id TEXT,
+                subject_set_namespace TEXT,
+                subject_set_object TEXT,
+                subject_set_relation TEXT
+            )""",
+            """CREATE INDEX keto_cl_nid ON keto_change_log (nid, id)""",
+        ],
+        ["DROP TABLE keto_change_log"],
+    ),
+    (
+        "20240101000003_meta",
+        [
+            """CREATE TABLE keto_meta (
+                nid TEXT NOT NULL,
+                key TEXT NOT NULL,
+                value TEXT NOT NULL,
+                PRIMARY KEY (nid, key)
+            )""",
+        ],
+        ["DROP TABLE keto_meta"],
+    ),
+]
+
+
+class SQLiteTupleStore:
+    """Durable Manager-contract store; one network id per handle."""
+
+    def __init__(
+        self,
+        path: str = ":memory:",
+        *,
+        network_id: str = DEFAULT_NID,
+        auto_migrate: Optional[bool] = None,
+        log_cap: int = 65536,
+    ):
+        self._lock = threading.RLock()
+        self.path = path
+        self.nid = network_id
+        self._log_cap = log_cap
+        # trim probes walk O(log_cap) index entries; amortize them
+        self._trim_interval = max(1, min(1024, log_cap // 4))
+        self._writes_since_trim = 0
+        self._listeners: List[Callable[[int], None]] = []
+        # autocommit connection; transactions are explicit (_tx) so that
+        # (a) DDL participates in migration transactions and (b) multi-
+        # statement reads see one WAL snapshot even across handles
+        self._db = sqlite3.connect(
+            path, check_same_thread=False, isolation_level=None
+        )
+        self._db.execute("PRAGMA foreign_keys=ON")
+        if path != ":memory:":
+            self._db.execute("PRAGMA journal_mode=WAL")
+            self._db.execute("PRAGMA synchronous=NORMAL")
+        self._db.execute(
+            """CREATE TABLE IF NOT EXISTS keto_migrations (
+                version TEXT PRIMARY KEY, applied_at REAL NOT NULL)"""
+        )
+        # the reference auto-migrates only ephemeral stores
+        # (registry_default.go:316-327); file-backed stores migrate
+        # explicitly via `keto-tpu migrate up` unless told otherwise
+        if auto_migrate is None:
+            auto_migrate = path == ":memory:"
+        if auto_migrate:
+            self.migrate_up()
+
+    @contextmanager
+    def _tx(self, mode: str = "DEFERRED"):
+        """Explicit transaction: IMMEDIATE for writes (takes the write lock
+        up front), DEFERRED for consistent multi-statement reads."""
+        self._db.execute(f"BEGIN {mode}")
+        try:
+            yield
+        except BaseException:
+            self._db.execute("ROLLBACK")
+            raise
+        else:
+            self._db.execute("COMMIT")
+
+    # -- migrations ----------------------------------------------------------
+
+    def _applied(self) -> List[str]:
+        rows = self._db.execute(
+            "SELECT version FROM keto_migrations ORDER BY version"
+        ).fetchall()
+        return [r[0] for r in rows]
+
+    def migration_status(self) -> List[Tuple[str, str]]:
+        """[(version, 'applied'|'pending')] in order."""
+        applied = set(self._applied())
+        return [
+            (v, "applied" if v in applied else "pending")
+            for v, _, _ in MIGRATIONS
+        ]
+
+    def migrate_up(self) -> int:
+        """Apply all pending migrations; returns how many ran.  Each
+        migration's DDL + bookkeeping commit atomically (SQLite DDL is
+        transactional), so a crash leaves whole migrations, never halves."""
+        with self._lock:
+            applied = set(self._applied())
+            n = 0
+            for version, ups, _ in MIGRATIONS:
+                if version in applied:
+                    continue
+                with self._tx("IMMEDIATE"):
+                    for stmt in ups:
+                        self._db.execute(stmt)
+                    self._db.execute(
+                        "INSERT INTO keto_migrations VALUES (?, ?)",
+                        (version, time.time()),
+                    )
+                n += 1
+            return n
+
+    def migrate_down(self, steps: int = 1) -> int:
+        """Roll back the newest ``steps`` applied migrations atomically."""
+        with self._lock:
+            applied = self._applied()
+            n = 0
+            for version in reversed(applied):
+                if n >= steps:
+                    break
+                downs = next(d for v, _, d in MIGRATIONS if v == version)
+                with self._tx("IMMEDIATE"):
+                    for stmt in downs:
+                        self._db.execute(stmt)
+                    self._db.execute(
+                        "DELETE FROM keto_migrations WHERE version = ?",
+                        (version,),
+                    )
+                n += 1
+            return n
+
+    def _assert_migrated(self) -> None:
+        if len(self._applied()) < len(MIGRATIONS):
+            raise BadRequestError(
+                "database schema is not up to date: run `keto-tpu migrate up`"
+            )
+
+    # -- row codecs ----------------------------------------------------------
+
+    @staticmethod
+    def _subject_cols(t: RelationTuple) -> Tuple:
+        s = t.subject
+        if isinstance(s, SubjectSet):
+            return (None, s.namespace, s.object, s.relation)
+        return (s.id, None, None, None)
+
+    @staticmethod
+    def _decode(row) -> RelationTuple:
+        ns, obj, rel, sid, ssn, sso, ssr = row
+        subject = SubjectID(sid) if sid is not None else SubjectSet(ssn, sso, ssr)
+        return RelationTuple(ns, obj, rel, subject)
+
+    _COLS = (
+        "namespace, object, relation, subject_id, "
+        "subject_set_namespace, subject_set_object, subject_set_relation"
+    )
+
+    def _where(self, query: Optional[RelationQuery]) -> Tuple[str, List]:
+        clauses, args = ["nid = ?"], [self.nid]
+        if query is not None:
+            if query.namespace is not None:
+                clauses.append("namespace = ?")
+                args.append(query.namespace)
+            if query.object is not None:
+                clauses.append("object = ?")
+                args.append(query.object)
+            if query.relation is not None:
+                clauses.append("relation = ?")
+                args.append(query.relation)
+            subject = query.subject()
+            if subject is not None:
+                if isinstance(subject, SubjectSet):
+                    clauses.append(
+                        "subject_set_namespace = ? AND subject_set_object = ?"
+                        " AND subject_set_relation = ?"
+                    )
+                    args.extend([subject.namespace, subject.object, subject.relation])
+                else:
+                    clauses.append("subject_id = ?")
+                    args.append(subject.id)
+        return " AND ".join(clauses), args
+
+    # -- reads ---------------------------------------------------------------
+
+    def get_relation_tuples(
+        self,
+        query: Optional[RelationQuery] = None,
+        *,
+        page_token: str = "",
+        page_size: int = 0,
+    ) -> Tuple[List[RelationTuple], str]:
+        if page_size <= 0:
+            page_size = DEFAULT_PAGE_SIZE
+        after = -1
+        if page_token:
+            try:
+                after = int(page_token)
+            except ValueError:
+                raise ErrMalformedPageToken() from None
+        where, args = self._where(query)
+        with self._lock:
+            self._assert_migrated()
+            rows = self._db.execute(
+                f"SELECT seq, {self._COLS} FROM keto_relation_tuples"
+                f" WHERE {where} AND seq > ? ORDER BY seq LIMIT ?",
+                (*args, after, page_size + 1),
+            ).fetchall()
+        if len(rows) > page_size:
+            rows = rows[:page_size]
+            return [self._decode(r[1:]) for r in rows], str(rows[-1][0])
+        return [self._decode(r[1:]) for r in rows], ""
+
+    def exists_relation_tuples(self, query: Optional[RelationQuery] = None) -> bool:
+        where, args = self._where(query)
+        with self._lock:
+            self._assert_migrated()
+            row = self._db.execute(
+                f"SELECT 1 FROM keto_relation_tuples WHERE {where} LIMIT 1",
+                args,
+            ).fetchone()
+        return row is not None
+
+    def __len__(self) -> int:
+        with self._lock:
+            self._assert_migrated()
+            return self._db.execute(
+                "SELECT COUNT(*) FROM keto_relation_tuples WHERE nid = ?",
+                (self.nid,),
+            ).fetchone()[0]
+
+    def _all_tuples_locked(self) -> List[RelationTuple]:
+        rows = self._db.execute(
+            f"SELECT {self._COLS} FROM keto_relation_tuples"
+            " WHERE nid = ? ORDER BY seq",
+            (self.nid,),
+        ).fetchall()
+        return [self._decode(r) for r in rows]
+
+    def all_tuples(self) -> List[RelationTuple]:
+        with self._lock:
+            self._assert_migrated()
+            return self._all_tuples_locked()
+
+    def tuples_and_head(self) -> Tuple[List[RelationTuple], int]:
+        """Scan + log head in ONE read transaction: a write committed by
+        any other handle/process either lands in the scan or in a later
+        ``changes_since(head)`` drain — never in neither."""
+        with self._lock:
+            self._assert_migrated()
+            with self._tx():
+                return self._all_tuples_locked(), self._log_head_locked()
+
+    # -- change notification / version ---------------------------------------
+
+    def on_change(self, fn: Callable[[int], None]) -> None:
+        self._listeners.append(fn)
+
+    @property
+    def version(self) -> int:
+        with self._lock:
+            self._assert_migrated()
+            row = self._db.execute(
+                "SELECT value FROM keto_meta WHERE nid = ? AND key = 'version'",
+                (self.nid,),
+            ).fetchone()
+        return int(row[0]) if row else 0
+
+    def _bump_locked(self) -> int:
+        v = self.version + 1
+        self._db.execute(
+            "INSERT INTO keto_meta (nid, key, value) VALUES (?, 'version', ?)"
+            " ON CONFLICT (nid, key) DO UPDATE SET value = excluded.value",
+            (self.nid, str(v)),
+        )
+        return v
+
+    # -- writes --------------------------------------------------------------
+
+    def write_relation_tuples(self, *tuples: RelationTuple) -> None:
+        self.transact_relation_tuples(insert=tuples, delete=())
+
+    def delete_relation_tuples(self, *tuples: RelationTuple) -> None:
+        self.transact_relation_tuples(insert=(), delete=tuples)
+
+    def transact_relation_tuples(
+        self,
+        insert: Iterable[RelationTuple] = (),
+        delete: Iterable[RelationTuple] = (),
+    ) -> None:
+        """Inserts then deletes in one transaction
+        (sql/relationtuples.go:277-287)."""
+        insert, delete = list(insert), list(delete)
+        for t in insert:
+            if t.subject is None:
+                raise BadRequestError("subject is not allowed to be nil")
+        with self._lock:
+            self._assert_migrated()
+            with self._tx("IMMEDIATE"):
+                now = time.time()
+                for t in insert:
+                    self._db.execute(
+                        "INSERT INTO keto_relation_tuples"
+                        f" (nid, {self._COLS}, commit_time)"
+                        " VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?)",
+                        (self.nid, t.namespace, t.object, t.relation,
+                         *self._subject_cols(t), now),
+                    )
+                    self._log_locked(1, t)
+                n_deleted = 0
+                for t in delete:
+                    n_deleted += self._delete_exact_locked(t)
+                if insert or n_deleted:
+                    v = self._bump_locked()
+                else:
+                    v = None
+        if v is not None:
+            for fn in self._listeners:
+                fn(v)
+
+    def delete_all_relation_tuples(self, query: Optional[RelationQuery] = None) -> int:
+        where, args = self._where(query)
+        with self._lock:
+            self._assert_migrated()
+            with self._tx("IMMEDIATE"):
+                rows = self._db.execute(
+                    f"SELECT seq, {self._COLS} FROM keto_relation_tuples"
+                    f" WHERE {where} ORDER BY seq",
+                    args,
+                ).fetchall()
+                for r in rows:
+                    self._db.execute(
+                        "DELETE FROM keto_relation_tuples WHERE seq = ?", (r[0],)
+                    )
+                    self._log_locked(-1, self._decode(r[1:]))
+                v = self._bump_locked() if rows else None
+        if v is not None:
+            for fn in self._listeners:
+                fn(v)
+        return len(rows)
+
+    def _delete_exact_locked(self, t: RelationTuple) -> int:
+        sid, ssn, sso, ssr = self._subject_cols(t)
+        subj_clause = (
+            "subject_id = ?" if sid is not None
+            else "subject_set_namespace = ? AND subject_set_object = ?"
+                 " AND subject_set_relation = ?"
+        )
+        subj_args = [sid] if sid is not None else [ssn, sso, ssr]
+        rows = self._db.execute(
+            "SELECT seq FROM keto_relation_tuples"
+            " WHERE nid = ? AND namespace = ? AND object = ? AND relation = ?"
+            f" AND {subj_clause}",
+            (self.nid, t.namespace, t.object, t.relation, *subj_args),
+        ).fetchall()
+        for (seq,) in rows:
+            self._db.execute(
+                "DELETE FROM keto_relation_tuples WHERE seq = ?", (seq,)
+            )
+            self._log_locked(-1, t)
+        return len(rows)
+
+    # -- change log ----------------------------------------------------------
+
+    def _log_locked(self, op: int, t: RelationTuple) -> None:
+        self._db.execute(
+            "INSERT INTO keto_change_log"
+            f" (nid, op, {self._COLS}) VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?)",
+            (self.nid, op, t.namespace, t.object, t.relation,
+             *self._subject_cols(t)),
+        )
+        # bounded retention: drop entries beyond the cap for this nid and
+        # record the trim floor so stale cursors are detectable.  The
+        # boundary probe walks O(log_cap) index entries, so it runs every
+        # _trim_interval writes (the log may overshoot the cap by that
+        # interval — readers only need the floor to be accurate, which the
+        # meta update below keeps)
+        self._writes_since_trim += 1
+        if self._writes_since_trim < self._trim_interval:
+            return
+        self._writes_since_trim = 0
+        row = self._db.execute(
+            "SELECT id FROM keto_change_log WHERE nid = ?"
+            " ORDER BY id DESC LIMIT 1 OFFSET ?",
+            (self.nid, self._log_cap),
+        ).fetchone()
+        if row is not None:
+            self._db.execute(
+                "DELETE FROM keto_change_log WHERE nid = ? AND id <= ?",
+                (self.nid, row[0]),
+            )
+            self._db.execute(
+                "INSERT INTO keto_meta (nid, key, value)"
+                " VALUES (?, 'log_floor', ?) ON CONFLICT (nid, key)"
+                " DO UPDATE SET value = excluded.value",
+                (self.nid, str(row[0] + 1)),
+            )
+
+    def _log_head_locked(self) -> int:
+        row = self._db.execute(
+            "SELECT MAX(id) FROM keto_change_log"
+        ).fetchone()
+        return (row[0] or 0) + 1
+
+    @property
+    def log_head(self) -> int:
+        with self._lock:
+            self._assert_migrated()
+            return self._log_head_locked()
+
+    def changes_since(self, cursor: int):
+        """([(op, tuple)], head) for this nid since ``cursor``; (None, head)
+        when the bounded log no longer covers the cursor.  One read
+        transaction, rows bounded by the head read inside it, so repeated
+        drains never miss or double-deliver a cross-handle write."""
+        with self._lock:
+            self._assert_migrated()
+            with self._tx():
+                head = self._log_head_locked()
+                row = self._db.execute(
+                    "SELECT value FROM keto_meta"
+                    " WHERE nid = ? AND key = 'log_floor'",
+                    (self.nid,),
+                ).fetchone()
+                if row is not None and cursor < int(row[0]):
+                    return None, head  # trimmed past the cursor
+                rows = self._db.execute(
+                    f"SELECT op, {self._COLS} FROM keto_change_log"
+                    " WHERE nid = ? AND id >= ? AND id < ? ORDER BY id",
+                    (self.nid, cursor, head),
+                ).fetchall()
+        return [(r[0], self._decode(r[1:])) for r in rows], head
+
+    def close(self) -> None:
+        with self._lock:
+            self._db.close()

@@ -200,3 +200,20 @@ def test_version(capsys):
 
     assert cli.main(["version"]) == 0
     assert capsys.readouterr().out.strip() == ketotpu.__version__
+
+
+def test_cli_migrate_roundtrip(tmp_path, capsys):
+    cfgfile = tmp_path / "keto.yml"
+    cfgfile.write_text(
+        f"dsn: sqlite://{tmp_path / 'keto.db'}\n"
+        "namespaces: [{id: 0, name: n}]\n"
+    )
+    assert cli.main(["migrate", "-c", str(cfgfile), "status"]) == 0
+    assert "pending" in capsys.readouterr().out
+    assert cli.main(["migrate", "-c", str(cfgfile), "up"]) == 0
+    assert cli.main(["migrate", "-c", str(cfgfile), "status"]) == 0
+    out = capsys.readouterr().out
+    assert "applied" in out and "pending" not in out
+    assert cli.main(["migrate", "-c", str(cfgfile), "down", "--steps", "1"]) == 0
+    assert cli.main(["migrate", "-c", str(cfgfile), "status"]) == 0
+    assert "pending" in capsys.readouterr().out

@@ -15,6 +15,7 @@ from ketotpu.opl.ast import Namespace, Relation
 from ketotpu.storage import (
     InMemoryTupleStore,
     OPLFileNamespaceManager,
+    SQLiteTupleStore,
     StaticNamespaceManager,
     Traverser,
     ast_relation_for,
@@ -23,9 +24,13 @@ from ketotpu.storage import (
 T = RelationTuple.from_string
 
 
-@pytest.fixture
-def store():
-    return InMemoryTupleStore()
+# the reference exports its persister suite to run over every configured
+# backend (manager_requirements.go:25, full_test.go); same pattern here
+@pytest.fixture(params=["memory", "sqlite"])
+def store(request):
+    if request.param == "memory":
+        return InMemoryTupleStore()
+    return SQLiteTupleStore(":memory:")
 
 
 class TestManager:
@@ -197,3 +202,98 @@ class TestNamespaceManagers:
         assert ast_relation_for(m, "n", "r") is ns.relations[0]
         with pytest.raises(BadRequestError):  # declared ns, undeclared relation
             ast_relation_for(m, "n", "missing")
+
+
+class TestSQLitePersister:
+    """Durable-backend specifics: migrations, durability across handles,
+    nid isolation (manager_isolation.go:16), change-log continuity."""
+
+    def test_migration_status_and_down_up(self, tmp_path):
+        s = SQLiteTupleStore(str(tmp_path / "keto.db"), auto_migrate=False)
+        assert all(state == "pending" for _, state in s.migration_status())
+        from ketotpu.api.types import BadRequestError
+
+        with pytest.raises(BadRequestError):  # unmigrated schema refuses IO
+            s.write_relation_tuples(T("n:o#r@a"))
+        assert s.migrate_up() == len(s.migration_status())
+        assert all(state == "applied" for _, state in s.migration_status())
+        s.write_relation_tuples(T("n:o#r@a"))
+        assert s.migrate_down(1) == 1
+        assert s.migration_status()[-1][1] == "pending"
+        assert s.migrate_up() == 1
+
+    def test_durability_across_reopen(self, tmp_path):
+        path = str(tmp_path / "keto.db")
+        s1 = SQLiteTupleStore(path, auto_migrate=True)
+        s1.write_relation_tuples(T("n:o#r@alice"), T("n:o#r@n:g#m"))
+        v = s1.version
+        s1.close()
+        s2 = SQLiteTupleStore(path, auto_migrate=True)
+        assert [str(t) for t in s2.all_tuples()] == ["n:o#r@alice", "n:o#r@n:g#m"]
+        assert s2.version == v
+        s2.close()
+
+    def test_network_isolation(self, tmp_path):
+        path = str(tmp_path / "keto.db")
+        a = SQLiteTupleStore(path, network_id="net-a", auto_migrate=True)
+        b = SQLiteTupleStore(path, network_id="net-b", auto_migrate=True)
+        a.write_relation_tuples(T("n:o#r@alice"))
+        assert b.all_tuples() == [] and len(b) == 0
+        assert not b.exists_relation_tuples(RelationQuery(namespace="n"))
+        assert b.version == 0 and a.version == 1
+        assert b.delete_all_relation_tuples(None) == 0
+        assert len(a) == 1
+        a.close(); b.close()
+
+    def test_changes_since_cross_handle(self, tmp_path):
+        """A reader handle sees writes committed through another handle —
+        the durable replacement for read-committed SQL visibility."""
+        path = str(tmp_path / "keto.db")
+        w = SQLiteTupleStore(path, auto_migrate=True)
+        r = SQLiteTupleStore(path, auto_migrate=True)
+        cursor = r.log_head
+        w.write_relation_tuples(T("n:o#r@alice"))
+        w.delete_relation_tuples(T("n:o#r@alice"))
+        changes, head = r.changes_since(cursor)
+        assert [(op, str(t)) for op, t in changes] == [
+            (1, "n:o#r@alice"), (-1, "n:o#r@alice"),
+        ]
+        w.close(); r.close()
+
+    def test_log_trim_returns_none(self):
+        s = SQLiteTupleStore(":memory:", log_cap=4)
+        cursor = s.log_head
+        for i in range(12):
+            s.write_relation_tuples(T(f"n:o{i}#r@u{i}"))
+        changes, head = s.changes_since(cursor)
+        assert changes is None
+        changes, _ = s.changes_since(head)
+        assert changes == []
+        s.close()
+
+    def test_device_engine_over_sqlite(self, tmp_path):
+        """The TPU engine runs unmodified over the durable backend."""
+        jax = pytest.importorskip("jax")
+        from ketotpu.engine.tpu import DeviceCheckEngine
+        from ketotpu.opl.parser import parse
+
+        namespaces, errors = parse(
+            "class User implements Namespace {}\n"
+            "class Doc implements Namespace {\n"
+            "  related: { owners: User[] }\n"
+            "  permits = { view: (ctx) => this.related.owners.includes(ctx.subject) }\n"
+            "}"
+        )
+        assert not errors
+        store = SQLiteTupleStore(str(tmp_path / "keto.db"), auto_migrate=True)
+        store.write_relation_tuples(T("Doc:readme#owners@alice"))
+        eng = DeviceCheckEngine(
+            store, StaticNamespaceManager(namespaces), frontier=256, arena=512
+        )
+        assert eng.batch_check(
+            [T("Doc:readme#view@alice"), T("Doc:readme#view@bob")]
+        ) == [True, False]
+        # overlay path over sqlite
+        store.write_relation_tuples(T("Doc:readme#owners@bob"))
+        assert eng.batch_check([T("Doc:readme#view@bob")]) == [True]
+        store.close()
