@@ -428,6 +428,16 @@ class Tree:
             d["children"] = [c.to_json() for c in self.children]
         return d
 
+    @staticmethod
+    def from_json(d: Mapping) -> "Tree":
+        return Tree(
+            type=TreeNodeType(d.get("type", "unspecified")),
+            tuple=(
+                RelationTuple.from_json(d["tuple"]) if "tuple" in d else None
+            ),
+            children=[Tree.from_json(c) for c in d.get("children", ())],
+        )
+
     def label(self) -> str:
         return str(self.tuple) if self.tuple is not None else ""
 

@@ -1,0 +1,72 @@
+"""Embedder extension seam (`ketoctx/options.go:18-35` analog).
+
+The reference is embeddable as a library: Ory Network runs it multi-tenant
+by supplying a ``Contextualizer`` that derives the network id (and config)
+from each request, plus hooks for logger, tracer wrapping, extra HTTP
+middlewares, extra gRPC interceptors, and readiness checks
+(`ketoctx/options.go`, `contextualizer.go`).  ``KetoOptions`` is that
+options bag here; ``Registry(config, options=...)`` consumes it.
+
+The contextualizer is live, not decorative: handlers resolve a per-request
+registry via ``Registry.resolve(request_metadata)``; a non-default network
+id routes to a derived registry with its own store handle (same durable
+file, different ``nid`` rows — see storage/sqlite.py multi-tenancy) and its
+own engine snapshots.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Mapping, Optional, Protocol
+
+#: request header / gRPC metadata key carrying the tenant network id
+NETWORK_HEADER = "x-keto-network"
+
+
+class Contextualizer(Protocol):
+    """Per-request tenant resolution (`ketoctx/contextualizer.go`)."""
+
+    def network(self, metadata: Mapping[str, str], fallback: str) -> str:
+        """Network id for this request; ``fallback`` is the process-wide
+        default (networkx DetermineNetwork analog)."""
+        ...
+
+
+class StaticContextualizer:
+    """Single-tenant: every request lives on the default network."""
+
+    def network(self, metadata: Mapping[str, str], fallback: str) -> str:
+        return fallback
+
+
+class HeaderContextualizer:
+    """Multi-tenant by trusted header/metadata (the Ory Network pattern:
+    an auth proxy in front injects the tenant id)."""
+
+    def __init__(self, header: str = NETWORK_HEADER):
+        self.header = header.lower()
+
+    def network(self, metadata: Mapping[str, str], fallback: str) -> str:
+        return metadata.get(self.header, fallback) or fallback
+
+
+@dataclass
+class KetoOptions:
+    """WithLogger/WithTracerWrapper/WithContextualizer/... analog."""
+
+    logger: Optional[object] = None
+    tracer_wrapper: Optional[Callable[[object], object]] = None
+    contextualizer: Contextualizer = field(default_factory=StaticContextualizer)
+    # REST middlewares: fn(method, path, request, next) -> (status, body,
+    # headers); ``next`` is zero-arg and runs the rest of the chain
+    # (negroni-style, ketoctx WithHTTPMiddlewares)
+    rest_middlewares: List[Callable] = field(default_factory=list)
+    # gRPC server interceptors (grpc.ServerInterceptor instances,
+    # ketoctx WithGRPCUnaryInterceptors)
+    grpc_interceptors: List[object] = field(default_factory=list)
+    # extra schema migrations appended to storage.sqlite.MIGRATIONS
+    # (ketoctx WithExtraMigrations)
+    extra_migrations: List[tuple] = field(default_factory=list)
+    # name -> zero-arg callable raising on unhealthy
+    # (ketoctx WithReadinessCheck)
+    readiness_checks: Dict[str, Callable[[], None]] = field(default_factory=dict)

@@ -58,10 +58,14 @@ class Registry:
         namespace_manager=None,
         readiness_checks: Optional[Dict[str, Callable[[], None]]] = None,
         network_id: uuid.UUID = DEFAULT_NETWORK_ID,
+        options: Optional["KetoOptions"] = None,
     ):
+        from ketotpu.ctx import KetoOptions
+
         self.config = config if config is not None else Provider()
+        self.options = options if options is not None else KetoOptions()
         self._lock = threading.RLock()
-        self._logger = logger
+        self._logger = logger if logger is not None else self.options.logger
         self._tracer = tracer
         self._metrics = metrics
         self._store = store
@@ -74,7 +78,12 @@ class Registry:
         self._uuid_mapper = None
         self.network_id = network_id
         self.readiness_checks = dict(readiness_checks or {})
+        self.readiness_checks.update(self.options.readiness_checks)
         self.version = __version__
+        # per-tenant derived registries (Contextualizer targets), LRU order
+        from collections import OrderedDict
+
+        self._tenants: "OrderedDict[str, Registry]" = OrderedDict()
 
     # -- cross-cutting ------------------------------------------------------
 
@@ -95,8 +104,57 @@ class Registry:
     def tracer(self) -> Tracer:
         with self._lock:
             if self._tracer is None:
-                self._tracer = Tracer(self.metrics(), self.logger())
+                t = Tracer(self.metrics(), self.logger())
+                if self.options.tracer_wrapper is not None:
+                    t = self.options.tracer_wrapper(t)
+                self._tracer = t
             return self._tracer
+
+    # -- multi-tenancy (ketoctx Contextualizer seam) ------------------------
+
+    def resolve(self, metadata: Optional[Dict[str, str]] = None) -> "Registry":
+        """Per-request registry: the options' Contextualizer maps request
+        metadata (HTTP headers / gRPC metadata, lower-cased keys) to a
+        network id; non-default ids get a derived registry whose store and
+        engines live on that network (`registry_default.go:121-126`)."""
+        nid = self.options.contextualizer.network(
+            metadata or {}, str(self.network_id)
+        )
+        if nid == str(self.network_id):
+            return self
+        return self.for_network(nid)
+
+    #: bound on cached tenant registries — the contextualizer key may be
+    #: client-influenced, so the cache must not grow without limit
+    MAX_TENANTS = 256
+
+    def for_network(self, nid: str) -> "Registry":
+        """Derived registry sharing config/observability/namespaces but
+        with tenant-scoped storage, engines, and UUID mapping.  Bounded
+        LRU: beyond MAX_TENANTS the least-recently-used tenant is evicted
+        (its store closed); its durable rows are untouched and it rebuilds
+        on next use."""
+        with self._lock:
+            reg = self._tenants.pop(nid, None)
+            if reg is None:
+                reg = Registry(
+                    self.config,
+                    logger=self.logger(),
+                    tracer=self.tracer(),
+                    metrics=self.metrics(),
+                    namespace_manager=self.namespace_manager(),
+                    store=self._build_store(nid),
+                    readiness_checks=self.readiness_checks,
+                    network_id=uuid.uuid5(self.network_id, nid),
+                    options=self.options,
+                )
+            self._tenants[nid] = reg  # reinsert = most recently used
+            while len(self._tenants) > self.MAX_TENANTS:
+                _, evicted = self._tenants.popitem(last=False)
+                close = getattr(evicted._store, "close", None)
+                if close is not None:
+                    close()
+            return reg
 
     # -- storage + namespaces ----------------------------------------------
 
@@ -106,21 +164,26 @@ class Registry:
         `keto-tpu migrate up` unless the path is ``:memory:``)."""
         with self._lock:
             if self._store is None:
-                dsn = self.config.dsn()
-                if dsn == "memory":
-                    self._store = InMemoryTupleStore()
-                elif dsn.startswith(("sqlite://", "sqlite:")):
-                    from ketotpu.storage.sqlite import SQLiteTupleStore
-
-                    path = dsn.split("://", 1)[-1] if "://" in dsn \
-                        else dsn.split(":", 1)[1]
-                    self._store = SQLiteTupleStore(
-                        path or ":memory:",
-                        network_id=str(self.network_id),
-                    )
-                else:
-                    raise ConfigError("dsn", f"unsupported dsn {dsn!r}")
+                self._store = self._build_store(str(self.network_id))
             return self._store
+
+    def _build_store(self, nid: str):
+        """One dsn-dispatch path for the default network and every tenant
+        (a tenant must never silently land on a different backend)."""
+        dsn = self.config.dsn()
+        if dsn == "memory":
+            return InMemoryTupleStore()  # per-registry: tenants isolated
+        if dsn.startswith(("sqlite://", "sqlite:")):
+            from ketotpu.storage.sqlite import SQLiteTupleStore
+
+            path = dsn.split("://", 1)[-1] if "://" in dsn \
+                else dsn.split(":", 1)[1]
+            return SQLiteTupleStore(
+                path or ":memory:",
+                network_id=nid,
+                extra_migrations=self.options.extra_migrations,
+            )
+        raise ConfigError("dsn", f"unsupported dsn {dsn!r}")
 
     def namespace_manager(self):
         """Resolve the polymorphic namespaces config (provider.go:311-342):
@@ -177,12 +240,18 @@ class Registry:
                 )
             return self._oracle_engine
 
-    def expand_engine(self) -> ExpandEngine:
+    def expand_engine(self):
         with self._lock:
             if self._expand_engine is None:
-                self._expand_engine = ExpandEngine(
-                    self.store(), max_depth=self.config.max_read_depth()
-                )
+                check = self.check_engine()
+                if isinstance(check, DeviceCheckEngine):
+                    # device-batched expand with host DFS reassembly
+                    # (engine/expand_device.py); oracle fallback inside
+                    self._expand_engine = _DeviceExpandAdapter(check)
+                else:
+                    self._expand_engine = ExpandEngine(
+                        self.store(), max_depth=self.config.max_read_depth()
+                    )
             return self._expand_engine
 
     # -- mapping ------------------------------------------------------------
@@ -236,6 +305,17 @@ class Registry:
             except Exception as e:  # noqa: BLE001 - reported, not raised
                 out[name] = str(e)
         return out
+
+
+class _DeviceExpandAdapter:
+    """ExpandEngine facade over DeviceCheckEngine.batch_expand so the
+    handler's build_tree seam (expand/engine.go:43) stays engine-agnostic."""
+
+    def __init__(self, engine: DeviceCheckEngine):
+        self._engine = engine
+
+    def build_tree(self, subject, rest_depth: int = 0):
+        return self._engine.batch_expand([subject], rest_depth)[0]
 
 
 def _strip_file_uri(location: str) -> str:

@@ -1,0 +1,251 @@
+"""Python client SDK over the REST API.
+
+The reference ships a generated Swagger SDK (`internal/httpclient/`,
+regenerated from `spec/api.json`) that its e2e suite drives as the fourth
+transport (`internal/e2e/full_suit_test.go:65-94`).  This is the same
+artifact for this framework: a typed client over the public REST surface,
+returning the package's own API types (`ketotpu.api.types`) and raising
+its typed errors on failure.
+
+Stdlib-only (urllib), synchronous, one class per API port pairing:
+
+    sdk = KetoClient("http://127.0.0.1:4466", "http://127.0.0.1:4467")
+    sdk.check("File", "doc", "view", SubjectID("alice"))    -> bool
+    sdk.expand(SubjectSet("File", "doc", "view"))           -> Tree | None
+    sdk.list_relation_tuples(RelationQuery(namespace="n"))  -> (rows, token)
+    sdk.create_relation_tuple(t) / sdk.delete_relation_tuple(t)
+    sdk.patch([("insert", t), ("delete", u)])
+    sdk.delete_relation_tuples(RelationQuery(...))
+    sdk.check_opl_syntax(source)                            -> [errors]
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+from ketotpu.api.types import (
+    BadRequestError,
+    KetoAPIError,
+    NotFoundError,
+    RelationQuery,
+    RelationTuple,
+    Subject,
+    SubjectSet,
+    Tree,
+)
+
+
+class SDKError(KetoAPIError):
+    """Non-2xx response that maps to no specific API error."""
+
+    def __init__(self, status: int, body: str):
+        super().__init__(f"unexpected status {status}: {body[:200]}")
+        self.status = status
+        self.body = body
+
+
+class KetoClient:
+    def __init__(
+        self,
+        read_url: str,
+        write_url: Optional[str] = None,
+        *,
+        opl_url: Optional[str] = None,
+        timeout: float = 30.0,
+    ):
+        self.read_url = read_url.rstrip("/")
+        self.write_url = (write_url or read_url).rstrip("/")
+        self.opl_url = (opl_url or read_url).rstrip("/")
+        self.timeout = timeout
+
+    # -- transport ----------------------------------------------------------
+
+    def _request(
+        self, method: str, url: str, body: Optional[dict | list] = None
+    ) -> Tuple[int, str]:
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            url,
+            data=data,
+            method=method,
+            headers={"Content-Type": "application/json"} if data else {},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return resp.status, resp.read().decode()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read().decode()
+
+    @staticmethod
+    def _raise_for(status: int, body: str):
+        if status == 400:
+            raise BadRequestError(_error_message(body))
+        if status == 404:
+            raise NotFoundError(_error_message(body))
+        raise SDKError(status, body)
+
+    # -- check --------------------------------------------------------------
+
+    def check(
+        self,
+        namespace: str,
+        object: str,
+        relation: str,
+        subject: Subject,
+        *,
+        max_depth: int = 0,
+    ) -> bool:
+        """Permission check via the non-mirroring openapi variant
+        (`getCheckNoStatus`, check/handler.go:156): unknown namespace is
+        ``False``, not an error."""
+        r = RelationTuple(namespace, object, relation, subject)
+        q = urllib.parse.urlencode(
+            dict(r.to_url_query(), **({"max-depth": str(max_depth)} if max_depth else {}))
+        )
+        status, body = self._request(
+            "GET", f"{self.read_url}/relation-tuples/check/openapi?{q}"
+        )
+        if status != 200:
+            self._raise_for(status, body)
+        return bool(json.loads(body)["allowed"])
+
+    def check_tuple(self, t: RelationTuple, *, max_depth: int = 0) -> bool:
+        return self.check(
+            t.namespace, t.object, t.relation, t.subject, max_depth=max_depth
+        )
+
+    # -- expand -------------------------------------------------------------
+
+    def expand(
+        self, subject_set: SubjectSet, *, max_depth: int = 0
+    ) -> Optional[Tree]:
+        params = {
+            "namespace": subject_set.namespace,
+            "object": subject_set.object,
+            "relation": subject_set.relation,
+        }
+        if max_depth:
+            params["max-depth"] = str(max_depth)
+        q = urllib.parse.urlencode(params)
+        status, body = self._request(
+            "GET", f"{self.read_url}/relation-tuples/expand?{q}"
+        )
+        if status == 404:
+            return None  # empty expansion (expand/handler.go:98-101)
+        if status != 200:
+            self._raise_for(status, body)
+        return Tree.from_json(json.loads(body))
+
+    # -- relation tuples ----------------------------------------------------
+
+    def list_relation_tuples(
+        self,
+        query: Optional[RelationQuery] = None,
+        *,
+        page_token: str = "",
+        page_size: int = 0,
+    ) -> Tuple[List[RelationTuple], str]:
+        params = dict(query.to_url_query()) if query is not None else {}
+        if page_token:
+            params["page_token"] = page_token
+        if page_size:
+            params["page_size"] = str(page_size)
+        q = urllib.parse.urlencode(params)
+        status, body = self._request(
+            "GET", f"{self.read_url}/relation-tuples?{q}"
+        )
+        if status != 200:
+            self._raise_for(status, body)
+        data = json.loads(body)
+        return (
+            [RelationTuple.from_json(d) for d in data["relation_tuples"]],
+            data.get("next_page_token", ""),
+        )
+
+    def create_relation_tuple(self, t: RelationTuple) -> RelationTuple:
+        status, body = self._request(
+            "PUT", f"{self.write_url}/admin/relation-tuples", t.to_json()
+        )
+        if status not in (200, 201):
+            self._raise_for(status, body)
+        return RelationTuple.from_json(json.loads(body))
+
+    def delete_relation_tuple(self, t: RelationTuple) -> None:
+        self._delete(t.to_url_query())
+
+    def delete_relation_tuples(self, query: RelationQuery) -> None:
+        """Delete everything the query matches (DELETE /admin/relation-tuples
+        with query params, transact_server.go:72)."""
+        self._delete(query.to_url_query())
+
+    def _delete(self, params: dict) -> None:
+        q = urllib.parse.urlencode(params)
+        status, body = self._request(
+            "DELETE", f"{self.write_url}/admin/relation-tuples?{q}"
+        )
+        if status != 204:
+            self._raise_for(status, body)
+
+    def patch(
+        self, deltas: Sequence[Tuple[str, RelationTuple]]
+    ) -> None:
+        """PATCH /admin/relation-tuples with [{action, relation_tuple}]
+        deltas; action is "insert" or "delete" (handler.go PATCH route)."""
+        body = [
+            {"action": action, "relation_tuple": t.to_json()}
+            for action, t in deltas
+        ]
+        status, out = self._request(
+            "PATCH", f"{self.write_url}/admin/relation-tuples", body
+        )
+        if status != 204:
+            self._raise_for(status, out)
+
+    # -- opl ----------------------------------------------------------------
+
+    def check_opl_syntax(self, source: str) -> List[dict]:
+        """Parse errors for an OPL document ([] = valid), POST
+        /opl/syntax/check (schema/handler.go:31-45)."""
+        req = urllib.request.Request(
+            f"{self.opl_url}/opl/syntax/check",
+            data=source.encode(),
+            method="POST",
+            headers={"Content-Type": "text/plain"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                status, body = resp.status, resp.read().decode()
+        except urllib.error.HTTPError as e:
+            status, body = e.code, e.read().decode()
+        if status != 200:
+            self._raise_for(status, body)
+        return json.loads(body).get("errors", [])
+
+    # -- meta ---------------------------------------------------------------
+
+    def health(self) -> bool:
+        status, _ = self._request("GET", f"{self.read_url}/health/ready")
+        return status == 200
+
+    def version(self) -> str:
+        status, body = self._request("GET", f"{self.read_url}/version")
+        if status != 200:
+            self._raise_for(status, body)
+        return json.loads(body)["version"]
+
+
+def _error_message(body: str) -> str:
+    try:
+        data = json.loads(body)
+        if isinstance(data, dict):
+            err = data.get("error", data)
+            if isinstance(err, dict):
+                return str(err.get("message", body))
+            return str(err)
+    except (json.JSONDecodeError, TypeError):
+        pass
+    return body

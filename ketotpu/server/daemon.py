@@ -174,6 +174,9 @@ class Server:
         server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=16),
             options=[("grpc.so_reuseport", 0)],
+            # embedder-supplied interceptors (ketoctx
+            # WithGRPCUnaryInterceptors, daemon.go:450-486 chain)
+            interceptors=tuple(self.registry.options.grpc_interceptors),
         )
         for name, servicer in services.items():
             add_servicer_to_server(name, servicer, server)

@@ -67,6 +67,15 @@ _GRPC_CODES = {
 }
 
 
+def _md(context) -> dict:
+    """gRPC invocation metadata as a lower-cased dict (for the
+    Contextualizer seam, ketoctx/contextualizer.go)."""
+    try:
+        return {k.lower(): v for k, v in (context.invocation_metadata() or ())}
+    except Exception:  # noqa: BLE001 - metadata is best-effort
+        return {}
+
+
 def _abort(context, e: Exception):
     """Map a typed API error onto the gRPC status surface (the herodot
     error-unwrap interceptor, daemon.go:468-478)."""
@@ -82,40 +91,49 @@ class CheckHandler:
     def __init__(self, registry):
         self.r = registry
 
-    def check_core(self, tuple_: RelationTuple, max_depth: int) -> bool:
+    def check_core(
+        self, tuple_: RelationTuple, max_depth: int, r=None
+    ) -> bool:
         """Engine dispatch incl. the unknown-namespace probe the Mapper does
         (uuid_mapping.go:199 via GetNamespaceByName); raises NotFoundError
         for unknown namespaces — REST swallows it, gRPC propagates."""
-        with self.r.tracer().span("check.Engine.CheckIsMember"):
+        r = r if r is not None else self.r
+        with r.tracer().span("check.Engine.CheckIsMember"):
             # ReadOnlyMapper: namespace checks + validation without interning
-            self.r.read_only_mapper().from_tuple(tuple_)
-            allowed = self.r.check_engine().check_is_member(tuple_, max_depth)
-        self.r.tracer().event(PERMISSIONS_CHECKED)
-        self.r.metrics().counter(
+            r.read_only_mapper().from_tuple(tuple_)
+            allowed = r.check_engine().check_is_member(tuple_, max_depth)
+        r.tracer().event(PERMISSIONS_CHECKED)
+        r.metrics().counter(
             "keto_checks_total", 1, help="authorization checks served",
             allowed=str(allowed).lower(),
         )
         return allowed
 
-    def check_rest(self, tuple_: RelationTuple, max_depth: int) -> bool:
+    def check_rest(
+        self, tuple_: RelationTuple, max_depth: int, headers=None
+    ) -> bool:
         try:
-            return self.check_core(tuple_, max_depth)
+            return self.check_core(
+                tuple_, max_depth, self.r.resolve(headers)
+            )
         except NotFoundError:
             return False  # check/handler.go:169-171
 
-    def snaptoken(self) -> str:
+    def snaptoken(self, r=None) -> str:
         """A real snaptoken: the store version the verdict was computed at
         (the Zanzibar zookie the reference stubs, check_service.proto:51-60)."""
-        return f"v{self.r.store().version}"
+        r = r if r is not None else self.r
+        return f"v{r.store().version}"
 
     # gRPC CheckService.Check
     def Check(self, request, context):
         try:
+            r = self.r.resolve(_md(context))
             src = request.tuple if request.HasField("tuple") else request
             tuple_ = tuple_from_proto(src)
-            allowed = self.check_core(tuple_, int(request.max_depth))
+            allowed = self.check_core(tuple_, int(request.max_depth), r)
             return check_service_pb2.CheckResponse(
-                allowed=allowed, snaptoken=self.snaptoken()
+                allowed=allowed, snaptoken=self.snaptoken(r)
             )
         except Exception as e:  # noqa: BLE001 - mapped to status codes
             _abort(context, e)
@@ -127,12 +145,13 @@ class ExpandHandler:
     def __init__(self, registry):
         self.r = registry
 
-    def expand_core(self, subject, max_depth: int):
-        with self.r.tracer().span("expand.Engine.BuildTree"):
+    def expand_core(self, subject, max_depth: int, r=None):
+        r = r if r is not None else self.r
+        with r.tracer().span("expand.Engine.BuildTree"):
             if isinstance(subject, SubjectSet):
-                self.r.read_only_mapper().from_subject_set(subject)  # ns check
-            tree = self.r.expand_engine().build_tree(subject, max_depth)
-        self.r.tracer().event(PERMISSIONS_EXPANDED)
+                r.read_only_mapper().from_subject_set(subject)  # ns check
+            tree = r.expand_engine().build_tree(subject, max_depth)
+        r.tracer().event(PERMISSIONS_EXPANDED)
         return tree
 
     # gRPC ExpandService.Expand
@@ -152,7 +171,9 @@ class ExpandHandler:
                 )
             s = request.subject.set
             subject = SubjectSet(s.namespace, s.object, s.relation)
-            tree = self.expand_core(subject, int(request.max_depth))
+            tree = self.expand_core(
+                subject, int(request.max_depth), self.r.resolve(_md(context))
+            )
             if tree is None:
                 return expand_service_pb2.ExpandResponse()
             return expand_service_pb2.ExpandResponse(tree=tree_to_proto(tree))
@@ -169,32 +190,35 @@ class RelationTupleHandler:
 
     # -- cores --------------------------------------------------------------
 
-    def list_core(self, query, page_size: int, page_token: str):
-        with self.r.tracer().span("relationtuple.Manager.GetRelationTuples"):
+    def list_core(self, query, page_size: int, page_token: str, r=None):
+        r = r if r is not None else self.r
+        with r.tracer().span("relationtuple.Manager.GetRelationTuples"):
             if query is not None and query.namespace is not None:
                 # FromQuery namespace resolution (uuid_mapping.go:82-90)
-                self.r.read_only_mapper().from_query(query)
-            tuples, next_token = self.r.store().get_relation_tuples(
+                r.read_only_mapper().from_query(query)
+            tuples, next_token = r.store().get_relation_tuples(
                 query, page_size=page_size or 100, page_token=page_token or ""
             )
         return tuples, next_token
 
-    def transact_core(self, inserts, deletes):
-        with self.r.tracer().span("relationtuple.Manager.TransactRelationTuples"):
+    def transact_core(self, inserts, deletes, r=None):
+        r = r if r is not None else self.r
+        with r.tracer().span("relationtuple.Manager.TransactRelationTuples"):
             if inserts or deletes:
-                self.r.mapper().from_tuple(*inserts, *deletes)  # validate + ns
-            self.r.store().transact_relation_tuples(inserts, deletes)
-        self.r.tracer().event(RELATIONTUPLES_CHANGED)
-        self.r.metrics().counter(
+                r.mapper().from_tuple(*inserts, *deletes)  # validate + ns
+            r.store().transact_relation_tuples(inserts, deletes)
+        r.tracer().event(RELATIONTUPLES_CHANGED)
+        r.metrics().counter(
             "keto_relationtuples_writes_total", 1, help="tuple transactions"
         )
 
-    def delete_all_core(self, query) -> int:
-        with self.r.tracer().span("relationtuple.Manager.DeleteAllRelationTuples"):
+    def delete_all_core(self, query, r=None) -> int:
+        r = r if r is not None else self.r
+        with r.tracer().span("relationtuple.Manager.DeleteAllRelationTuples"):
             if query is not None and query.namespace is not None:
-                self.r.read_only_mapper().from_query(query)
-            n = self.r.store().delete_all_relation_tuples(query)
-        self.r.tracer().event(RELATIONTUPLES_DELETED)
+                r.read_only_mapper().from_query(query)
+            n = r.store().delete_all_relation_tuples(query)
+        r.tracer().event(RELATIONTUPLES_DELETED)
         return n
 
     # -- gRPC ReadService ---------------------------------------------------
@@ -217,7 +241,8 @@ class RelationTupleHandler:
             else:
                 raise BadRequestError("you must provide a query")
             tuples, next_token = self.list_core(
-                query, int(request.page_size), request.page_token
+                query, int(request.page_size), request.page_token,
+                self.r.resolve(_md(context)),
             )
             return read_service_pb2.ListRelationTuplesResponse(
                 relation_tuples=[tuple_to_proto(t) for t in tuples],
@@ -237,9 +262,10 @@ class RelationTupleHandler:
                     inserts.append(t)
                 elif delta.action == write_service_pb2.RelationTupleDelta.ACTION_DELETE:
                     deletes.append(t)
-            self.transact_core(inserts, deletes)
+            r = self.r.resolve(_md(context))
+            self.transact_core(inserts, deletes, r)
             return write_service_pb2.TransactRelationTuplesResponse(
-                snaptokens=[f"v{self.r.store().version}"] * len(inserts)
+                snaptokens=[f"v{r.store().version}"] * len(inserts)
             )
         except Exception as e:  # noqa: BLE001
             _abort(context, e)
@@ -261,7 +287,7 @@ class RelationTupleHandler:
                     query = query.with_subject(subject_from_proto(q.subject))
             else:
                 raise BadRequestError("invalid request")
-            self.delete_all_core(query)
+            self.delete_all_core(query, self.r.resolve(_md(context)))
             return write_service_pb2.DeleteRelationTuplesResponse()
         except Exception as e:  # noqa: BLE001
             _abort(context, e)

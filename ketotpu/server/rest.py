@@ -126,26 +126,36 @@ class Router:
     # -- dispatch ------------------------------------------------------------
 
     def dispatch(self, method: str, path: str, req) -> Tuple[int, object, Dict]:
-        fn = self.routes.get((method, path))
-        if fn is None:
-            known_methods = [m for (m, p) in self.routes if p == path]
-            if known_methods:
-                return 405, _error_body(405, "method not allowed"), {}
-            return 404, _error_body(404, "route not found"), {}
         try:
-            out = fn(req)
-            if len(out) == 2:
-                status, body = out
-                headers: Dict[str, str] = {}
-            else:
-                status, body, headers = out
-            return status, body, headers
+            # embedder middlewares run outermost (negroni-style chain,
+            # ketoctx WithHTTPMiddlewares); each gets a zero-arg `next`
+            chain = lambda: self._route(method, path, req)  # noqa: E731
+            for mw in reversed(self.r.options.rest_middlewares):
+                chain = (lambda m, nxt: lambda: m(method, path, req, nxt))(
+                    mw, chain
+                )
+            return chain()
         except KetoAPIError as e:
             code = e.status_code or 500
             return code, _error_body(code, str(e)), {}
         except Exception as e:  # noqa: BLE001 - the panic-recovery interceptor
             self.r.logger().exception("handler panic: %s", e)
             return 500, _error_body(500, str(e)), {}
+
+    def _route(self, method: str, path: str, req) -> Tuple[int, object, Dict]:
+        fn = self.routes.get((method, path))
+        if fn is None:
+            known_methods = [m for (m, p) in self.routes if p == path]
+            if known_methods:
+                return 405, _error_body(405, "method not allowed"), {}
+            return 404, _error_body(404, "route not found"), {}
+        out = fn(req)
+        if len(out) == 2:
+            status, body = out
+            headers: Dict[str, str] = {}
+        else:
+            status, body, headers = out
+        return status, body, headers
 
 
 def _error_body(code: int, message: str) -> dict:
@@ -161,9 +171,15 @@ def _error_body(code: int, message: str) -> dict:
 class Request:
     """Parsed request handed to route functions."""
 
-    def __init__(self, query: Dict[str, str], body: bytes):
+    def __init__(
+        self,
+        query: Dict[str, str],
+        body: bytes,
+        headers: Optional[Dict[str, str]] = None,
+    ):
         self.query = query
         self.body = body
+        self.headers = headers or {}  # lower-cased names
 
     def json(self):
         try:
@@ -192,7 +208,7 @@ def read_router(registry) -> Router:
     def get_check(mirror: bool):
         def handler(req):
             tuple_ = RelationTuple.from_url_query(req.query)
-            allowed = check.check_rest(tuple_, _max_depth(req.query))
+            allowed = check.check_rest(tuple_, _max_depth(req.query), req.headers)
             status = 403 if (mirror and not allowed) else 200
             return status, {"allowed": allowed}
 
@@ -201,7 +217,7 @@ def read_router(registry) -> Router:
     def post_check(mirror: bool):
         def handler(req):
             tuple_ = RelationTuple.from_json(req.json() or {})
-            allowed = check.check_rest(tuple_, _max_depth(req.query))
+            allowed = check.check_rest(tuple_, _max_depth(req.query), req.headers)
             status = 403 if (mirror and not allowed) else 200
             return status, {"allowed": allowed}
 
@@ -218,7 +234,9 @@ def read_router(registry) -> Router:
             object=req.query.get("object", ""),
             relation=req.query.get("relation", ""),
         )
-        tree = expand.expand_core(subject, _max_depth(req.query))
+        tree = expand.expand_core(
+            subject, _max_depth(req.query), registry.resolve(req.headers)
+        )
         if tree is None:
             return 404, _error_body(404, "no relation tuple found")
         return 200, tree.to_json()
@@ -234,7 +252,8 @@ def read_router(registry) -> Router:
             except ValueError as e:
                 raise BadRequestError(str(e)) from None
         out, next_token = tuples.list_core(
-            query, page_size, req.query.get("page_token", "")
+            query, page_size, req.query.get("page_token", ""),
+            registry.resolve(req.headers),
         )
         return 200, {
             "relation_tuples": [t.to_json() for t in out],
@@ -260,7 +279,7 @@ def write_router(registry) -> Router:
 
     def put_tuple(req):
         tuple_ = RelationTuple.from_json(req.json() or {})
-        tuples.transact_core([tuple_], [])
+        tuples.transact_core([tuple_], [], registry.resolve(req.headers))
         registry.tracer().event(RELATIONTUPLES_CREATED)
         # urlencode: raw values in a header invite response splitting
         location = "/relation-tuples?" + urlencode(tuple_.to_url_query())
@@ -278,7 +297,7 @@ def write_router(registry) -> Router:
         if req.body:
             raise BadRequestError("the request body must be empty")
         query = RelationQuery.from_url_query(req.query)
-        tuples.delete_all_core(query)
+        tuples.delete_all_core(query, registry.resolve(req.headers))
         return 204, None
 
     def patch_tuples(req):
@@ -297,7 +316,7 @@ def write_router(registry) -> Router:
                 deletes.append(t)
             else:
                 raise BadRequestError(f"unknown action {action}")
-        tuples.transact_core(inserts, deletes)
+        tuples.transact_core(inserts, deletes, registry.resolve(req.headers))
         return 204, None
 
     rt.add("PUT", "/admin/relation-tuples", put_tuple)
@@ -340,8 +359,9 @@ def make_http_server(router: Router, host: str, port: int) -> ThreadingHTTPServe
             query = _flatten_query(parse_qs(parsed.query))
             length = int(self.headers.get("Content-Length") or 0)
             body = self.rfile.read(length) if length else b""
+            hdrs = {k.lower(): v for k, v in self.headers.items()}
             status, payload, extra = router.dispatch(
-                method, parsed.path, Request(query, body)
+                method, parsed.path, Request(query, body, hdrs)
             )
             if payload is None:
                 data = b""

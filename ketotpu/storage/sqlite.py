@@ -120,10 +120,15 @@ class SQLiteTupleStore:
         network_id: str = DEFAULT_NID,
         auto_migrate: Optional[bool] = None,
         log_cap: int = 65536,
+        extra_migrations: Iterable[Tuple[str, List[str], List[str]]] = (),
     ):
         self._lock = threading.RLock()
         self.path = path
         self.nid = network_id
+        # embedder migrations append after the built-ins (the reference's
+        # MigrationBox merges keto + embedder migrations,
+        # registry_default.go:247-273 / ketoctx WithExtraMigrations)
+        self.migrations = MIGRATIONS + list(extra_migrations)
         self._log_cap = log_cap
         # trim probes walk O(log_cap) index entries; amortize them
         self._trim_interval = max(1, min(1024, log_cap // 4))
@@ -177,7 +182,7 @@ class SQLiteTupleStore:
         applied = set(self._applied())
         return [
             (v, "applied" if v in applied else "pending")
-            for v, _, _ in MIGRATIONS
+            for v, _, _ in self.migrations
         ]
 
     def migrate_up(self) -> int:
@@ -187,7 +192,7 @@ class SQLiteTupleStore:
         with self._lock:
             applied = set(self._applied())
             n = 0
-            for version, ups, _ in MIGRATIONS:
+            for version, ups, _ in self.migrations:
                 if version in applied:
                     continue
                 with self._tx("IMMEDIATE"):
@@ -208,7 +213,7 @@ class SQLiteTupleStore:
             for version in reversed(applied):
                 if n >= steps:
                     break
-                downs = next(d for v, _, d in MIGRATIONS if v == version)
+                downs = next(d for v, _, d in self.migrations if v == version)
                 with self._tx("IMMEDIATE"):
                     for stmt in downs:
                         self._db.execute(stmt)
@@ -220,7 +225,7 @@ class SQLiteTupleStore:
             return n
 
     def _assert_migrated(self) -> None:
-        if len(self._applied()) < len(MIGRATIONS):
+        if len(self._applied()) < len(self.migrations):
             raise BadRequestError(
                 "database schema is not up to date: run `keto-tpu migrate up`"
             )

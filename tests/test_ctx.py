@@ -1,0 +1,207 @@
+"""Embedder seam tests (ketoctx/options.go analog): contextualizer-driven
+multi-tenancy, REST middlewares, gRPC interceptors, tracer wrapping."""
+
+import json
+import urllib.error
+import urllib.request
+
+import grpc
+import pytest
+
+from ketotpu.api.types import RelationTuple
+from ketotpu.ctx import HeaderContextualizer, KetoOptions, NETWORK_HEADER
+from ketotpu.driver import Provider, Registry
+from ketotpu.proto import check_service_pb2 as cs
+from ketotpu.proto import relation_tuples_pb2 as rts
+from ketotpu.proto.services import CheckServiceStub
+from ketotpu.server import serve_all
+
+T = RelationTuple.from_string
+
+
+def _cfg(tmp_path):
+    return Provider(
+        {
+            "dsn": f"sqlite://{tmp_path / 'keto.db'}",
+            "serve": {
+                n: {"host": "127.0.0.1", "port": 0}
+                for n in ("read", "write", "metrics", "opl")
+            },
+            "namespaces": [
+                {"id": 0, "name": "doc", "relations": ["viewers"]}
+            ],
+            "engine": {"kind": "oracle"},
+        }
+    )
+
+
+class _CountingInterceptor(grpc.ServerInterceptor):
+    def __init__(self):
+        self.calls = 0
+
+    def intercept_service(self, continuation, handler_call_details):
+        self.calls += 1
+        return continuation(handler_call_details)
+
+
+@pytest.fixture()
+def tenant_server(tmp_path):
+    seen_paths = []
+
+    def audit_mw(method, path, req, next_):
+        seen_paths.append((method, path))
+        return next_()
+
+    interceptor = _CountingInterceptor()
+    wrapped = []
+
+    def tracer_wrapper(t):
+        wrapped.append(t)
+        return t
+
+    opts = KetoOptions(
+        contextualizer=HeaderContextualizer(),
+        rest_middlewares=[audit_mw],
+        grpc_interceptors=[interceptor],
+        tracer_wrapper=tracer_wrapper,
+    )
+    # migrate the shared file up front (file dsns don't auto-migrate)
+    reg = Registry(_cfg(tmp_path), options=opts)
+    reg.store().migrate_up()
+    reg.init()
+    srv = serve_all(reg)
+    yield srv, reg, seen_paths, interceptor, wrapped
+    srv.stop()
+
+
+def _check(addr, headers=None, subject="alice"):
+    req = urllib.request.Request(
+        "http://%s:%d/relation-tuples/check/openapi?" % tuple(addr)
+        + f"namespace=doc&object=d1&relation=viewers&subject_id={subject}",
+        headers=headers or {},
+    )
+    with urllib.request.urlopen(req) as resp:
+        return json.loads(resp.read())["allowed"]
+
+
+def _put(addr, tuple_json, headers=None):
+    req = urllib.request.Request(
+        "http://%s:%d/admin/relation-tuples" % tuple(addr),
+        data=json.dumps(tuple_json).encode(),
+        method="PUT",
+        headers=headers or {},
+    )
+    urllib.request.urlopen(req).read()
+
+
+def test_header_contextualizer_isolates_tenants(tenant_server):
+    srv, reg, *_ = tenant_server
+    read, write = srv.addresses["read"], srv.addresses["write"]
+    t = {"namespace": "doc", "object": "d1", "relation": "viewers",
+         "subject_id": "alice"}
+
+    _put(write, t, {NETWORK_HEADER: "tenant-a"})
+    assert _check(read, {NETWORK_HEADER: "tenant-a"}) is True
+    # other tenants (and the default network) don't see tenant-a's tuple
+    assert _check(read, {NETWORK_HEADER: "tenant-b"}) is False
+    assert _check(read) is False
+    # rows are nid-isolated in the shared durable file
+    assert reg.for_network("tenant-a").store().all_tuples() == [
+        T("doc:d1#viewers@alice")
+    ]
+    assert reg.for_network("tenant-b").store().all_tuples() == []
+
+
+def test_grpc_metadata_contextualizer(tenant_server):
+    srv, *_ = tenant_server
+    t = {"namespace": "doc", "object": "d2", "relation": "viewers",
+         "subject_id": "bob"}
+    _put(srv.addresses["write"], t, {NETWORK_HEADER: "tenant-g"})
+
+    with grpc.insecure_channel("%s:%d" % tuple(srv.addresses["read"])) as ch:
+        stub = CheckServiceStub(ch)
+        req = cs.CheckRequest(
+            tuple=rts.RelationTuple(
+                namespace="doc", object="d2", relation="viewers",
+                subject=rts.Subject(id="bob"),
+            )
+        )
+        allowed_g = stub.Check(
+            req, metadata=((NETWORK_HEADER, "tenant-g"),)
+        ).allowed
+        allowed_default = stub.Check(req).allowed
+    assert allowed_g is True and allowed_default is False
+
+
+def test_rest_middleware_and_grpc_interceptor_ran(tenant_server):
+    srv, reg, seen_paths, interceptor, wrapped = tenant_server
+    _check(srv.addresses["read"])
+    assert ("GET", "/relation-tuples/check/openapi") in seen_paths
+    assert interceptor.calls == 0  # REST traffic must not touch gRPC
+    with grpc.insecure_channel("%s:%d" % tuple(srv.addresses["read"])) as ch:
+        CheckServiceStub(ch).Check(
+            cs.CheckRequest(
+                tuple=rts.RelationTuple(
+                    namespace="doc", object="x", relation="viewers",
+                    subject=rts.Subject(id="y"),
+                )
+            )
+        )
+    assert interceptor.calls >= 1
+    assert wrapped, "tracer_wrapper was not applied"
+
+
+def test_middleware_can_short_circuit(tmp_path):
+    def deny_all(method, path, req, next_):
+        if path.startswith("/admin"):
+            return 403, {"error": {"code": 403, "message": "read-only"}}, {}
+        return next_()
+
+    reg = Registry(
+        _cfg(tmp_path), options=KetoOptions(rest_middlewares=[deny_all])
+    )
+    reg.store().migrate_up()
+    srv = serve_all(reg.init())
+    try:
+        t = {"namespace": "doc", "object": "d", "relation": "viewers",
+             "subject_id": "s"}
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _put(srv.addresses["write"], t)
+        assert e.value.code == 403
+    finally:
+        srv.stop()
+
+
+def test_extra_migrations_applied(tmp_path):
+    opts = KetoOptions(
+        extra_migrations=[
+            ("90000000000001_audit",
+             ["CREATE TABLE embedder_audit (id INTEGER PRIMARY KEY)"],
+             ["DROP TABLE embedder_audit"]),
+        ]
+    )
+    reg = Registry(_cfg(tmp_path), options=opts)
+    store = reg.store()
+    assert store.migrate_up() == 4  # 3 built-ins + 1 embedder migration
+    store._db.execute("INSERT INTO embedder_audit VALUES (1)")
+    assert [v for v, s in store.migration_status() if s == "applied"][-1] \
+        == "90000000000001_audit"
+
+
+def test_tenant_cache_is_bounded(tmp_path):
+    reg = Registry(_cfg(tmp_path), options=KetoOptions())
+    reg.store().migrate_up()
+    reg.MAX_TENANTS = 4
+    for i in range(10):
+        reg.for_network(f"t{i}")
+    assert len(reg._tenants) == 4
+    assert set(reg._tenants) == {"t6", "t7", "t8", "t9"}
+    # evicted tenant rebuilds transparently; durable rows survive eviction
+    reg.for_network("t0").store().write_relation_tuples(
+        T("doc:d#viewers@a")
+    )
+    for i in range(1, 10):
+        reg.for_network(f"t{i}")
+    assert reg.for_network("t0").store().all_tuples() == [
+        T("doc:d#viewers@a")
+    ]

@@ -380,3 +380,73 @@ class TestAuxSurfaces:
     def test_unknown_route_404_known_route_wrong_method_405(self, read_addr):
         assert _http("GET", f"{read_addr}/nope")[0] == 404
         assert _http("POST", f"{read_addr}/relation-tuples")[0] == 405
+
+
+class TestSDKTransport:
+    """Fourth transport of the e2e matrix (full_suit_test.go:65-94): the
+    Python SDK (ketotpu/sdk.py) over REST, same shared case list."""
+
+    @pytest.fixture()
+    def sdk(self, read_addr, write_addr):
+        from ketotpu.sdk import KetoClient
+
+        return KetoClient(read_addr, write_addr)
+
+    def test_check_cases(self, sdk):
+        for case, want in CASES:
+            r = _parse_case(case)
+            assert sdk.check_tuple(r) is want, case
+
+    def test_expand_and_none(self, sdk):
+        from ketotpu.api.types import SubjectSet, TreeNodeType
+
+        tree = sdk.expand(SubjectSet("Folder", "keto", "viewers"), max_depth=3)
+        assert tree is not None and tree.type == TreeNodeType.UNION
+        assert "bob" in json.dumps(tree.to_json())
+        assert sdk.expand(SubjectSet("Folder", "none", "viewers")) is None
+
+    def test_write_list_delete_cycle(self, sdk):
+        from ketotpu.api.types import RelationQuery
+
+        t = RelationTuple.from_string("Group:sdk#members@carol")
+        created = sdk.create_relation_tuple(t)
+        assert created == t
+        rows, _ = sdk.list_relation_tuples(RelationQuery(object="sdk"))
+        assert rows == [t]
+        assert sdk.check_tuple(
+            RelationTuple.from_string("Group:sdk#members@carol")
+        )
+        sdk.delete_relation_tuple(t)
+        rows, _ = sdk.list_relation_tuples(RelationQuery(object="sdk"))
+        assert rows == []
+
+    def test_patch_deltas(self, sdk):
+        from ketotpu.api.types import RelationQuery
+
+        a = RelationTuple.from_string("Group:sdkp#members@dave")
+        b = RelationTuple.from_string("Group:sdkp#members@erin")
+        sdk.patch([("insert", a), ("insert", b)])
+        sdk.patch([("delete", a)])
+        rows, _ = sdk.list_relation_tuples(RelationQuery(object="sdkp"))
+        assert rows == [b]
+        sdk.patch([("delete", b)])
+
+    def test_opl_syntax_check(self, sdk, server):
+        from ketotpu.sdk import KetoClient
+
+        opl = KetoClient("http://%s:%d" % tuple(server.addresses["opl"]))
+        assert opl.check_opl_syntax("class A implements Namespace {}") == []
+        errs = opl.check_opl_syntax("class ??? {")
+        assert errs and all("message" in e for e in errs)
+
+    def test_version_and_health(self, sdk):
+        import ketotpu
+
+        assert sdk.health() is True
+        assert sdk.version() == ketotpu.__version__
+
+    def test_errors_are_typed(self, sdk):
+        from ketotpu.api.types import BadRequestError
+
+        with pytest.raises(BadRequestError):
+            sdk.list_relation_tuples(page_token="not-a-token")
