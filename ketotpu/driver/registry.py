@@ -21,6 +21,7 @@ determine the network id, warm the engine snapshot.
 
 from __future__ import annotations
 
+import os
 import threading
 import uuid
 from typing import Any, Callable, Dict, List, Optional
@@ -35,6 +36,7 @@ from ketotpu.observability import Metrics, Tracer, make_logger
 from ketotpu.opl.ast import Namespace
 from ketotpu.storage.memory import InMemoryTupleStore
 from ketotpu.storage.namespaces import (
+    DirectoryNamespaceManager,
     OPLFileNamespaceManager,
     StaticNamespaceManager,
 )
@@ -192,12 +194,11 @@ class Registry:
             if self._namespace_manager is None:
                 ns_cfg = self.config.namespaces_config()
                 if isinstance(ns_cfg, dict):
-                    location = ns_cfg.get("location", "")
-                    self._namespace_manager = OPLFileNamespaceManager(
-                        _strip_file_uri(location)
+                    self._namespace_manager = _uri_manager(
+                        _strip_file_uri(ns_cfg.get("location", ""))
                     )
                 elif isinstance(ns_cfg, str):
-                    self._namespace_manager = OPLFileNamespaceManager(
+                    self._namespace_manager = _uri_manager(
                         _strip_file_uri(ns_cfg)
                     )
                 else:
@@ -316,6 +317,14 @@ class _DeviceExpandAdapter:
 
     def build_tree(self, subject, rest_depth: int = 0):
         return self._engine.batch_expand([subject], rest_depth)[0]
+
+
+def _uri_manager(path: str):
+    """URI namespace flavor (provider.go:315-342): a directory is the
+    legacy per-file watcher, a file is an OPL document."""
+    if os.path.isdir(path):
+        return DirectoryNamespaceManager(path)
+    return OPLFileNamespaceManager(path)
 
 
 def _strip_file_uri(location: str) -> str:

@@ -315,10 +315,15 @@ class DeviceCheckEngine:
         qpad = min(_bucket(n), self.frontier)
         padded = self._pad(enc, n, qpad)
         fast_active = np.pad(~(err | general), (0, qpad - n))
-        res = fp.run_fast(
+        # ONE packed upload + ONE packed verdict download per chunk: each
+        # separate transfer is a full host-link round-trip (fastpath
+        # _run_fused_packed)
+        qpack = np.stack([*padded, fast_active.astype(np.int32)]).astype(
+            np.int32
+        )
+        res = fp.run_fast_packed(
             self._device_arrays,
-            *padded,
-            fast_active,
+            qpack,
             frontier=self.frontier,
             arena=self.arena,
             max_depth=self.max_depth,
@@ -361,13 +366,10 @@ class DeviceCheckEngine:
             allowed[gi] = codes == dev.R_IS
             fallback[gi] |= gover | (codes == dev.R_ERR)
 
-        found = np.asarray(res.found)[:n]
-        over = np.asarray(res.over)[:n]
-        dirty = (
-            np.asarray(res.dirty)[:n]
-            if res.dirty is not None
-            else np.zeros(n, bool)
-        )
+        codes = np.asarray(res)[:n]  # one D2H fetch for all three masks
+        found = (codes & 1).astype(bool)
+        over = ((codes >> 1) & 1).astype(bool)
+        dirty = ((codes >> 2) & 1).astype(bool)
         fmask = ~(err | general)
         allowed[fmask] = found[fmask]
         # dirty queries touched a CSR row with pending writes: the oracle
@@ -384,10 +386,12 @@ class DeviceCheckEngine:
             rpad = min(_bucket(len(ri), 256), self.retry_scale * self.frontier)
             renc = self._pad(tuple(a[ri] for a in enc), len(ri), rpad)
             self.retries += len(ri)
-            rres = fp.run_fast(
+            rpack = np.stack(
+                [*renc, (np.arange(rpad) < len(ri)).astype(np.int32)]
+            ).astype(np.int32)
+            rres = fp.run_fast_packed(
                 self._device_arrays,
-                *renc,
-                np.arange(rpad) < len(ri),
+                rpack,
                 frontier=self.retry_scale * self.frontier,
                 arena=self.retry_scale * self.arena,
                 max_depth=self.max_depth,
@@ -397,13 +401,10 @@ class DeviceCheckEngine:
                 # and with a small retry batch the caps alone don't bind
                 boost=self.retry_scale,
             )
-            rfound = np.asarray(rres.found)[: len(ri)]
-            rover = np.asarray(rres.over)[: len(ri)]
-            rdirty = (
-                np.asarray(rres.dirty)[: len(ri)]
-                if rres.dirty is not None
-                else np.zeros(len(ri), bool)
-            )
+            rcodes = np.asarray(rres)[: len(ri)]
+            rfound = (rcodes & 1).astype(bool)
+            rover = ((rcodes >> 1) & 1).astype(bool)
+            rdirty = ((rcodes >> 2) & 1).astype(bool)
             allowed[ri] = rfound
             unres[ri] = (rover | rdirty) & ~rfound
         fallback |= unres

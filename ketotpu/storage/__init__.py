@@ -8,6 +8,7 @@ projected CSR snapshots of it, not the store directly.
 from ketotpu.storage.memory import ErrMalformedPageToken, InMemoryTupleStore
 from ketotpu.storage.sqlite import MIGRATIONS, SQLiteTupleStore
 from ketotpu.storage.namespaces import (
+    DirectoryNamespaceManager,
     OPLFileNamespaceManager,
     StaticNamespaceManager,
     ast_relation_for,
@@ -19,6 +20,7 @@ from ketotpu.storage.traverser import (
 )
 
 __all__ = [
+    "DirectoryNamespaceManager",
     "ErrMalformedPageToken",
     "InMemoryTupleStore",
     "MIGRATIONS",

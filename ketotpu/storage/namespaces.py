@@ -102,6 +102,91 @@ class OPLFileNamespaceManager:
         return list(self._namespaces)
 
 
+class DirectoryNamespaceManager:
+    """Legacy namespace-directory watcher (`namespace_watcher.go:54`):
+    one yaml/json/toml file per namespace (the pre-OPL config format,
+    e.g. ``{"id": 0, "name": "videos"}`` — cat-videos-example shape),
+    re-scanned on directory or file mtime change.  Files that fail to
+    parse are skipped with rollback-to-previous semantics per file, like
+    the reference's per-file watcher events; a failed parse still records
+    the file's mtime so the broken content is not re-parsed until it
+    changes (namespaces()/get_namespace() sit on the check hot path via
+    the engine's config fingerprint)."""
+
+    _EXTS = (".yml", ".yaml", ".json", ".toml")
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._namespaces: dict = {}  # filename -> Namespace
+        self._mtimes: dict = {}
+        self._scan(initial=True)
+
+    @staticmethod
+    def _parse_file(fname: str):
+        with open(fname, "rb") as f:
+            raw = f.read()
+        if fname.endswith(".json"):
+            import json
+
+            data = json.loads(raw)
+        elif fname.endswith(".toml"):
+            import tomllib
+
+            data = tomllib.loads(raw.decode("utf-8"))
+        else:
+            import yaml
+
+            data = yaml.safe_load(raw)
+        if not isinstance(data, dict) or not data.get("name"):
+            raise BadRequestError("namespace file must define 'name'")
+        return Namespace(str(data["name"]))
+
+    def _scan(self, *, initial: bool = False) -> None:
+        try:
+            entries = sorted(
+                e for e in os.listdir(self.path)
+                if e.endswith(self._EXTS)
+            )
+        except OSError as e:
+            if initial:
+                raise BadRequestError(
+                    f"cannot read namespace directory {self.path!r}: {e}"
+                ) from None
+            return
+        seen = set()
+        for name in entries:
+            fname = os.path.join(self.path, name)
+            try:
+                mtime = os.stat(fname).st_mtime
+            except OSError:
+                continue
+            seen.add(name)
+            if self._mtimes.get(name) == mtime:
+                continue
+            try:
+                self._namespaces[name] = self._parse_file(fname)
+            except Exception:  # noqa: BLE001 - per-file rollback
+                pass  # keep the previous parse of this file, if any
+            self._mtimes[name] = mtime
+        for gone in set(self._mtimes) - seen:
+            self._namespaces.pop(gone, None)
+            del self._mtimes[gone]
+
+    def get_namespace(self, name: str) -> Namespace:
+        with self._lock:
+            self._scan()
+            for n in self._namespaces.values():
+                if n.name == name:
+                    return n
+        raise NotFoundError(f"namespace {name!r} was not found")
+
+    def namespaces(self) -> List[Namespace]:
+        with self._lock:
+            self._scan()
+            return list(self._namespaces.values())
+
+
 def ast_relation_for(
     manager: NamespaceManager, namespace: str, relation: str
 ) -> Optional[Relation]:

@@ -362,3 +362,58 @@ class TestErrorPositions:
         assert j["message"]
         assert set(j["start"]) == {"Line", "column"}
         assert j["start"]["Line"] == 1
+
+
+class TestParserFuzz:
+    """Parser robustness (the reference fuzzes its OPL parser with
+    libFuzzer, internal/schema/parser_fuzzer.go:6-9): arbitrary input must
+    produce namespaces or ParseErrors, never an exception."""
+
+    def test_random_byte_soup(self):
+        import random
+
+        rng = random.Random(0)
+        alphabet = (
+            "class implements Namespace related permits this ctx subject "
+            "{}()[]<>:;,.|&!=> \"'`\n\t\\ abc123 é世 // /* */"
+        )
+        for _ in range(300):
+            src = "".join(
+                rng.choice(alphabet) for _ in range(rng.randrange(0, 200))
+            )
+            parse(src)  # must not raise
+
+    def test_mutated_valid_source(self):
+        import random
+
+        base = (
+            'class User implements Namespace {}\n'
+            'class Doc implements Namespace {\n'
+            '  related: { viewers: (User | SubjectSet<Group, "members">)[] }\n'
+            '  permits = { view: (ctx: Context): boolean => '
+            'this.related.viewers.includes(ctx.subject) }\n'
+            '}\n'
+        )
+        rng = random.Random(1)
+        for _ in range(300):
+            chars = list(base)
+            for _ in range(rng.randrange(1, 6)):
+                op = rng.randrange(3)
+                pos = rng.randrange(len(chars))
+                if op == 0:
+                    del chars[pos]
+                elif op == 1:
+                    chars.insert(pos, rng.choice("{}()<>|&!:;,.@#"))
+                else:
+                    chars[pos] = rng.choice("{}()<>|&!:;,.@#x ")
+            parse("".join(chars))  # must not raise
+
+    def test_deep_nesting_is_limited_not_fatal(self):
+        # nesting cap 10 (limits.go:13): deep parens must error, not crash
+        deep = "(" * 200 + "ctx.subject" + ")" * 200
+        src = (
+            "class A implements Namespace { permits = { p: (ctx) => "
+            f"this.related.r.includes({deep}) }} }}"
+        )
+        _, errors = parse(src)
+        assert errors  # rejected with a ParseError, not a RecursionError

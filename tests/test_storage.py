@@ -297,3 +297,56 @@ class TestSQLitePersister:
         store.write_relation_tuples(T("Doc:readme#owners@bob"))
         assert eng.batch_check([T("Doc:readme#view@bob")]) == [True]
         store.close()
+
+
+class TestDirectoryNamespaceManager:
+    """Legacy namespace-dir watcher (namespace_watcher.go:54): per-file
+    yaml/json/toml namespaces, mtime rescan, per-file rollback."""
+
+    def _mgr(self, tmp_path):
+        from ketotpu.storage.namespaces import DirectoryNamespaceManager
+
+        (tmp_path / "a.yml").write_text("id: 0\nname: videos\n")
+        (tmp_path / "b.json").write_text('{"id": 1, "name": "files"}')
+        (tmp_path / "c.toml").write_text('id = 2\nname = "groups"\n')
+        (tmp_path / "ignored.txt").write_text("not a namespace")
+        return DirectoryNamespaceManager(str(tmp_path))
+
+    def test_scans_all_formats(self, tmp_path):
+        # a stray broken file must not block startup: it is skipped
+        (tmp_path / "broken.yml").write_text(":::not yaml {{{")
+        m = self._mgr(tmp_path)
+        assert sorted(n.name for n in m.namespaces()) == [
+            "files", "groups", "videos",
+        ]
+        assert m.get_namespace("videos").name == "videos"
+        with pytest.raises(NotFoundError):
+            m.get_namespace("nope")
+
+    def test_add_remove_and_rollback(self, tmp_path):
+        import os
+
+        m = self._mgr(tmp_path)
+        # new file appears
+        p = tmp_path / "d.yml"
+        p.write_text("name: docs\n")
+        assert "docs" in {n.name for n in m.namespaces()}
+        # broken rewrite rolls back to the previous parse of that file
+        p.write_text(":::not yaml {{{")
+        os.utime(p, (0, 99999))
+        assert "docs" in {n.name for n in m.namespaces()}
+        # removal drops the namespace
+        p.unlink()
+        assert "docs" not in {n.name for n in m.namespaces()}
+
+    def test_registry_resolves_directory_uri(self, tmp_path):
+        from ketotpu.driver import Provider, Registry
+
+        (tmp_path / "ns.yml").write_text("name: videos\n")
+        reg = Registry(Provider({
+            "dsn": "memory",
+            "namespaces": f"file://{tmp_path}",
+        }))
+        assert [n.name for n in reg.namespace_manager().namespaces()] == [
+            "videos"
+        ]
