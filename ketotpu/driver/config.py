@@ -75,6 +75,10 @@ def _defaults() -> Dict[str, Any]:
             # multi-chip: 0 = single device; n>0 = shard over an n-device mesh
             "mesh_devices": 0,
             "mesh_axis": "shard",
+            # optional projection checkpoint path: resumed at boot when it
+            # matches the store version + namespace config; every full
+            # rebuild refreshes it (engine/checkpoint.py)
+            "checkpoint": "",
         },
         "log": {"level": "info", "format": "text"},
     }

@@ -288,11 +288,22 @@ class Registry:
 
     def init(self) -> "Registry":
         """Eager init (RegistryDefault.Init analog): resolve config into
-        live components and warm the device snapshot."""
+        live components and warm the device snapshot — resuming from the
+        configured projection checkpoint when it is still valid, and
+        refreshing it after the warm build otherwise."""
         self.namespace_manager()
         self.store()
         eng = self.check_engine()
         if isinstance(eng, DeviceCheckEngine):
+            ckpt_path = str(self.config.get("engine.checkpoint") or "")
+            if ckpt_path:
+                resumed = eng.load_checkpoint(ckpt_path)
+                # every full rebuild from here on refreshes the checkpoint
+                eng.checkpoint_path = ckpt_path
+                self.logger().info(
+                    "projection checkpoint %s: %s", ckpt_path,
+                    "resumed" if resumed else "stale/absent, will refresh",
+                )
             eng.snapshot()
         return self
 

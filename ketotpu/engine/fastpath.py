@@ -48,6 +48,24 @@ the parent with a frontier-sized one a level later (cheaper), and it is
 the only formulation that shards: the target row lives on the owner shard
 of the child's object, so only the owner can probe it.
 
+Kernel strategy (SURVEY §7 step 6, measured on a v5 lite chip): the
+per-level cost is bounded by arena-sized random gathers from HBM tables
+(~6-18 ms per 196k-element gather; probes, scans, scatters and the
+linear-dedup pack measure at noise level beside them).  Pallas/Mosaic
+alternatives were evaluated and rejected with measurements rather than
+assumed: (a) one fused [A,16] row gather — 2.5x SLOWER than 16 separate
+1-D gathers under XLA's TPU lowering; (b) a VMEM-resident table with
+`jnp.take` inside a Pallas kernel — Mosaic lowers only same-shape 2-D
+`take_along_axis`, not 1-D/arbitrary gather; (c) a scalar `fori_loop`
+gather kernel — Mosaic forbids scalar stores to VMEM; (d) one-hot matmul
+gathers on the MXU — the on-the-fly one-hot compare costs A*N VPU ops,
+which loses to the native gather for every table size in play.  XLA's
+gather is the best available primitive for this access pattern on this
+hardware, so the engine's wins come from doing *fewer and smaller*
+gathers (lean per-level schedules, child-level EXISTS probes, linear
+scatter dedup instead of sorts) and from eliminating host round-trips
+(fused multi-level dispatch, packed query upload / verdict download).
+
 Exploration order differs from the sequential oracle in one deliberate way:
 instead of the oracle's per-expansion-subtree visited sets (DFS order,
 `engine.go:119`, `x/graph/graph_utils.go:38-53`), each level merges duplicate

@@ -38,6 +38,7 @@ this version, SURVEY §2 proto row).
 
 from __future__ import annotations
 
+import hashlib
 from typing import List, Optional, Sequence
 
 import jax
@@ -75,7 +76,13 @@ def config_fingerprint(manager: Optional[NamespaceManager]) -> int:
     """
     if manager is None:
         return 0
-    return hash(tuple(repr(ns) for ns in manager.namespaces()))
+    # stable across processes (unlike hash(), which is seed-randomized):
+    # checkpoint resume compares fingerprints across server restarts
+    digest = hashlib.sha256()
+    for ns in manager.namespaces():
+        digest.update(repr(ns).encode())
+        digest.update(b"\x00")
+    return int.from_bytes(digest.digest()[:8], "big", signed=True)
 
 
 class DeviceCheckEngine:
@@ -132,6 +139,10 @@ class DeviceCheckEngine:
         self.retries = 0  # observability: device-retry (tier-2) counter
         self.rebuilds = 0  # observability: full snapshot rebuilds
         self.overlay_applies = 0  # observability: O(delta) write applications
+        # when set, every full rebuild refreshes this projection checkpoint
+        # (engine/checkpoint.py); save failures count, never raise
+        self.checkpoint_path: Optional[str] = None
+        self.checkpoint_errors = 0
 
     # -- snapshot lifecycle -------------------------------------------------
     #
@@ -188,6 +199,16 @@ class DeviceCheckEngine:
             ),
         )
         self.rebuilds += 1
+        if self.checkpoint_path:
+            from ketotpu.engine import checkpoint as ckpt
+
+            try:
+                ckpt.save_snapshot(
+                    self._snap, self.checkpoint_path,
+                    extra={"fingerprint": fingerprint},
+                )
+            except OSError:
+                self.checkpoint_errors += 1
 
     def snapshot(self) -> Snapshot:
         fingerprint = config_fingerprint(self.namespace_manager)
@@ -199,8 +220,11 @@ class DeviceCheckEngine:
             self._rebuild(fingerprint)
             return self._snap
         if changes:
-            for op, t in changes:
-                self._cols.apply(op, t)
+            if self._cols is not None:
+                # keep the column mirror current; after a checkpoint resume
+                # it is None and _sync_cols rescans at the next rebuild
+                for op, t in changes:
+                    self._cols.apply(op, t)
             self._log_cursor = head
             try:
                 dl.apply_changes(self._overlay, self._snap, self._vocab, changes)
@@ -229,6 +253,66 @@ class DeviceCheckEngine:
         """Force a full rebuild (the CheckRequest.latest consistency knob —
         stronger than needed, since overlay probes are already exact)."""
         self._rebuild(config_fingerprint(self.namespace_manager))
+
+    # -- checkpoint / resume (SURVEY §5.4) ----------------------------------
+
+    def save_checkpoint(self, path: str) -> None:
+        """Persist the current projection; restart skips re-projection when
+        the store version and namespace config still match.  An active
+        delta overlay is folded in by a full rebuild first — the overlay is
+        not serialized, so saving the stale base would persist a projection
+        whose version never matches the store."""
+        from ketotpu.engine import checkpoint as ckpt
+
+        snap = self.snapshot()
+        if self._overlay_active:
+            self.refresh()
+            snap = self._snap
+        # stamp the fingerprint the snapshot was BUILT under, not a fresh
+        # read: a file-backed config reloading between build and save must
+        # not mis-stamp a stale projection as current
+        ckpt.save_snapshot(
+            snap, path, extra={"fingerprint": self._snap_fingerprint}
+        )
+
+    def load_checkpoint(self, path: str) -> bool:
+        """Install a checkpoint if it matches the live store version and
+        namespace config; returns False (and leaves state untouched) when
+        it doesn't — the next snapshot() then projects from the store.
+        Any load failure (missing, truncated, corrupt, or foreign file) is
+        a graceful refusal, never a boot-loop crash."""
+        from ketotpu.engine import checkpoint as ckpt
+
+        fingerprint = config_fingerprint(self.namespace_manager)
+        try:
+            snap = ckpt.load_snapshot(
+                path, want_extra={"fingerprint": fingerprint}
+            )
+        except Exception:  # noqa: BLE001 - refusal is the contract
+            return False
+        # read the log head BEFORE comparing versions: a write landing
+        # between the two reads then fails the version check (reading in
+        # the other order would skip that write's log entry forever)
+        log_head = self.store.log_head
+        if snap.version != self.store.version:
+            return False  # store moved since the save: stale projection
+        self._snap = snap
+        self._snap_fingerprint = fingerprint
+        self._vocab = snap.vocab
+        self._cols = None  # lazily re-mirrored on the next full rebuild
+        self._log_cursor = log_head
+        self._overlay = dl.OverlayState()
+        self._overlay_active = False
+        self._base_device = jax.device_put(snap.arrays())
+        self._device_arrays = dict(
+            self._base_device,
+            **jax.device_put(
+                dl.overlay_arrays(
+                    self._overlay, snap, pair_cap=self.max_overlay_pairs
+                )
+            ),
+        )
+        return True
 
     # -- query encoding -----------------------------------------------------
 
