@@ -306,6 +306,42 @@ def cmd_status(args) -> int:
             time.sleep(1.0)
 
 
+def cmd_ns_generate_opl(args) -> int:
+    """Legacy namespace config(s) -> an OPL document template
+    (cmd/namespace/opl_generate.go:20).  Accepts per-namespace files
+    (yaml/json/toml with a top-level name) or whole config files carrying
+    a ``namespaces:`` list."""
+    import yaml
+
+    from ketotpu.storage.namespaces import DirectoryNamespaceManager
+
+    names = []
+    for p in args.files:
+        if p.endswith((".json", ".toml")):
+            # extension-dispatching per-namespace parser (shared with the
+            # legacy directory watcher)
+            try:
+                names.append(DirectoryNamespaceManager._parse_file(p).name)
+            except Exception as e:  # noqa: BLE001 - CLI-facing message
+                print(f"{p}: {e}", file=sys.stderr)
+                return 1
+            continue
+        data = yaml.safe_load(pathlib.Path(p).read_text())
+        if isinstance(data, dict) and "namespaces" in data:
+            data = data["namespaces"]
+        items = data if isinstance(data, list) else [data]
+        for d in items:
+            name = (d or {}).get("name") if isinstance(d, dict) else None
+            if not name:
+                print(f"{p}: entry without a namespace name", file=sys.stderr)
+                return 1
+            names.append(str(name))
+    print('import { Namespace, Context } from "@ory/keto-namespace-types"\n')
+    for name in names:
+        print(f"class {name} implements Namespace {{}}\n")
+    return 0
+
+
 def cmd_migrate(args) -> int:
     """Schema migrations for durable dsns (cmd/migrate/, popx analog).
     Runs locally against the configured dsn — no server required."""
@@ -421,6 +457,11 @@ def build_parser() -> argparse.ArgumentParser:
     ns_validate = nssub.add_parser("validate", help="validate an OPL file")
     ns_validate.add_argument("file")
     ns_validate.set_defaults(fn=cmd_ns_validate)
+    ns_gen = nssub.add_parser(
+        "generate-opl", help="legacy namespace config -> OPL template"
+    )
+    ns_gen.add_argument("files", nargs="+")
+    ns_gen.set_defaults(fn=cmd_ns_generate_opl)
 
     migrate = sub.add_parser("migrate", help="schema migrations (durable dsn)")
     migrate.add_argument("-c", "--config", help="config file (yaml/json)")

@@ -72,6 +72,9 @@ def _defaults() -> Dict[str, Any]:
             "arena": 16384,
             "max_batch": 8192,
             "retry_scale": 4,
+            # window (ms) for coalescing concurrent single checks into one
+            # device dispatch; 0 disables (engine/coalesce.py)
+            "coalesce_ms": 2,
             # multi-chip: 0 = single device; n>0 = shard over an n-device mesh
             "mesh_devices": 0,
             "mesh_axis": "shard",

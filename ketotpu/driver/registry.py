@@ -30,6 +30,7 @@ from ketotpu import __version__
 from ketotpu.api.mapper import Mapper
 from ketotpu.api.uuid_map import UUIDMapper
 from ketotpu.driver.config import ConfigError, Provider
+from ketotpu.engine.coalesce import CoalescingEngine
 from ketotpu.engine.oracle import CheckEngine, ExpandEngine
 from ketotpu.engine.tpu import DeviceCheckEngine
 from ketotpu.observability import Metrics, Tracer, make_logger
@@ -153,6 +154,11 @@ class Registry:
             self._tenants[nid] = reg  # reinsert = most recently used
             while len(self._tenants) > self.MAX_TENANTS:
                 _, evicted = self._tenants.popitem(last=False)
+                # stop the coalescer worker before dropping the engine so
+                # eviction frees the thread and the device snapshot too
+                eng_close = getattr(evicted._check_engine, "close", None)
+                if eng_close is not None:
+                    eng_close()
                 close = getattr(evicted._store, "close", None)
                 if close is not None:
                     close()
@@ -214,7 +220,7 @@ class Registry:
             if self._check_engine is None:
                 kind = self.config.get("engine.kind")
                 if kind == "tpu":
-                    self._check_engine = DeviceCheckEngine(
+                    dev = DeviceCheckEngine(
                         self.store(),
                         self.namespace_manager(),
                         max_depth=self.config.max_read_depth(),
@@ -225,9 +231,22 @@ class Registry:
                         max_batch=int(self.config.get("engine.max_batch")),
                         retry_scale=int(self.config.get("engine.retry_scale")),
                     )
+                    ms = float(self.config.get("engine.coalesce_ms") or 0)
+                    # concurrent single checks ride one device dispatch
+                    # (engine/coalesce.py); 0 disables
+                    self._check_engine = (
+                        CoalescingEngine(dev, window=ms / 1000.0)
+                        if ms > 0 else dev
+                    )
                 else:
                     self._check_engine = self.oracle_engine()
             return self._check_engine
+
+    def _device_engine(self) -> Optional[DeviceCheckEngine]:
+        """The underlying device engine, unwrapping the coalescer facade."""
+        eng = self.check_engine()
+        inner = getattr(eng, "inner", eng)
+        return inner if isinstance(inner, DeviceCheckEngine) else None
 
     def oracle_engine(self) -> CheckEngine:
         with self._lock:
@@ -244,11 +263,11 @@ class Registry:
     def expand_engine(self):
         with self._lock:
             if self._expand_engine is None:
-                check = self.check_engine()
-                if isinstance(check, DeviceCheckEngine):
+                dev = self._device_engine()
+                if dev is not None:
                     # device-batched expand with host DFS reassembly
                     # (engine/expand_device.py); oracle fallback inside
-                    self._expand_engine = _DeviceExpandAdapter(check)
+                    self._expand_engine = _DeviceExpandAdapter(dev)
                 else:
                     self._expand_engine = ExpandEngine(
                         self.store(), max_depth=self.config.max_read_depth()
@@ -293,8 +312,8 @@ class Registry:
         refreshing it after the warm build otherwise."""
         self.namespace_manager()
         self.store()
-        eng = self.check_engine()
-        if isinstance(eng, DeviceCheckEngine):
+        eng = self._device_engine()
+        if eng is not None:
             ckpt_path = str(self.config.get("engine.checkpoint") or "")
             if ckpt_path:
                 resumed = eng.load_checkpoint(ckpt_path)
@@ -306,6 +325,32 @@ class Registry:
                 )
             eng.snapshot()
         return self
+
+    def sample_engine_metrics(self) -> None:
+        """Refresh device-engine gauges (scraped via /metrics/prometheus):
+        the SURVEY §5.5 'per-batch device metrics' — fallbacks, retries,
+        rebuilds, overlay applies, checkpoint errors."""
+        with self._lock:
+            outer = self._check_engine
+        eng = getattr(outer, "inner", outer)
+        if not isinstance(eng, DeviceCheckEngine):
+            return
+        m = self.metrics()
+        if isinstance(outer, CoalescingEngine):
+            m.gauge("keto_engine_coalesced_waves", outer.waves,
+                    help="coalesced check dispatch waves")
+            m.gauge("keto_engine_coalesced_checks", outer.coalesced,
+                    help="single checks served via coalesced waves")
+        m.gauge("keto_engine_oracle_fallbacks", eng.fallbacks,
+                help="queries answered by the host oracle")
+        m.gauge("keto_engine_device_retries", eng.retries,
+                help="queries re-run at wider device capacity")
+        m.gauge("keto_engine_snapshot_rebuilds", eng.rebuilds,
+                help="full device snapshot projections")
+        m.gauge("keto_engine_overlay_applies", eng.overlay_applies,
+                help="O(delta) overlay write applications")
+        m.gauge("keto_engine_checkpoint_errors", eng.checkpoint_errors,
+                help="projection checkpoint save failures")
 
     def health(self) -> Dict[str, str]:
         """Readiness probe results; "ok" or the error string per check."""

@@ -1,0 +1,140 @@
+"""Request coalescing: concurrent single checks ride one device dispatch.
+
+The reference amortizes per-check cost with goroutine fan-out inside one
+request (`checkgroup/concurrent_checkgroup.go`); the TPU engine amortizes
+ACROSS requests instead — a single check costs a full device dispatch
+(fixed host-link latency + a compiled program sized for thousands), so
+serving concurrent Check RPCs one dispatch each wastes almost all of the
+machine.  The coalescer queues single checks for up to ``window``
+seconds (or until ``max_pending``) and answers the whole wave with one
+``batch_check`` call on the underlying engine.
+
+Semantics are unchanged: per-query typed errors (the oracle's client
+errors) are re-raised in the calling thread; other queries in the same
+wave are unaffected.  ``batch_check`` calls pass straight through — they
+are already batched — and every other attribute proxies to the wrapped
+engine, so the registry seam (`check.EngineProvider`) sees the same
+surface.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional, Sequence
+
+from ketotpu.api.types import RelationTuple
+
+
+class _Slot:
+    __slots__ = ("tuple", "depth", "event", "result", "error")
+
+    def __init__(self, t: RelationTuple, depth: int):
+        self.tuple = t
+        self.depth = depth
+        self.event = threading.Event()
+        self.result: Optional[bool] = None
+        self.error: Optional[BaseException] = None
+
+
+class CoalescingEngine:
+    """check_is_member batching facade over a (device) check engine."""
+
+    def __init__(self, inner, *, window: float = 0.002,
+                 max_pending: int = 4096):
+        self.inner = inner
+        self.window = window
+        self.max_pending = max_pending
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._pending: List[_Slot] = []
+        self._closed = False
+        self.waves = 0  # observability: coalesced dispatch count
+        self.coalesced = 0  # observability: queries served via waves
+        self._worker = threading.Thread(
+            target=self._run, name="keto-coalescer", daemon=True
+        )
+        self._worker.start()
+
+    # -- engine surface ------------------------------------------------------
+
+    def check(self, r: RelationTuple, rest_depth: int = 0) -> bool:
+        return self.check_is_member(r, rest_depth)
+
+    def check_is_member(self, r: RelationTuple, rest_depth: int = 0) -> bool:
+        with self._wake:
+            if self._closed:
+                # the worker is gone; never strand the caller on a dead
+                # queue — answer directly on the wrapped engine
+                return bool(self.inner.check_is_member(r, rest_depth))
+            slot = _Slot(r, rest_depth)
+            self._pending.append(slot)
+            self._wake.notify()
+        slot.event.wait()
+        if slot.error is not None:
+            raise slot.error
+        return bool(slot.result)
+
+    def batch_check(
+        self, queries: Sequence[RelationTuple], rest_depth: int = 0
+    ) -> List[bool]:
+        return self.inner.batch_check(queries, rest_depth)
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    def close(self) -> None:
+        with self._wake:
+            self._closed = True
+            self._wake.notify()
+
+    # -- worker --------------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            with self._wake:
+                while not self._pending and not self._closed:
+                    self._wake.wait()
+                if self._closed and not self._pending:
+                    return
+                # wave window: let concurrent callers pile on for the FULL
+                # window (every enqueue notifies, so loop on the deadline
+                # rather than trusting a single wait)
+                deadline = time.monotonic() + self.window
+                while (
+                    len(self._pending) < self.max_pending
+                    and not self._closed
+                ):
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._wake.wait(remaining)
+                wave, self._pending = self._pending, []
+            self._serve(wave)
+
+    def _serve(self, wave: List[_Slot]) -> None:
+        self.waves += 1
+        self.coalesced += len(wave)
+        by_depth = {}
+        for s in wave:
+            by_depth.setdefault(s.depth, []).append(s)
+        for depth, slots in by_depth.items():
+            try:
+                verdicts = self.inner.batch_check(
+                    [s.tuple for s in slots], depth
+                )
+                for s, v in zip(slots, verdicts):
+                    s.result = bool(v)
+            except Exception:  # noqa: BLE001 - isolate per-query errors
+                # a typed client error aborted the batch: answer each query
+                # individually so only the erroring ones raise
+                for s in slots:
+                    try:
+                        s.result = bool(
+                            self.inner.batch_check([s.tuple], depth)[0]
+                        )
+                    except Exception as e:  # noqa: BLE001
+                        s.error = e
+            finally:
+                for s in slots:
+                    s.event.set()

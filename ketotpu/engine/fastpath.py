@@ -54,7 +54,10 @@ per-level cost is bounded by arena-sized random gathers from HBM tables
 linear-dedup pack measure at noise level beside them).  Pallas/Mosaic
 alternatives were evaluated and rejected with measurements rather than
 assumed: (a) one fused [A,16] row gather — 2.5x SLOWER than 16 separate
-1-D gathers under XLA's TPU lowering; (b) a VMEM-resident table with
+1-D gathers when benchmarked in isolation, while rewriting this module's
+row gathers as flattened 1-D gathers changed end-to-end batch time by
+0% (XLA already emits the efficient form in context); (b) a
+VMEM-resident table with
 `jnp.take` inside a Pallas kernel — Mosaic lowers only same-shape 2-D
 `take_along_axis`, not 1-D/arbitrary gather; (c) a scalar `fori_loop`
 gather kernel — Mosaic forbids scalar stores to VMEM; (d) one-hot matmul

@@ -47,6 +47,7 @@ class Metrics:
         self._lock = threading.Lock()
         self._counters: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
         self._hists: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], List] = {}
+        self._gauges: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
         self._help: Dict[str, str] = {}
 
     def counter(self, name: str, value: float = 1.0, help: str = "", **labels):
@@ -74,6 +75,15 @@ class Metrics:
             h[1] += value
             h[2] += 1
 
+    def gauge(self, name: str, value: float, help: str = "", **labels):
+        """Set (not accumulate) the latest value — device-engine state like
+        rebuild counts is owned by the engine and sampled at scrape time."""
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            if help:
+                self._help.setdefault(name, help)
+            self._gauges[key] = value
+
     def get_counter(self, name: str, **labels) -> float:
         key = (name, tuple(sorted(labels.items())))
         with self._lock:
@@ -90,7 +100,11 @@ class Metrics:
         """Prometheus text format 0.0.4."""
         lines: List[str] = []
         with self._lock:
-            names = sorted({n for n, _ in self._counters} | {n for n, _ in self._hists})
+            names = sorted(
+                {n for n, _ in self._counters}
+                | {n for n, _ in self._hists}
+                | {n for n, _ in self._gauges}
+            )
             for name in names:
                 if name in self._help:
                     lines.append(f"# HELP {name} {self._help[name]}")
@@ -98,6 +112,14 @@ class Metrics:
                 if ctr_items:
                     lines.append(f"# TYPE {name} counter")
                     for (n, labels), v in sorted(ctr_items):
+                        fv = int(v) if float(v).is_integer() else v
+                        lines.append(f"{name}{self._fmt_labels(labels)} {fv}")
+                gauge_items = [
+                    (k, v) for k, v in self._gauges.items() if k[0] == name
+                ]
+                if gauge_items:
+                    lines.append(f"# TYPE {name} gauge")
+                    for (n, labels), v in sorted(gauge_items):
                         fv = int(v) if float(v).is_integer() else v
                         lines.append(f"{name}{self._fmt_labels(labels)} {fv}")
                 hist_items = [(k, v) for k, v in self._hists.items() if k[0] == name]

@@ -118,6 +118,22 @@ class KetoClient:
             t.namespace, t.object, t.relation, t.subject, max_depth=max_depth
         )
 
+    def batch_check(
+        self, tuples: Sequence[RelationTuple], *, max_depth: int = 0
+    ) -> List[bool]:
+        """Many checks in one request (extension endpoint
+        POST /relation-tuples/check/batch; the TPU engine answers the whole
+        list in fused device dispatches)."""
+        url = f"{self.read_url}/relation-tuples/check/batch"
+        if max_depth:
+            url += f"?max-depth={max_depth}"
+        status, body = self._request(
+            "POST", url, {"tuples": [t.to_json() for t in tuples]}
+        )
+        if status != 200:
+            self._raise_for(status, body)
+        return [bool(r["allowed"]) for r in json.loads(body)["results"]]
+
     # -- expand -------------------------------------------------------------
 
     def expand(

@@ -119,6 +119,41 @@ class CheckHandler:
         except NotFoundError:
             return False  # check/handler.go:169-171
 
+    def batch_check_core(self, tuples, max_depth: int, r=None):
+        """Batched checks through the engine's batch surface (the TPU
+        engine answers the whole list in fused device dispatches).  An
+        EXTENSION over the reference contract — Keto has no BatchCheck RPC
+        at this version (SURVEY §2 proto row); REST route:
+        POST /relation-tuples/check/batch.  Per-item semantics match the
+        single openapi check: unknown namespace => allowed=false."""
+        r = r if r is not None else self.r
+        with r.tracer().span("check.Engine.BatchCheck"):
+            ok_idx, out = [], [False] * len(tuples)
+            for i, t in enumerate(tuples):
+                try:
+                    r.read_only_mapper().from_tuple(t)
+                except NotFoundError:
+                    continue  # unknown namespace: deny (handler.go:169-171)
+                ok_idx.append(i)
+            engine = r.check_engine()
+            if ok_idx:
+                batch = [tuples[i] for i in ok_idx]
+                bc = getattr(engine, "batch_check", None)
+                verdicts = (
+                    bc(batch, max_depth) if bc is not None
+                    else [engine.check_is_member(t, max_depth) for t in batch]
+                )
+                for i, v in zip(ok_idx, verdicts):
+                    out[i] = bool(v)
+        for v in out:
+            r.metrics().counter(
+                "keto_checks_total", 1,
+                help="authorization checks served",
+                allowed=str(v).lower(),
+            )
+        r.tracer().event(PERMISSIONS_CHECKED)
+        return out
+
     def snaptoken(self, r=None) -> str:
         """A real snaptoken: the store version the verdict was computed at
         (the Zanzibar zookie the reference stubs, check_service.proto:51-60)."""

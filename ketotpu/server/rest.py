@@ -121,6 +121,9 @@ class Router:
         return 200, {"version": self.r.version}
 
     def _metrics(self, req) -> Tuple[int, object]:
+        sample = getattr(self.r, "sample_engine_metrics", None)
+        if sample is not None:
+            sample()  # refresh device-engine gauges at scrape time
         return 200, ("text/plain; version=0.0.4", self.r.metrics().exposition())
 
     # -- dispatch ------------------------------------------------------------
@@ -227,6 +230,26 @@ def read_router(registry) -> Router:
     rt.add("POST", "/relation-tuples/check", post_check(mirror=True))
     rt.add("GET", "/relation-tuples/check/openapi", get_check(mirror=False))
     rt.add("POST", "/relation-tuples/check/openapi", post_check(mirror=False))
+
+    def post_check_batch(req):
+        # EXTENSION endpoint (no reference counterpart): one request, many
+        # verdicts, answered by the engine's batched device dispatch
+        body = req.json()
+        if not isinstance(body, dict) or not isinstance(
+            body.get("tuples"), list
+        ):
+            raise BadRequestError('expected {"tuples": [...]}')
+        tuples_in = [RelationTuple.from_json(d or {}) for d in body["tuples"]]
+        r = registry.resolve(req.headers)
+        results = check.batch_check_core(
+            tuples_in, _max_depth(req.query), r
+        )
+        return 200, {
+            "results": [{"allowed": a} for a in results],
+            "snaptoken": check.snaptoken(r),
+        }
+
+    rt.add("POST", "/relation-tuples/check/batch", post_check_batch)
 
     def get_expand(req):
         subject = SubjectSet(

@@ -217,3 +217,16 @@ def test_cli_migrate_roundtrip(tmp_path, capsys):
     assert cli.main(["migrate", "-c", str(cfgfile), "down", "--steps", "1"]) == 0
     assert cli.main(["migrate", "-c", str(cfgfile), "status"]) == 0
     assert "pending" in capsys.readouterr().out
+
+
+def test_namespace_generate_opl(capsys):
+    from ketotpu.opl.parser import parse
+
+    rc = cli.main([
+        "namespace", "generate-opl", str(FIXTURES / "cat-videos" / "keto.yml")
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0
+    namespaces, errors = parse(out)  # generated template must be valid OPL
+    assert not errors
+    assert [n.name for n in namespaces] == ["videos"]

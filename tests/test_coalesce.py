@@ -1,0 +1,112 @@
+"""Coalescer tests: concurrent single checks ride shared device dispatches
+with unchanged per-query semantics (engine/coalesce.py)."""
+
+import threading
+
+import pytest
+
+from ketotpu.api.types import BadRequestError, RelationTuple
+from ketotpu.engine.coalesce import CoalescingEngine
+from ketotpu.engine.tpu import DeviceCheckEngine
+from ketotpu.utils.synth import build_synth, synth_queries
+
+T = RelationTuple.from_string
+
+
+@pytest.fixture(scope="module")
+def setup():
+    graph = build_synth(n_users=64, n_groups=8, n_folders=32, n_docs=128)
+    dev = DeviceCheckEngine(
+        graph.store, graph.manager, frontier=2048, arena=4096, max_batch=512
+    )
+    dev.snapshot()
+    return graph, dev
+
+
+def test_concurrent_checks_coalesce_and_agree(setup):
+    graph, dev = setup
+    eng = CoalescingEngine(dev, window=0.02)
+    queries = synth_queries(graph, 64, seed=9)
+    want = [dev.oracle.check_is_member(q) for q in queries]
+    got = [None] * len(queries)
+
+    def worker(i):
+        got[i] = eng.check_is_member(queries[i])
+
+    threads = [
+        threading.Thread(target=worker, args=(i,))
+        for i in range(len(queries))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert got == want
+    assert eng.coalesced == len(queries)
+    # 64 concurrent singles must NOT cost 64 dispatches
+    assert eng.waves < len(queries) / 4
+    eng.close()
+
+
+def test_error_isolation(setup):
+    graph, dev = setup
+    eng = CoalescingEngine(dev, window=0.02)
+    good = synth_queries(graph, 4, seed=11)
+    # undeclared relation on a configured namespace: typed client error
+    bad = T("Doc:d0#nope@u1")
+    results = {}
+    errors = {}
+
+    def check(i, q):
+        try:
+            results[i] = eng.check_is_member(q)
+        except Exception as e:  # noqa: BLE001
+            errors[i] = e
+
+    threads = [
+        threading.Thread(target=check, args=(i, q))
+        for i, q in enumerate([*good, bad])
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(results) == len(good)  # the good queries all answered
+    assert isinstance(errors[len(good)], BadRequestError)
+    eng.close()
+
+
+def test_depth_groups_answer_independently(setup):
+    graph, dev = setup
+    eng = CoalescingEngine(dev, window=0.02)
+    q = synth_queries(graph, 1, seed=13)[0]
+    out = {}
+
+    def check(d):
+        out[d] = eng.check_is_member(q, d)
+
+    threads = [threading.Thread(target=check, args=(d,)) for d in (0, 2, 4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for d in (0, 2, 4):
+        assert out[d] == dev.oracle.check_is_member(q, d), d
+    eng.close()
+
+
+def test_passthrough_surface(setup):
+    graph, dev = setup
+    eng = CoalescingEngine(dev, window=0.001)
+    qs = synth_queries(graph, 8, seed=15)
+    assert eng.batch_check(qs) == dev.batch_check(qs)
+    assert eng.max_depth == dev.max_depth  # attribute proxying
+    eng.close()
+
+
+def test_check_after_close_answers_directly(setup):
+    graph, dev = setup
+    eng = CoalescingEngine(dev, window=0.001)
+    q = synth_queries(graph, 1, seed=17)[0]
+    eng.close()
+    assert eng.check_is_member(q) == dev.oracle.check_is_member(q)

@@ -77,7 +77,14 @@ def test_registry_engine_seam():
     r = Registry(Provider({"engine": {"kind": "oracle"}}))
     assert isinstance(r.check_engine(), CheckEngine)
     r2 = Registry(Provider())
-    assert isinstance(r2.check_engine(), DeviceCheckEngine)
+    # default engine: the device engine behind the coalescing facade
+    from ketotpu.engine.coalesce import CoalescingEngine
+
+    assert isinstance(r2.check_engine(), CoalescingEngine)
+    assert isinstance(r2._device_engine(), DeviceCheckEngine)
+    # coalescing can be disabled, exposing the bare device engine
+    r3 = Registry(Provider({"engine": {"coalesce_ms": 0}}))
+    assert isinstance(r3.check_engine(), DeviceCheckEngine)
     # lazy singletons
     assert r2.check_engine() is r2.check_engine()
     assert r2.store() is r2.store()

@@ -160,3 +160,15 @@ class d implements Namespace {
     for i, w in enumerate(want):
         if not over[i]:
             assert got[i] == w
+
+
+def test_sharded_snapshot_memory_scales_down():
+    """BASELINE config #5 / VERDICT r1 #4: sharding must actually divide the
+    graph — per-shard CSR row counts sum to the total, and every shard holds
+    roughly total/n rows, not a replica."""
+    graph = build_synth(n_users=256, n_groups=16, n_folders=128, n_docs=512)
+    shards, meta = build_sharded_snapshot(graph.store, graph.manager, 8)
+    per_shard = [int(s.n_tuples) for s in shards]
+    assert sum(per_shard) == len(graph.store)
+    assert max(per_shard) < len(graph.store) / 2  # no shard hoards the graph
+    assert min(per_shard) > 0

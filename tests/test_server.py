@@ -450,3 +450,79 @@ class TestSDKTransport:
 
         with pytest.raises(BadRequestError):
             sdk.list_relation_tuples(page_token="not-a-token")
+
+
+def test_engine_gauges_on_metrics(server, read_addr):
+    status, body = _http("GET", f"{read_addr}/metrics/prometheus")
+    assert status == 200
+    _, text = body if isinstance(body, tuple) else (None, body)
+    assert "keto_engine_snapshot_rebuilds" in text
+    assert "keto_engine_oracle_fallbacks" in text
+
+
+class TestBatchCheck:
+    def test_rest_batch_matches_singles(self, read_addr):
+        body = json.dumps(
+            {"tuples": [_parse_case(c).to_json() for c, _ in CASES]}
+        ).encode()
+        status, out = _http(
+            "POST", f"{read_addr}/relation-tuples/check/batch", body,
+            {"Content-Type": "application/json"},
+        )
+        assert status == 200
+        data = json.loads(out)
+        assert [r["allowed"] for r in data["results"]] == [w for _, w in CASES]
+        assert data["snaptoken"].startswith("v")
+
+    def test_sdk_batch_check(self, read_addr, write_addr):
+        from ketotpu.sdk import KetoClient
+
+        sdk = KetoClient(read_addr, write_addr)
+        got = sdk.batch_check([_parse_case(c) for c, _ in CASES])
+        assert got == [w for _, w in CASES]
+
+    def test_batch_rejects_malformed(self, read_addr):
+        status, _ = _http(
+            "POST", f"{read_addr}/relation-tuples/check/batch",
+            json.dumps({"nope": 1}).encode(),
+            {"Content-Type": "application/json"},
+        )
+        assert status == 400
+
+
+def test_batch_check_works_with_oracle_engine():
+    """The batch endpoint must serve engine.kind=oracle too (the oracle
+    has no batch surface; the handler loops check_is_member)."""
+    cfg = Provider(
+        {
+            "serve": {
+                n: {"host": "127.0.0.1", "port": 0}
+                for n in ("read", "write", "metrics", "opl")
+            },
+            "namespaces": {
+                "location": str(FIXTURES / "rewrites_namespaces.keto.ts")
+            },
+            "engine": {"kind": "oracle"},
+        }
+    )
+    reg = Registry(cfg).init()
+    reg.store().write_relation_tuples(
+        RelationTuple.from_string("Group:g#members@alice")
+    )
+    srv = serve_all(reg)
+    try:
+        addr = "http://%s:%d" % tuple(srv.addresses["read"])
+        body = json.dumps({"tuples": [
+            RelationTuple.from_string("Group:g#members@alice").to_json(),
+            RelationTuple.from_string("Group:g#members@bob").to_json(),
+        ]}).encode()
+        status, out = _http(
+            "POST", f"{addr}/relation-tuples/check/batch", body,
+            {"Content-Type": "application/json"},
+        )
+        assert status == 200
+        assert [r["allowed"] for r in json.loads(out)["results"]] == [
+            True, False,
+        ]
+    finally:
+        srv.stop()
