@@ -526,3 +526,23 @@ def test_batch_check_works_with_oracle_engine():
         ]
     finally:
         srv.stop()
+
+
+def test_openapi_spec_matches_routes():
+    """spec/api.json is the wire-contract artifact (layer 9): every
+    method+path it documents must exist in a router table."""
+    import pathlib as _pl
+
+    from ketotpu.server import rest as _rest
+
+    spec = json.loads(
+        (_pl.Path(__file__).parent.parent / "spec" / "api.json").read_text()
+    )
+    reg = Registry(Provider({"engine": {"kind": "oracle"}}))
+    routes = set()
+    for build in (_rest.read_router, _rest.write_router, _rest.opl_router,
+                  _rest.metrics_router):
+        routes |= set(build(reg).routes)
+    for path, ops in spec["paths"].items():
+        for method in ops:
+            assert (method.upper(), path) in routes, (method, path)
