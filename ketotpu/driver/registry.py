@@ -220,9 +220,7 @@ class Registry:
             if self._check_engine is None:
                 kind = self.config.get("engine.kind")
                 if kind == "tpu":
-                    dev = DeviceCheckEngine(
-                        self.store(),
-                        self.namespace_manager(),
+                    common = dict(
                         max_depth=self.config.max_read_depth(),
                         max_width=self.config.max_read_width(),
                         strict_mode=self.config.strict_mode(),
@@ -231,6 +229,24 @@ class Registry:
                         max_batch=int(self.config.get("engine.max_batch")),
                         retry_scale=int(self.config.get("engine.retry_scale")),
                     )
+                    n_mesh = int(self.config.get("engine.mesh_devices") or 0)
+                    if n_mesh > 0:
+                        # graph-sharded serving over an n-device mesh
+                        # (parallel/meshengine.py, BASELINE config #5)
+                        from ketotpu.parallel import MeshCheckEngine
+
+                        dev = MeshCheckEngine(
+                            self.store(), self.namespace_manager(),
+                            mesh_devices=n_mesh,
+                            mesh_axis=str(
+                                self.config.get("engine.mesh_axis") or "shard"
+                            ),
+                            **common,
+                        )
+                    else:
+                        dev = DeviceCheckEngine(
+                            self.store(), self.namespace_manager(), **common
+                        )
                     ms = float(self.config.get("engine.coalesce_ms") or 0)
                     # concurrent single checks ride one device dispatch
                     # (engine/coalesce.py); 0 disables

@@ -402,6 +402,9 @@ def overlay_arrays(
     must not trigger a recompile (~minutes on a tunneled chip), and each
     write re-ships only these small arrays.
     """
+    # a 0 threshold (mesh engine: every write rebuilds) still needs a
+    # well-formed empty table
+    pair_cap = max(1, pair_cap)
     mem: List[Tuple[int, int, int]] = []
     for (node, subj), net in state.pair_net.items():
         base = _base_pair_count(snap, node, subj) if node < snap.n_nodes else 0

@@ -184,20 +184,7 @@ class DeviceCheckEngine:
         self._snap_fingerprint = fingerprint
         self._overlay = dl.OverlayState()
         self._overlay_active = False
-        # base arrays transfer once per rebuild; overlay updates later merge
-        # over this dict so a write re-ships only the (small) overlay.
-        # EMPTY overlay arrays ship from the start so the jitted program's
-        # pytree structure is identical before and after the first write —
-        # overlay activation must never trigger a recompile.
-        self._base_device = jax.device_put(self._snap.arrays())
-        self._device_arrays = dict(
-            self._base_device,
-            **jax.device_put(
-                dl.overlay_arrays(
-                    self._overlay, self._snap, pair_cap=self.max_overlay_pairs
-                )
-            ),
-        )
+        self._install_device_arrays()
         self.rebuilds += 1
         if self.checkpoint_path:
             from ketotpu.engine import checkpoint as ckpt
@@ -209,6 +196,29 @@ class DeviceCheckEngine:
                 )
             except OSError:
                 self.checkpoint_errors += 1
+
+    def _install_device_arrays(self) -> None:
+        """Ship the projection to the device.  Base arrays transfer once
+        per rebuild; overlay updates later merge over this dict so a write
+        re-ships only the (small) overlay.  EMPTY overlay arrays ship from
+        the start so the jitted program's pytree structure is identical
+        before and after the first write — overlay activation must never
+        trigger a recompile.  (The mesh engine overrides this: it ships
+        sharded stacks instead and builds the replicated copy lazily.)"""
+        self._base_device = jax.device_put(self._snap.arrays())
+        self._device_arrays = dict(
+            self._base_device,
+            **jax.device_put(
+                dl.overlay_arrays(
+                    self._overlay, self._snap, pair_cap=self.max_overlay_pairs
+                )
+            ),
+        )
+
+    def _expand_arrays(self):
+        """Device arrays for batch_expand (the mesh engine builds its
+        replicated copy lazily here)."""
+        return self._device_arrays
 
     def snapshot(self) -> Snapshot:
         fingerprint = config_fingerprint(self.namespace_manager)
@@ -240,6 +250,9 @@ class DeviceCheckEngine:
                     self._overlay, self._snap, pair_cap=self.max_overlay_pairs
                 )
             except ValueError:  # fixed-shape table could not fit the content
+                self._rebuild(fingerprint)
+                return self._snap
+            if self._base_device is None:  # mesh engine: no overlay serving
                 self._rebuild(fingerprint)
                 return self._snap
             self._device_arrays = dict(
@@ -303,15 +316,7 @@ class DeviceCheckEngine:
         self._log_cursor = log_head
         self._overlay = dl.OverlayState()
         self._overlay_active = False
-        self._base_device = jax.device_put(snap.arrays())
-        self._device_arrays = dict(
-            self._base_device,
-            **jax.device_put(
-                dl.overlay_arrays(
-                    self._overlay, snap, pair_cap=self.max_overlay_pairs
-                )
-            ),
-        )
+        self._install_device_arrays()
         return True
 
     # -- query encoding -----------------------------------------------------
@@ -542,7 +547,7 @@ class DeviceCheckEngine:
             return out
         roots = [subjects[i] for i in set_idx]
         trees, over = xd.run_expand(
-            self._device_arrays, snap, roots, rest_depth,
+            self._expand_arrays(), snap, roots, rest_depth,
             max_depth=self.max_depth, fanout=fanout, cap=cap,
         )
         for k, i in enumerate(set_idx):

@@ -17,8 +17,10 @@ Here scale-out is a first-class device-mesh design:
 
 from ketotpu.parallel.graphshard import build_sharded_snapshot, sharded_check
 from ketotpu.parallel.mesh import make_mesh, shard_batch_check, shard_fast_check
+from ketotpu.parallel.meshengine import MeshCheckEngine
 
 __all__ = [
+    "MeshCheckEngine",
     "build_sharded_snapshot",
     "make_mesh",
     "shard_batch_check",

@@ -1,0 +1,132 @@
+"""MeshCheckEngine: the serving engine over a graph-sharded device mesh.
+
+BASELINE config #5 behind the registry's engine seam: with
+``engine.mesh_devices: n`` the registry builds this engine instead of the
+single-device one.  The CSR is partitioned by (namespace, object) hash
+across an n-device `jax.sharding.Mesh` (parallel/graphshard.py); each BFS
+level expands locally, routes cross-shard subject-set / tuple-to-userset
+children to their owner shard with `lax.all_to_all`, and merges verdict
+bits with `psum` — per-device graph memory drops with mesh size instead
+of replicating.
+
+Inherits the single-device engine's whole host surface (encode, classify,
+oracle fallback, expand, checkpointing of the base projection) and swaps
+only the fast-path dispatch.  Differences forced by sharding:
+
+* the delta overlay is disabled (``max_overlay_pairs = 0``): overlay
+  tables are built for the replicated layout, so every write amortizes
+  through a full rebuild instead — writes are the rare path at the scale
+  a mesh serves (SURVEY §7 step 8's snapshot-oriented design);
+* AND/NOT-reachable ("general") queries go straight to the host oracle —
+  the task-tree interpreter is single-device;
+* the overflow tail falls back to the oracle without a device retry tier
+  (capacity on a mesh is per-shard; a retry would need a second stacked
+  projection at wider caps for a few queries).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ketotpu.engine.tpu import DeviceCheckEngine, _bucket
+from ketotpu.parallel import graphshard
+from ketotpu.parallel.mesh import make_mesh
+
+
+class MeshCheckEngine(DeviceCheckEngine):
+    """Graph-sharded batched checks; oracle fallback on the host."""
+
+    def __init__(
+        self,
+        store,
+        namespace_manager=None,
+        *,
+        mesh_devices: int,
+        mesh_axis: str = "shard",
+        **kwargs,
+    ):
+        super().__init__(store, namespace_manager, **kwargs)
+        self.mesh = make_mesh(mesh_devices, axis=mesh_axis)
+        if self.mesh.devices.size != mesh_devices:
+            # make_mesh silently truncates to what exists; serving with
+            # fewer devices than shards would DROP the missing shards'
+            # tuples as silent denials
+            raise ValueError(
+                f"engine.mesh_devices={mesh_devices} but only "
+                f"{self.mesh.devices.size} JAX devices are available"
+            )
+        self.mesh_axis = mesh_axis
+        self.n_shards = mesh_devices
+        self._stacked = None
+        # overlay tables target the replicated layout; sharded serving
+        # amortizes writes through full rebuilds instead
+        self.max_overlay_pairs = 0
+        self.max_overlay_dirty = 0
+
+    def _install_device_arrays(self) -> None:
+        """Ship the SHARDED stacks; the replicated copy (only batch_expand
+        reads it) is built lazily so device 0 doesn't hold the whole graph
+        next to its shard."""
+        self._base_device = None
+        self._device_arrays = None
+        _, self._stacked = graphshard.build_sharded_snapshot(
+            self.store, self.namespace_manager, self.n_shards, self._vocab
+        )
+
+    def _expand_arrays(self):
+        if self._device_arrays is None:
+            import jax
+
+            from ketotpu.engine import delta as dl
+
+            self._base_device = jax.device_put(self._snap.arrays())
+            self._device_arrays = dict(
+                self._base_device,
+                **jax.device_put(
+                    dl.overlay_arrays(
+                        self._overlay, self._snap,
+                        pair_cap=self.max_overlay_pairs,
+                    )
+                ),
+            )
+        return self._device_arrays
+
+    def _dispatch(self, queries, rest_depth: int):
+        n = len(queries)
+        if n == 0:
+            return None
+        snap = self.snapshot()
+        enc = self._encode(queries, rest_depth)
+        err, general = self._classify(snap, enc[0], enc[2])
+        qpad = min(_bucket(n), self.frontier)
+        padded = self._pad(enc, n, qpad)
+        active = np.pad(~(err | general), (0, qpad - n))
+        res = graphshard.sharded_check(
+            self._stacked,
+            padded,
+            self.mesh,
+            axis=self.mesh_axis,
+            frontier=self.frontier,
+            arena=self.arena,
+            max_depth=self.max_depth,
+            max_width=self.max_width,
+            active=active,
+        )
+        # general queries are oracle work on this engine (see module doc)
+        return (enc, err | general, res)
+
+    def _collect(self, handle, retry: bool = True):
+        enc, fallback_mask, res = handle
+        n = fallback_mask.shape[0]
+        allowed = np.zeros(n, bool)
+        fallback = fallback_mask.copy()
+        found = np.asarray(res.found)[:n]
+        over = np.asarray(res.over)[:n]
+        fmask = ~fallback_mask
+        allowed[fmask] = found[fmask]
+        # found is monotone: overflow voids only not-yet-found queries
+        fallback |= fmask & over & ~found
+        return allowed, fallback
+
