@@ -9,7 +9,7 @@ t0=time.perf_counter(); eng.snapshot(); print("snapshot:", time.perf_counter()-t
 queries = synth_queries(graph, 4096*2, seed=2)
 b = queries[:4096]
 
-t0=time.perf_counter(); enc = eng._encode(b, 0); print("encode:", time.perf_counter()-t0)
+t0=time.perf_counter(); enc = eng._encode(eng.snapshot(), b, 0); print("encode:", time.perf_counter()-t0)
 snap = eng.snapshot()
 err, general = eng._classify(snap, enc[0], enc[2])
 print("err:", err.sum(), "general:", general.sum(), "of", len(b))

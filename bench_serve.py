@@ -1,0 +1,153 @@
+"""Serving-latency benchmark: concurrent single Check RPCs through the daemon.
+
+The BASELINE metric is "Check RPCs/sec **and p50/p99 latency**" (the
+reference measures per-check latency in `internal/check/bench_test.go:
+171-183`); bench.py's batch path measures only bulk throughput.  This
+drives the real wire path — gRPC `CheckService.Check` against the booted
+4-port daemon with the coalescer on — from N closed-loop client threads,
+and reports RPS + p50/p99 per-request milliseconds.
+
+Importable (bench.py embeds the numbers in its JSON line) or standalone:
+
+    python bench_serve.py [concurrency] [seconds]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+from typing import Dict, List
+
+import numpy as np
+
+
+def run_serving_bench(
+    graph=None,
+    *,
+    concurrency: int = 64,
+    duration: float = 10.0,
+    coalesce_ms: float = 2.0,
+    frontier: int = 16384,
+    arena: int = 65536,
+) -> Dict[str, float]:
+    """Boot the daemon on the given synth graph and hammer it with single
+    Checks; returns {"serve_rps", "serve_p50_ms", "serve_p99_ms",
+    "serve_concurrency", ...}."""
+    import grpc
+
+    from ketotpu.api.proto_codec import subject_to_proto
+    from ketotpu.driver import Provider, Registry
+    from ketotpu.proto import check_service_pb2 as cs
+    from ketotpu.proto import relation_tuples_pb2 as rts
+    from ketotpu.proto.services import CheckServiceStub
+    from ketotpu.server import serve_all
+    from ketotpu.utils.synth import build_synth, synth_queries
+
+    if graph is None:
+        graph = build_synth(
+            n_users=2000, n_groups=100, n_folders=2000, n_docs=20000, seed=0
+        )
+    cfg = Provider(
+        {
+            "serve": {
+                n: {"host": "127.0.0.1", "port": 0}
+                for n in ("read", "write", "metrics", "opl")
+            },
+            "engine": {
+                "kind": "tpu",
+                "frontier": frontier,
+                "arena": arena,
+                "max_batch": frontier,
+                "coalesce_ms": coalesce_ms,
+            },
+        }
+    )
+    reg = Registry(
+        cfg, store=graph.store, namespace_manager=graph.manager
+    ).init()
+    srv = serve_all(reg)
+    try:
+        host, port = srv.addresses["read"]
+        target = f"{host}:{port}"
+
+        # pre-built requests: client-side encode cost out of the loop
+        queries = synth_queries(graph, 4096, seed=5)
+        requests = [
+            cs.CheckRequest(
+                tuple=rts.RelationTuple(
+                    namespace=q.namespace,
+                    object=q.object,
+                    relation=q.relation,
+                    subject=subject_to_proto(q.subject),
+                )
+            )
+            for q in queries
+        ]
+
+        # warmup: compile every level shape the coalescer will hit
+        with grpc.insecure_channel(target) as ch:
+            stub = CheckServiceStub(ch)
+            for r in requests[:4]:
+                stub.Check(r)
+
+        lat: List[List[float]] = [[] for _ in range(concurrency)]
+        stop = threading.Event()
+        errors = [0]
+
+        def client(idx: int) -> None:
+            rng = np.random.default_rng(idx)
+            with grpc.insecure_channel(target) as ch:
+                stub = CheckServiceStub(ch)
+                my = lat[idx]
+                n_req = len(requests)
+                while not stop.is_set():
+                    r = requests[int(rng.integers(n_req))]
+                    t0 = time.perf_counter()
+                    try:
+                        stub.Check(r)
+                    except grpc.RpcError:
+                        errors[0] += 1
+                        continue
+                    my.append(time.perf_counter() - t0)
+
+        threads = [
+            threading.Thread(target=client, args=(i,), daemon=True)
+            for i in range(concurrency)
+        ]
+        t_start = time.perf_counter()
+        for t in threads:
+            t.start()
+        time.sleep(duration)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10.0)
+        elapsed = time.perf_counter() - t_start
+
+        all_lat = np.array([x for sub in lat for x in sub])
+        done = len(all_lat)
+        out = {
+            "serve_rps": round(done / elapsed, 1),
+            "serve_p50_ms": round(
+                float(np.percentile(all_lat, 50)) * 1000, 2
+            ) if done else -1.0,
+            "serve_p99_ms": round(
+                float(np.percentile(all_lat, 99)) * 1000, 2
+            ) if done else -1.0,
+            "serve_concurrency": concurrency,
+            "serve_seconds": round(elapsed, 1),
+            "serve_errors": errors[0],
+            "serve_coalesced_waves": getattr(
+                reg.check_engine(), "waves", 0
+            ),
+        }
+        return out
+    finally:
+        srv.stop(grace=2.0)
+
+
+if __name__ == "__main__":
+    conc = int(sys.argv[1]) if len(sys.argv) > 1 else 64
+    secs = float(sys.argv[2]) if len(sys.argv) > 2 else 10.0
+    print(json.dumps(run_serving_bench(concurrency=conc, duration=secs)))

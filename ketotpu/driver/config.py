@@ -144,7 +144,7 @@ class Provider:
             # rejoin known multi-word leaf keys (env has one separator only)
             for known in ("max_read_depth", "max_read_width", "mesh_devices",
                           "mesh_axis", "max_batch", "retry_scale",
-                          "experimental_strict_mode"):
+                          "coalesce_ms", "experimental_strict_mode"):
                 suffix = known.split("_")
                 if len(joined) > len(suffix) and joined[-len(suffix):] == suffix:
                     joined = joined[: -len(suffix)] + [known]

@@ -154,14 +154,21 @@ class Registry:
             self._tenants[nid] = reg  # reinsert = most recently used
             while len(self._tenants) > self.MAX_TENANTS:
                 _, evicted = self._tenants.popitem(last=False)
-                # stop the coalescer worker before dropping the engine so
-                # eviction frees the thread and the device snapshot too
+                # stop the coalescer worker eagerly (frees the thread and
+                # the device snapshot), but DEFER the store close until the
+                # evicted registry is unreachable: a request on another
+                # thread may still hold it mid-flight, and closing its
+                # sqlite connection under it would 500 that request.  The
+                # finalizer holds the store (not the registry), so the close
+                # runs exactly when the last in-flight reference drops.
                 eng_close = getattr(evicted._check_engine, "close", None)
                 if eng_close is not None:
                     eng_close()
                 close = getattr(evicted._store, "close", None)
                 if close is not None:
-                    close()
+                    import weakref
+
+                    weakref.finalize(evicted, close)
             return reg
 
     # -- storage + namespaces ----------------------------------------------
@@ -200,9 +207,14 @@ class Registry:
             if self._namespace_manager is None:
                 ns_cfg = self.config.namespaces_config()
                 if isinstance(ns_cfg, dict):
-                    self._namespace_manager = _uri_manager(
-                        _strip_file_uri(ns_cfg.get("location", ""))
-                    )
+                    loc = _strip_file_uri(ns_cfg.get("location", "") or "")
+                    if not loc:
+                        # {experimental_strict_mode: ...} with no location is
+                        # valid config (config.py); an empty manager beats a
+                        # raw FileNotFoundError("") at boot
+                        self._namespace_manager = StaticNamespaceManager([])
+                    else:
+                        self._namespace_manager = _uri_manager(loc)
                 elif isinstance(ns_cfg, str):
                     self._namespace_manager = _uri_manager(
                         _strip_file_uri(ns_cfg)

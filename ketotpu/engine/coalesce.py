@@ -23,7 +23,7 @@ import threading
 import time
 from typing import List, Optional, Sequence
 
-from ketotpu.api.types import RelationTuple
+from ketotpu.api.types import KetoAPIError, RelationTuple
 
 
 class _Slot:
@@ -125,7 +125,7 @@ class CoalescingEngine:
                 )
                 for s, v in zip(slots, verdicts):
                     s.result = bool(v)
-            except Exception:  # noqa: BLE001 - isolate per-query errors
+            except KetoAPIError:
                 # a typed client error aborted the batch: answer each query
                 # individually so only the erroring ones raise
                 for s in slots:
@@ -135,6 +135,14 @@ class CoalescingEngine:
                         )
                     except Exception as e:  # noqa: BLE001
                         s.error = e
+            except Exception as e:  # noqa: BLE001
+                # transient device/runtime failure: degrading the whole wave
+                # to per-query dispatches would serialize up to max_pending
+                # full dispatches on this one thread while new checks queue
+                # behind them — raise to every caller instead and let them
+                # retry against a (hopefully) recovered engine
+                for s in slots:
+                    s.error = e
             finally:
                 for s in slots:
                     s.event.set()

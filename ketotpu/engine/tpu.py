@@ -39,6 +39,7 @@ this version, SURVEY §2 proto row).
 from __future__ import annotations
 
 import hashlib
+import threading
 from typing import List, Optional, Sequence
 
 import jax
@@ -124,6 +125,14 @@ class DeviceCheckEngine:
             max_width=max_width,
             strict_mode=strict_mode,
         )
+        # guards every snapshot-state mutation (change-log drain, column
+        # mirror, overlay, device-array swap): the daemon calls
+        # batch_check/batch_expand from many threads, and two threads
+        # draining changes_since with the same cursor would double-apply
+        # deltas (a delete then leaves a net-positive overlay entry —
+        # revoked permissions keep answering allowed).  Device dispatch
+        # and collection stay outside the lock.
+        self._sync_lock = threading.RLock()
         self._vocab = Vocab()
         self._snap: Optional[Snapshot] = None
         self._snap_fingerprint: Optional[int] = None
@@ -221,6 +230,10 @@ class DeviceCheckEngine:
         return self._device_arrays
 
     def snapshot(self) -> Snapshot:
+        with self._sync_lock:
+            return self._snapshot_locked()
+
+    def _snapshot_locked(self) -> Snapshot:
         fingerprint = config_fingerprint(self.namespace_manager)
         if self._snap is None or self._snap_fingerprint != fingerprint:
             self._rebuild(fingerprint)
@@ -262,10 +275,21 @@ class DeviceCheckEngine:
             self.overlay_applies += 1
         return self._snap
 
+    def _sync_view(self):
+        """Atomic (snapshot, device_arrays, overlay_active) triple.  Writers
+        mutate all three together under ``_sync_lock``, so a dispatching
+        thread must capture them together — reading ``_device_arrays`` after
+        releasing the lock could pair a new snapshot's encodings with an
+        older projection (or vice versa)."""
+        with self._sync_lock:
+            snap = self._snapshot_locked()
+            return snap, self._device_arrays, self._overlay_active
+
     def refresh(self) -> None:
         """Force a full rebuild (the CheckRequest.latest consistency knob —
         stronger than needed, since overlay probes are already exact)."""
-        self._rebuild(config_fingerprint(self.namespace_manager))
+        with self._sync_lock:
+            self._rebuild(config_fingerprint(self.namespace_manager))
 
     # -- checkpoint / resume (SURVEY §5.4) ----------------------------------
 
@@ -277,16 +301,17 @@ class DeviceCheckEngine:
         whose version never matches the store."""
         from ketotpu.engine import checkpoint as ckpt
 
-        snap = self.snapshot()
-        if self._overlay_active:
-            self.refresh()
-            snap = self._snap
-        # stamp the fingerprint the snapshot was BUILT under, not a fresh
-        # read: a file-backed config reloading between build and save must
-        # not mis-stamp a stale projection as current
-        ckpt.save_snapshot(
-            snap, path, extra={"fingerprint": self._snap_fingerprint}
-        )
+        with self._sync_lock:
+            snap = self._snapshot_locked()
+            if self._overlay_active:
+                self.refresh()
+                snap = self._snap
+            # stamp the fingerprint the snapshot was BUILT under, not a
+            # fresh read: a file-backed config reloading between build and
+            # save must not mis-stamp a stale projection as current
+            ckpt.save_snapshot(
+                snap, path, extra={"fingerprint": self._snap_fingerprint}
+            )
 
     def load_checkpoint(self, path: str) -> bool:
         """Install a checkpoint if it matches the live store version and
@@ -303,26 +328,27 @@ class DeviceCheckEngine:
             )
         except Exception:  # noqa: BLE001 - refusal is the contract
             return False
-        # read the log head BEFORE comparing versions: a write landing
-        # between the two reads then fails the version check (reading in
-        # the other order would skip that write's log entry forever)
-        log_head = self.store.log_head
-        if snap.version != self.store.version:
-            return False  # store moved since the save: stale projection
-        self._snap = snap
-        self._snap_fingerprint = fingerprint
-        self._vocab = snap.vocab
-        self._cols = None  # lazily re-mirrored on the next full rebuild
-        self._log_cursor = log_head
-        self._overlay = dl.OverlayState()
-        self._overlay_active = False
-        self._install_device_arrays()
-        return True
+        with self._sync_lock:
+            # read the log head BEFORE comparing versions: a write landing
+            # between the two reads then fails the version check (reading in
+            # the other order would skip that write's log entry forever)
+            log_head = self.store.log_head
+            if snap.version != self.store.version:
+                return False  # store moved since the save: stale projection
+            self._snap = snap
+            self._snap_fingerprint = fingerprint
+            self._vocab = snap.vocab
+            self._cols = None  # lazily re-mirrored on the next full rebuild
+            self._log_cursor = log_head
+            self._overlay = dl.OverlayState()
+            self._overlay_active = False
+            self._install_device_arrays()
+            return True
 
     # -- query encoding -----------------------------------------------------
 
-    def _encode(self, queries: Sequence[RelationTuple], rest_depth: int):
-        snap = self.snapshot()
+    def _encode(self, snap: Snapshot, queries: Sequence[RelationTuple],
+                rest_depth: int):
         v = snap.vocab
         n = len(queries)
         ns_look = v.namespaces.lookup
@@ -396,8 +422,8 @@ class DeviceCheckEngine:
         n = len(queries)
         if n == 0:
             return None
-        snap = self.snapshot()
-        enc = self._encode(queries, rest_depth)
+        snap, dev_arrays, overlay_active = self._sync_view()
+        enc = self._encode(snap, queries, rest_depth)
         err, general = self._classify(snap, enc[0], enc[2])
         # pad for compile-cache reuse, but never beyond the frontier cap
         # (max_batch <= frontier guarantees n fits)
@@ -411,7 +437,7 @@ class DeviceCheckEngine:
             np.int32
         )
         res = fp.run_fast_packed(
-            self._device_arrays,
+            dev_arrays,
             qpack,
             frontier=self.frontier,
             arena=self.arena,
@@ -419,7 +445,7 @@ class DeviceCheckEngine:
             max_width=self.max_width,
         )
         gres = gi = None
-        if general.any() and self._overlay_active:
+        if general.any() and overlay_active:
             # the general-path interpreter reads the stale base arrays; with
             # an overlay pending its verdicts could miss writes, so those
             # (rare: AND/NOT-reachable) queries go to the oracle directly
@@ -430,7 +456,7 @@ class DeviceCheckEngine:
             gpad = _bucket(len(gi), 32)
             genc = self._pad(tuple(a[gi] for a in enc), len(gi), gpad)
             gres = dev.run_batch(
-                self._device_arrays,
+                dev_arrays,
                 *genc,
                 cap=self.cap,
                 arena=self.gen_arena,
@@ -439,12 +465,15 @@ class DeviceCheckEngine:
                 max_width=self.max_width,
                 strict=self.strict_mode,
             )
-        return (enc, err, general, res, gi, gres)
+        return (enc, err, general, res, gi, gres, dev_arrays)
 
     def _collect(self, handle, retry: bool = True):
         """Sync one chunk's results; device-retry the fast-path overflow
-        tail at ``retry_scale``x caps.  Returns (allowed, fallback)."""
-        enc, err, general, res, gi, gres = handle
+        tail at ``retry_scale``x caps.  Returns (allowed, fallback).
+        The retry runs against the handle's own device arrays — a write
+        landing between dispatch and retry must not pair these encodings
+        with a newer projection."""
+        enc, err, general, res, gi, gres, dev_arrays = handle
         n = err.shape[0]
         allowed = np.zeros(n, bool)
         fallback = err.copy()
@@ -479,7 +508,7 @@ class DeviceCheckEngine:
                 [*renc, (np.arange(rpad) < len(ri)).astype(np.int32)]
             ).astype(np.int32)
             rres = fp.run_fast_packed(
-                self._device_arrays,
+                dev_arrays,
                 rpack,
                 frontier=self.retry_scale * self.frontier,
                 arena=self.retry_scale * self.arena,
@@ -525,7 +554,6 @@ class DeviceCheckEngine:
         from ketotpu.engine import expand_device as xd
         from ketotpu.engine.oracle import ExpandEngine
 
-        snap = self.snapshot()
         oracle = ExpandEngine(self.store, max_depth=self.max_depth)
         subjects = list(subjects)
         out: List = [None] * len(subjects)
@@ -537,8 +565,15 @@ class DeviceCheckEngine:
                     tuple=RelationTuple("", "", "", s),
                 )
         if not set_idx:
+            # all-SubjectID expands never touch the engine: don't pay the
+            # mesh engine's lazy replicated-graph device transfer (and don't
+            # stall concurrent checks on the lock) for leaves
             return out
-        if self._overlay_active:
+        with self._sync_lock:
+            snap = self._snapshot_locked()
+            overlay_active = self._overlay_active
+            xarrays = None if overlay_active else self._expand_arrays()
+        if overlay_active:
             # the device membership CSR is stale between rebuilds; expand
             # reads every member, so answer on the live store
             for i in set_idx:
@@ -547,7 +582,7 @@ class DeviceCheckEngine:
             return out
         roots = [subjects[i] for i in set_idx]
         trees, over = xd.run_expand(
-            self._expand_arrays(), snap, roots, rest_depth,
+            xarrays, snap, roots, rest_depth,
             max_depth=self.max_depth, fanout=fanout, cap=cap,
         )
         for k, i in enumerate(set_idx):

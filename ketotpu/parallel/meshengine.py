@@ -97,14 +97,16 @@ class MeshCheckEngine(DeviceCheckEngine):
         n = len(queries)
         if n == 0:
             return None
-        snap = self.snapshot()
-        enc = self._encode(queries, rest_depth)
+        with self._sync_lock:
+            snap = self._snapshot_locked()
+            stacked = self._stacked
+        enc = self._encode(snap, queries, rest_depth)
         err, general = self._classify(snap, enc[0], enc[2])
         qpad = min(_bucket(n), self.frontier)
         padded = self._pad(enc, n, qpad)
         active = np.pad(~(err | general), (0, qpad - n))
         res = graphshard.sharded_check(
-            self._stacked,
+            stacked,
             padded,
             self.mesh,
             axis=self.mesh_axis,

@@ -1,0 +1,106 @@
+"""Cost model of the tunneled chip: RTT, upload, download, per-batch compute.
+
+prof_chain.py showed repeated executions with IDENTICAL inputs can return
+absurdly fast (0.2ms for a 4096-batch program) — the tunnel appears to
+memoize — so every measurement here uses DISTINCT inputs per repetition.
+
+Measures:
+  rtt          — trivial jit (x+1 on int32[8]) with fresh input each rep
+  upload       — device_put of an int32[6, B] query pack
+  download     — np.asarray of a uint8[B] device verdict
+  fused[B]     — the 5-level fused program, distinct query batch each rep
+  pipeline x4  — 4 distinct batches dispatched back-to-back, one sync pass
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from ketotpu.engine import fastpath as fp  # noqa: E402
+from ketotpu.engine.tpu import DeviceCheckEngine  # noqa: E402
+from ketotpu.utils.synth import build_synth, synth_queries  # noqa: E402
+
+
+def main():
+    print(f"devices: {jax.devices()}")
+
+    # RTT floor: tiny program, fresh input every rep
+    tiny = jax.jit(lambda x: x + 1)
+    xs = [np.full((8,), i, np.int32) for i in range(8)]
+    jax.block_until_ready(tiny(xs[0]))
+    ts = []
+    for x in xs[1:]:
+        t0 = time.perf_counter()
+        jax.block_until_ready(tiny(x))
+        ts.append(time.perf_counter() - t0)
+    print(f"rtt floor (tiny jit, fresh input): min={min(ts)*1000:.1f} "
+          f"med={sorted(ts)[len(ts)//2]*1000:.1f} ms")
+
+    graph = build_synth(
+        n_users=2000, n_groups=100, n_folders=2000, n_docs=20000, seed=0
+    )
+    eng = DeviceCheckEngine(
+        graph.store, graph.manager,
+        frontier=98304, arena=196608, max_batch=16384,
+    )
+    eng.snapshot()
+    snap = eng.snapshot()
+    g = eng._device_arrays
+
+    def make_packs(batch, n):
+        packs = []
+        for i in range(n):
+            qs = synth_queries(graph, batch, seed=100 + i)
+            enc = eng._encode(snap, qs, 0)
+            err, general = eng._classify(snap, enc[0], enc[2])
+            act = ~(err | general)
+            packs.append(np.stack([*enc, act.astype(np.int32)]).astype(np.int32))
+        return packs
+
+    # upload / download costs at 16k
+    packs = make_packs(16384, 6)
+    jax.block_until_ready(jax.device_put(packs[0]))
+    t0 = time.perf_counter()
+    for p in packs[1:]:
+        jax.block_until_ready(jax.device_put(p))
+    print(f"upload int32[6,16384]: {(time.perf_counter()-t0)/5*1000:.1f} ms avg")
+
+    for batch in (2048, 4096, 8192, 16384):
+        packs = make_packs(batch, 5)
+
+        def run(p):
+            return fp.run_fast_packed(
+                g, p, frontier=eng.frontier, arena=eng.arena,
+                max_depth=eng.max_depth, max_width=eng.max_width)
+
+        jax.block_until_ready(run(packs[0]))  # compile
+        ts = []
+        for p in packs[1:]:
+            t0 = time.perf_counter()
+            r = run(p)
+            v = np.asarray(r)  # full sync incl. download
+            ts.append(time.perf_counter() - t0)
+        t1 = min(ts)
+        print(f"fused batch={batch:6d}: min={t1*1000:8.1f} ms  "
+              f"({batch/t1:8.0f} checks/s)")
+
+        # pipelining: dispatch 4 distinct batches, then sync all
+        packs4 = make_packs(batch, 5)[1:]
+        t0 = time.perf_counter()
+        handles = [run(p) for p in packs4]
+        t_disp = time.perf_counter() - t0
+        outs = [np.asarray(h) for h in handles]
+        t_all = time.perf_counter() - t0
+        print(f"  4 batches pipelined: dispatch={t_disp*1000:7.1f} ms  "
+              f"total={t_all*1000:8.1f} ms  ({4*batch/t_all:8.0f} checks/s)")
+
+
+if __name__ == "__main__":
+    main()

@@ -110,3 +110,39 @@ def test_check_after_close_answers_directly(setup):
     q = synth_queries(graph, 1, seed=17)[0]
     eng.close()
     assert eng.check_is_member(q) == dev.oracle.check_is_member(q)
+
+
+def test_unexpected_error_raises_wave_without_serial_fallback():
+    # advisor r2: a transient device failure must NOT degrade the wave to
+    # per-query serial dispatches on the lone worker thread — it re-raises
+    # to every caller (only typed KetoAPIError gets per-query isolation)
+    class Boom:
+        def __init__(self):
+            self.calls = 0
+
+        def batch_check(self, queries, depth=0):
+            self.calls += 1
+            raise RuntimeError("device lost")
+
+    inner = Boom()
+    eng = CoalescingEngine(inner, window=0.05)
+    outcomes = []
+
+    def worker():
+        try:
+            eng.check_is_member(T("d:x#r@u"))
+            outcomes.append("no error")
+        except RuntimeError:
+            outcomes.append("runtime")
+        except Exception:  # noqa: BLE001
+            outcomes.append("wrong type")
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert outcomes == ["runtime"] * 8
+    # one dispatch per wave, never one per query
+    assert inner.calls < 8
+    eng.close()

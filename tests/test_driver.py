@@ -108,3 +108,18 @@ def test_registry_readiness_checks():
     boom = {"db": lambda: (_ for _ in ()).throw(RuntimeError("down"))}
     r = Registry(Provider(), readiness_checks=boom)
     assert r.health() == {"db": "down"}
+
+
+def test_env_coalesce_ms_override():
+    # advisor r2: coalesce_ms was missing from the multi-word env leaf-key
+    # rejoin list, so KETO_ENGINE_COALESCE_MS was silently ignored
+    p = Provider(env={"KETO_ENGINE_COALESCE_MS": "7"})
+    assert p.get("engine.coalesce_ms") == 7
+
+
+def test_namespaces_strict_mode_without_location_boots():
+    # advisor r2: {experimental_strict_mode} with no location passed config
+    # validation but blew up at boot with a raw FileNotFoundError("")
+    r = Registry(Provider({"namespaces": {"experimental_strict_mode": True}}))
+    assert r.namespace_manager().namespaces() == []
+    assert r.config.strict_mode() is True

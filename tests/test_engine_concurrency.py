@@ -1,0 +1,74 @@
+"""Regression: snapshot-state sync is thread-safe (advisor r2, high).
+
+The daemon calls batch_check from many gRPC worker threads while writes
+land.  Before the engine's ``_sync_lock``, two threads draining
+``changes_since`` with the same cursor double-applied deltas: the
+overlay's pair_net inflated, a later delete left a net-positive entry,
+and the revoked permission kept answering allowed (fails open) — with
+subsequent rebuilds projecting the corrupted column mirror.
+"""
+
+import threading
+
+from ketotpu.api.types import RelationTuple
+from ketotpu.engine.tpu import DeviceCheckEngine
+from ketotpu.opl.ast import Namespace
+from ketotpu.storage import InMemoryTupleStore, StaticNamespaceManager
+
+T = RelationTuple.from_string
+
+
+def test_concurrent_writes_and_checks_never_fail_open():
+    store = InMemoryTupleStore()
+    base = [T(f"d:doc{i}#owner@u{i}") for i in range(32)]
+    store.write_relation_tuples(*base)
+    nsm = StaticNamespaceManager([Namespace("d")])
+    eng = DeviceCheckEngine(store, nsm, frontier=512, arena=1024)
+    eng.snapshot()
+
+    hot = T("d:hot#owner@eve")
+    stop = threading.Event()
+    errors = []
+
+    def reader():
+        queries = [T(f"d:doc{i}#owner@u{i}") for i in range(32)]
+        try:
+            while not stop.is_set():
+                got = eng.batch_check(queries)
+                # base tuples are never touched: any False is corruption
+                assert all(got)
+        except Exception as e:  # noqa: BLE001 - re-raised on the main thread
+            errors.append(e)
+            stop.set()
+
+    def writer():
+        try:
+            for k in range(60):
+                store.write_relation_tuples(hot)
+                assert eng.check(hot) is True
+                store.delete_relation_tuples(hot)
+                extra = T(f"d:tmp#owner@w{k}")
+                store.write_relation_tuples(extra)
+                store.delete_relation_tuples(extra)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+        finally:
+            stop.set()
+
+    readers = [threading.Thread(target=reader) for _ in range(4)]
+    w = threading.Thread(target=writer)
+    for t in readers:
+        t.start()
+    w.start()
+    w.join()
+    stop.set()
+    for t in readers:
+        t.join()
+    assert not errors, errors
+    # the revoked permission must deny — fails-open here was the bug
+    assert eng.check(hot) is False
+    assert all(eng.batch_check(base))
+    # and a clean rebuild (fresh projection of the column mirror) agrees
+    eng.refresh()
+    assert eng.check(hot) is False
+    assert all(eng.batch_check(base))

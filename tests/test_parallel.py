@@ -34,7 +34,7 @@ def _engine_and_queries(n_queries, **synth_kw):
     eng = DeviceCheckEngine(graph.store, graph.manager, frontier=1024, arena=4096)
     eng.snapshot()
     queries = synth_queries(graph, n_queries)
-    enc = tuple(np.asarray(a) for a in eng._encode(queries, 0))
+    enc = tuple(np.asarray(a) for a in eng._encode(eng.snapshot(), queries, 0))
     want = [eng.oracle.check_is_member(r) for r in queries]
     return eng, graph, queries, enc, want
 
@@ -149,7 +149,7 @@ class d implements Namespace {
                             cap=2048, gen_arena=2048, vcap=1024)
     eng.snapshot()
     queries = [T(f"d:o{i}#finalize@u{i % 5}") for i in range(16)]
-    enc = tuple(np.asarray(a) for a in eng._encode(queries, 0))
+    enc = tuple(np.asarray(a) for a in eng._encode(eng.snapshot(), queries, 0))
     mesh = make_mesh(8)
     res = shard_batch_check(
         eng._device_arrays, enc, mesh, cap=2048, arena=2048, vcap=1024
