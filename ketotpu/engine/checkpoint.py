@@ -29,7 +29,10 @@ from ketotpu.engine.snapshot import Snapshot
 from ketotpu.engine.vocab import Interner, Vocab
 
 #: bump on ANY structural change to the serialized snapshot layout
-SNAPSHOT_FORMAT = 1
+#: (v2: node/membership hash tables build at SNAPSHOT_PROBE=4 — a v1
+#: checkpoint's deeper-bucket tables would silently miss entries under
+#: the shallower lookup unroll)
+SNAPSHOT_FORMAT = 2
 
 _SCALARS = ("num_rels", "n_nodes", "n_edges", "n_tuples", "version")
 _ARRAYS = (

@@ -64,9 +64,75 @@ class TupleColumns:
         for c in self.COLS:
             setattr(self, c, np.full(self.cap, -1, np.int32))
         self.alive = np.zeros(self.cap, bool)
-        # tuple identity -> alive row indices (FIFO delete order parity
-        # with the store's seq-ordered removal)
-        self._rows_by_key: Dict[Tuple, List[int]] = {}
+        # tuple identity (vocab id 4-tuple) -> alive row indices (FIFO
+        # delete order parity with the store's seq-ordered removal).
+        # None = lazy: bulk-adopted columns skip the per-row dict build
+        # (the 10M-tuple cliff) and pay it on the first delete instead.
+        self._rows_by_key: Optional[Dict[Tuple, List[int]]] = {}
+
+    @classmethod
+    def from_arrays(
+        cls, vocab: Vocab, cols: Dict[str, np.ndarray], alive: np.ndarray
+    ) -> "TupleColumns":
+        """Adopt pre-built id columns (a columnar store's base segment)
+        without any per-row Python — the row-key index is lazy."""
+        self = cls.__new__(cls)
+        self.vocab = vocab
+        n = int(len(alive))
+        cap = 1024
+        while cap < max(n, 1):
+            cap *= 2
+        self.cap = cap
+        self.n = n
+        for c in cls.COLS:
+            arr = np.full(cap, -1, np.int32)
+            arr[:n] = cols[c][:n]
+            setattr(self, c, arr)
+        self.alive = np.zeros(cap, bool)
+        self.alive[:n] = alive[:n]
+        self.alive_count = int(self.alive[:n].sum())
+        self._rows_by_key = None
+        return self
+
+    def masked(self, keep_rows: np.ndarray) -> "TupleColumns":
+        """Shallow view with ``alive`` further restricted to ``keep_rows``
+        (bool[n]) — shard partitioning without copying the columns."""
+        out = TupleColumns.__new__(TupleColumns)
+        out.vocab = self.vocab
+        out.cap = self.cap
+        out.n = self.n
+        for c in self.COLS:
+            setattr(out, c, getattr(self, c))
+        out.alive = self.alive.copy()
+        out.alive[: self.n] &= keep_rows[: self.n]
+        out.alive_count = int(out.alive[: self.n].sum())
+        out._rows_by_key = None
+        return out
+
+    def _key_ids(self, t: RelationTuple) -> Optional[Tuple]:
+        """Identity of a tuple in vocab-id space; None when any part is
+        unknown to the vocab (such a tuple cannot be in the columns)."""
+        v = self.vocab
+        ids = (
+            v.namespaces.lookup(t.namespace),
+            v.objects.lookup(t.object),
+            v.relations.lookup(t.relation),
+            v.subjects.lookup(t.subject.unique_id()),
+        )
+        return None if -1 in ids else ids
+
+    def _ensure_key_index(self) -> None:
+        if self._rows_by_key is not None:
+            return
+        idx: Dict[Tuple, List[int]] = {}
+        live = np.flatnonzero(self.alive[: self.n])
+        keys = zip(
+            self.ns[live].tolist(), self.obj[live].tolist(),
+            self.rel[live].tolist(), self.subj[live].tolist(),
+        )
+        for i, key in zip(live.tolist(), keys):
+            idx.setdefault(key, []).append(i)
+        self._rows_by_key = idx
 
     def _grow(self) -> None:
         new_cap = self.cap * 2
@@ -79,10 +145,6 @@ class TupleColumns:
         grown_alive[: self.n] = self.alive[: self.n]
         self.alive = grown_alive
         self.cap = new_cap
-
-    @staticmethod
-    def _key(t: RelationTuple) -> Tuple:
-        return (t.namespace, t.object, t.relation, t.subject.unique_id())
 
     def apply(self, op: int, t: RelationTuple) -> None:
         if op > 0:
@@ -105,13 +167,20 @@ class TupleColumns:
             self.alive[i] = True
             self.n += 1
             self.alive_count += 1
-            self._rows_by_key.setdefault(self._key(t), []).append(i)
+            if self._rows_by_key is not None:
+                key = (int(self.ns[i]), int(self.obj[i]),
+                       int(self.rel[i]), int(self.subj[i]))
+                self._rows_by_key.setdefault(key, []).append(i)
         else:
-            rows = self._rows_by_key.get(self._key(t))
+            key = self._key_ids(t)
+            if key is None:
+                return
+            self._ensure_key_index()
+            rows = self._rows_by_key.get(key)
             if rows:
                 i = rows.pop(0)
                 if not rows:
-                    del self._rows_by_key[self._key(t)]
+                    del self._rows_by_key[key]
                 if self.alive[i]:
                     self.alive[i] = False
                     self.alive_count -= 1
@@ -128,9 +197,12 @@ class TupleColumns:
         self.alive[: len(keep)] = True
         self.alive[len(keep):] = False
         self.n = len(keep)
-        remap = {int(old): new for new, old in enumerate(keep)}
-        for key, rows in self._rows_by_key.items():
-            self._rows_by_key[key] = [remap[r] for r in rows if r in remap]
+        if self._rows_by_key is not None:
+            remap = {int(old): new for new, old in enumerate(keep)}
+            for key, rows in self._rows_by_key.items():
+                self._rows_by_key[key] = [
+                    remap[r] for r in rows if r in remap
+                ]
 
 
 def build_snapshot_cols(
@@ -254,9 +326,11 @@ def build_snapshot_cols(
         node_hi[:n_nodes].astype(np.int64),
         node_lo[:n_nodes].astype(np.int64),
         np.arange(n_nodes, dtype=np.int32),
+        probe=hashtab.SNAPSHOT_PROBE,
     )
     mem_tab = hashtab.build_table(
-        mem_node_v.astype(np.int64), mem_subj_v.astype(np.int64)
+        mem_node_v.astype(np.int64), mem_subj_v.astype(np.int64),
+        probe=hashtab.SNAPSHOT_PROBE,
     )
 
     snap = Snapshot(

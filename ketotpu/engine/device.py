@@ -67,17 +67,28 @@ class RunResult(NamedTuple):
 
 
 def _node_lookup(g: Dict[str, jax.Array], ns, obj, rel):
-    """(ns, obj, rel) -> node id or -1.  Stride is the relation-vocab size."""
+    """(ns, obj, rel) -> node id or -1.  Stride is the relation-vocab size.
+    Hash-table probe (O(1) gathers) like the fast path — the unrolled
+    binary search this replaced costs log2(N) dependent gather rounds,
+    which at the 10M-tuple scale is ~24 rounds per lookup site."""
+    from ketotpu.engine import hashtab
+
     num_rels = g["prog_root"].shape[1]
     hi = ns * num_rels + rel
-    idx, found = lex_searchsorted((g["node_hi"], g["node_lo"]), (hi, obj))
-    found = found & (ns >= 0) & (obj >= 0) & (rel >= 0)
-    return jnp.where(found, idx, -1).astype(jnp.int32)
+    ok = (ns >= 0) & (obj >= 0) & (rel >= 0)
+    idx, found = hashtab.lookup(
+        hashtab.subtables(g, "nt_"), hi, obj, probe=hashtab.SNAPSHOT_PROBE
+    )
+    return jnp.where(found & ok, idx, -1).astype(jnp.int32)
 
 
 def _member(g: Dict[str, jax.Array], node, subj):
     """Does tuple (node, subject) exist?  ExistsRelationTuples equivalent."""
-    _, found = lex_searchsorted((g["mem_node"], g["mem_subj"]), (node, subj))
+    from ketotpu.engine import hashtab
+
+    _, found = hashtab.lookup(
+        hashtab.subtables(g, "mt_"), node, subj, probe=hashtab.SNAPSHOT_PROBE
+    )
     return found & (node >= 0) & (subj >= 0)
 
 
@@ -560,6 +571,37 @@ def check_step(
     )
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "k", "cap", "arena", "vcap", "max_width", "strict", "prop_passes",
+    ),
+)
+def check_steps(
+    g: Dict[str, jax.Array],
+    s: Dict[str, jax.Array],
+    *,
+    k: int,
+    cap: int,
+    arena: int,
+    vcap: int,
+    max_width: int = 100,
+    strict: bool = False,
+    prop_passes: int = 4,
+) -> Dict[str, jax.Array]:
+    """``k`` frontier levels fused into ONE device program.  Progress is
+    monotone, so steps past quiescence are no-ops and the LAST step's
+    flags summarize the window: once a step makes no progress none after
+    it can, and roots_done stays true once set — the host may therefore
+    early-exit on the window's final flags alone."""
+    for _ in range(k):
+        s = check_step(
+            g, s, cap=cap, arena=arena, vcap=vcap,
+            max_width=max_width, strict=strict, prop_passes=prop_passes,
+        )
+    return s
+
+
 def run_batch(
     g: Dict[str, jax.Array],
     q_ns,
@@ -575,17 +617,23 @@ def run_batch(
     max_width: int = 100,
     strict: bool = False,
     prop_passes: int = 4,
+    steps_per_dispatch: int = 4,
 ) -> RunResult:
-    """Host-driven wavefront: step until all roots resolve or no progress."""
+    """Host-driven wavefront: step until all roots resolve or no progress.
+    ``steps_per_dispatch`` levels run fused per dispatch (check_steps) —
+    the host syncs flags once per window instead of once per level, the
+    fix for the round-trip-per-iteration cost VERDICT r2 #3 flagged."""
     Q = q_ns.shape[0]
     s = init_state(q_ns, q_obj, q_rel, q_subj, q_depth, cap=cap, vcap=vcap)
     it = 0
-    for it in range(1, max_iters + 1):
-        s = check_step(
-            g, s,
+    while it < max_iters:
+        k = min(max(steps_per_dispatch, 1), max_iters - it)
+        s = check_steps(
+            g, s, k=k,
             cap=cap, arena=arena, vcap=vcap,
             max_width=max_width, strict=strict, prop_passes=prop_passes,
         )
+        it += k
         flags = int(s["flags"])
         if flags & F_ALL_ROOTS_DONE:
             break

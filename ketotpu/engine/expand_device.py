@@ -55,7 +55,12 @@ def _mem_deg(g, node):
     ptr = g["mem_row_ptr"]
     safe = jnp.clip(node, 0, ptr.shape[0] - 2)
     deg = ptr[safe + 1] - ptr[safe]
-    return jnp.where(node >= 0, deg, 0).astype(jnp.int32)
+    # overlay-created virtual nodes (>= ov_nbase) have no base member row;
+    # their members come entirely from the host-side overlay merge
+    ok = node >= 0
+    if "ov_nbase" in g:
+        ok = ok & (node < g["ov_nbase"])
+    return jnp.where(ok, deg, 0).astype(jnp.int32)
 
 
 @functools.partial(jax.jit, static_argnames=("schedule",))
@@ -153,6 +158,59 @@ class _Decoder:
         # unique_id format "id:<subject id>" (api/types.py)
         return SubjectID(uid[3:] if uid.startswith("id:") else uid)
 
+    def subject_from_uid(self, subj_id: int) -> Subject:
+        """Decode via the unique-id string alone — works for subjects
+        interned AFTER the snapshot (overlay writes), which the snapshot's
+        sub_ns/sub_obj/sub_rel arrays do not cover."""
+        uid = self.sub[subj_id]
+        if uid.startswith("set:"):
+            return SubjectSet.from_string(uid[4:])
+        return SubjectID(uid[3:] if uid.startswith("id:") else uid)
+
+
+class OverlayMembers:
+    """Host-side view of the write overlay for Expand: per-node membership
+    deltas vs the base snapshot, plus (hi, obj) -> virtual-node resolution.
+
+    Built under the engine's sync lock (a point-in-time copy — the live
+    OverlayState keeps mutating as writes land).  Expand is the one read
+    path that needs *every* member of a row, so the overlay-exact story is
+    host-side: the device enumerates base rows, and `assemble` drops
+    deleted members, appends added ones (in write order — matching the
+    reference's insertion-ordered pagination, relationtuples.go:216-219),
+    and recurses into added subject-sets via the sequential engine.  One
+    known divergence: a member deleted and re-added since the snapshot
+    keeps its original row position here, while live-store pagination
+    would move it to the end."""
+
+    def __init__(self, overlay, snap, vocab: Vocab):
+        self.added: Dict[int, List[int]] = {}
+        self.deleted: Dict[int, set] = {}
+        for (node, subj), net in overlay.pair_net.items():
+            if net > 0:
+                self.added.setdefault(node, []).append(subj)
+            elif net < 0:
+                self.deleted.setdefault(node, set()).add(subj)
+        self.new_nodes = dict(overlay.new_nodes)
+        self._snap = snap
+        self._vocab = vocab
+
+    def resolve(self, s: SubjectSet) -> int:
+        """Node id (base or virtual) for a subject set, -1 if unknown."""
+        from ketotpu.engine import delta as dl
+
+        v = self._vocab
+        ns = v.namespaces.lookup(s.namespace)
+        rel = v.relations.lookup(s.relation)
+        obj = v.objects.lookup(s.object)
+        if ns < 0 or rel < 0 or obj < 0:
+            return -1
+        hi = ns * self._snap.num_rels + rel
+        node = dl._base_node_id(self._snap, hi, obj)
+        if node < 0:
+            node = self.new_nodes.get((hi, obj), -1)
+        return node
+
 
 def _leaf(subject: Subject) -> Tree:
     return Tree(type=TreeNodeType.LEAF,
@@ -164,10 +222,19 @@ def assemble(
     sub_dec: Tuple[np.ndarray, np.ndarray, np.ndarray],
     vocab: Vocab,
     roots: List[SubjectSet],
+    ov: Optional[OverlayMembers] = None,
+    sub_expand=None,
 ) -> List[Optional[Tree]]:
-    """Exact DFS replay of expand/engine.go:54-124 over the device records."""
+    """Exact DFS replay of expand/engine.go:54-124 over the device records.
+
+    With ``ov`` set, each union node's member list is the base row minus
+    deleted pairs plus added pairs; added subject-set members (which the
+    device never expanded) recurse through ``sub_expand(subject, depth,
+    visited)`` — the sequential engine sharing THIS tree's visited set, so
+    the reference's global-DFS-visited semantics hold across the merge."""
     dec = _Decoder(vocab)
     sub_ns, sub_obj, sub_rel = sub_dec
+    n_snap_subj = len(sub_ns)
     # children of item i at level l: slots of level l+1 with parent == i,
     # in slot (row insertion) order
     kids: List[Dict[int, List[int]]] = []
@@ -176,6 +243,13 @@ def assemble(
         for slot in np.flatnonzero(nxt["parent"] >= 0):
             by_parent.setdefault(int(nxt["parent"][slot]), []).append(int(slot))
         kids.append(by_parent)
+
+    def decode(sid: int) -> Subject:
+        if sid < n_snap_subj:
+            return dec.subject(
+                sid, int(sub_ns[sid]), int(sub_obj[sid]), int(sub_rel[sid])
+            )
+        return dec.subject_from_uid(sid)
 
     out: List[Optional[Tree]] = []
     for r, root_subject in enumerate(roots):
@@ -187,7 +261,15 @@ def assemble(
             if subject.unique_id() in visited:
                 return None
             visited.add(subject.unique_id())
-            if levels[level]["deg"][slot] == 0:
+            base_deg = int(levels[level]["deg"][slot])
+            added: List[int] = []
+            deleted: set = set()
+            if ov is not None:
+                node = ov.resolve(subject)
+                if node >= 0:
+                    added = ov.added.get(node, [])
+                    deleted = ov.deleted.get(node, set())
+            if base_deg - len(deleted) + len(added) <= 0:
                 return None
             tree = Tree(type=TreeNodeType.UNION,
                         tuple=RelationTuple("", "", "", subject))
@@ -197,11 +279,20 @@ def assemble(
             for cslot in kids[level].get(slot, ()):  # row order
                 rec = levels[level + 1]
                 sid = int(rec["subj"][cslot])
-                child_subject = dec.subject(
-                    sid, int(sub_ns[sid]), int(sub_obj[sid]), int(sub_rel[sid])
-                )
+                if sid in deleted:
+                    continue
+                child_subject = decode(sid)
                 child = build(level + 1, cslot, child_subject,
                               int(rec["d"][cslot]))
+                if child is None:
+                    child = _leaf(child_subject)
+                tree.children.append(child)
+            for sid in added:  # write order = end of the live row
+                child_subject = decode(sid)
+                if isinstance(child_subject, SubjectID):
+                    tree.children.append(_leaf(child_subject))
+                    continue
+                child = sub_expand(child_subject, depth - 1, visited)
                 if child is None:
                     child = _leaf(child_subject)
                 tree.children.append(child)
@@ -220,6 +311,8 @@ def run_expand(
     max_depth: int = 5,
     fanout: int = 16,
     cap: int = 65536,
+    ov: Optional[OverlayMembers] = None,
+    sub_expand=None,
 ):
     """Device traversal + host assembly for a batch of subject-set roots.
 
@@ -245,6 +338,7 @@ def run_expand(
     levels = [{k: np.asarray(v) for k, v in lvl.items()} for lvl in levels]
     over = np.asarray(over)
     trees = assemble(
-        levels, (snap.sub_ns, snap.sub_obj, snap.sub_rel), vocab, roots
+        levels, (snap.sub_ns, snap.sub_obj, snap.sub_rel), vocab, roots,
+        ov=ov, sub_expand=sub_expand,
     )
     return trees, over

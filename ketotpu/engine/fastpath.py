@@ -109,10 +109,6 @@ class FastResult(NamedTuple):
     dirty: Optional[jax.Array] = None
 
 
-def _tab(g: Dict[str, jax.Array], prefix: str) -> Dict[str, jax.Array]:
-    return {k[len(prefix):]: v for k, v in g.items() if k.startswith(prefix)}
-
-
 def _node_lookup(g: Dict[str, jax.Array], ns, obj, rel):
     """(ns, obj, rel) -> node id or -1.  Stride = padded relation count.
     With a delta overlay, nodes created since the base snapshot resolve to
@@ -120,12 +116,14 @@ def _node_lookup(g: Dict[str, jax.Array], ns, obj, rel):
     num_rels = g["f_direct_ok"].shape[1]
     hi = ns * num_rels + rel
     ok = (ns >= 0) & (obj >= 0) & (rel >= 0)
-    idx, found = hashtab.lookup(_tab(g, "nt_"), hi, obj)
+    idx, found = hashtab.lookup(
+        hashtab.subtables(g, "nt_"), hi, obj, probe=hashtab.SNAPSHOT_PROBE
+    )
     found = found & ok
     res = jnp.where(found, idx, -1)
     if "ovt_ptr" in g:
         vid, vfound = hashtab.lookup(
-            _tab(g, "ovt_"), hi, obj, probe=hashtab.PROBE_SHALLOW
+            hashtab.subtables(g, "ovt_"), hi, obj, probe=hashtab.PROBE_SHALLOW
         )
         res = jnp.where(ok & vfound & ~found, vid, res)
     return res.astype(jnp.int32)
@@ -135,10 +133,12 @@ def _member(g: Dict[str, jax.Array], node, subj):
     """Does tuple (node, subject) exist?  ExistsRelationTuples equivalent.
     Overlay-exact: base OR added-since-base AND NOT deleted-since-base, so
     probe verdicts always reflect the latest write."""
-    _, found = hashtab.lookup(_tab(g, "mt_"), node, subj)
+    _, found = hashtab.lookup(
+        hashtab.subtables(g, "mt_"), node, subj, probe=hashtab.SNAPSHOT_PROBE
+    )
     if "om_ptr" in g:
         v, vf = hashtab.lookup(
-            _tab(g, "om_"), node, subj, probe=hashtab.PROBE_SHALLOW
+            hashtab.subtables(g, "om_"), node, subj, probe=hashtab.PROBE_SHALLOW
         )
         found = (found | (vf & (v == OV_ADDED))) & ~(vf & (v == OV_DELETED))
     return found
@@ -604,29 +604,40 @@ fast_step = functools.partial(
 PROBE_ONLY_ARENA = 8  # arena <= this: level runs probes only, no children
 
 
+#: worst-case per-level frontier multipliers (units of q); also the ceiling
+#: the demand-adaptive schedule may never exceed
+F_MULT = (1, 4, 5, 6, 6)
+
+
 def level_schedule(
-    q: int, frontier: int, arena: int, max_depth: int, boost: int = 1
+    q: int, frontier: int, arena: int, max_depth: int, boost: int = 1,
+    mults: Optional[Tuple[int, ...]] = None,
 ) -> Tuple[Tuple[int, int], ...]:
     """Per-level (frontier, arena) sizes: level 0 holds exactly the roots,
     later levels grow geometrically up to the configured caps.  Early levels
     are the common case (short-circuit kills most queries fast), so sizing
     them to the work instead of the worst case is most of the win.
 
-    Growth is tuned to measured frontier shapes (chains with a mid-walk
-    bulge dominate, not explosions: a deny-verdict query walks ~1-2 children
-    per item per level until its closure is exhausted).  Capacity misses
-    surface as per-query ``over`` bits and the engine retries just those
-    queries at wider caps (tpu.py) — far cheaper than sizing every batch for
-    the worst case.  The final level cannot produce live children (depth
-    strictly decreases and a child needs d >= 1), so it runs probe-only
-    with a token arena.
+    Default growth is tuned to measured frontier shapes (chains with a
+    mid-walk bulge dominate, not explosions: a deny-verdict query walks
+    ~1-2 children per item per level until its closure is exhausted);
+    ``mults`` overrides it with *measured* per-level multipliers — the
+    engine feeds back the fused program's per-level occupancy counts, so
+    steady-state batches size every buffer to the workload's actual
+    frontier shape instead of the worst case (the per-level cost is
+    dominated by array-sized device work, so smaller buffers are a direct
+    win).  Capacity misses surface as per-query ``over`` bits and the
+    engine retries just those queries at wider caps (tpu.py) — far cheaper
+    than sizing every batch for the worst case.  The final level cannot
+    produce live children (depth strictly decreases and a child needs
+    d >= 1), so it runs probe-only with a token arena.
 
     ``boost`` scales the demand-driven per-query term (m*q), not just the
     caps: a retry tier must grow the capacity a query's own fan-out gets,
     and when levels are q-bound rather than cap-bound, scaling only the
     caps would change nothing.
     """
-    f_mult = (1, 4, 5, 6, 6)
+    f_mult = F_MULT if mults is None else mults
     out = []
     for lvl in range(max_depth):
         last = lvl == max_depth - 1
@@ -656,7 +667,9 @@ def _fused_body(
     # decreases per level).  Callers pass rest_depth <= max_depth anyway
     # (engine.go:82-84 global-cap precedence); clamp defensively.
     s["f_depth"] = jnp.minimum(s["f_depth"], len(schedule))
+    occ = []  # live items ENTERING each level (occ[0] = roots)
     for i, (f, a) in enumerate(schedule):
+        occ.append(jnp.sum((s["f_qid"] >= 0).astype(jnp.int32)))
         nxt_f = schedule[i + 1][0] if i + 1 < len(schedule) else 1
         children, q_found, q_over, q_dirty = expand_phase(
             g, s, arena=a, max_width=max_width,
@@ -671,7 +684,7 @@ def _fused_body(
         )
     return FastResult(
         found=s["q_found"], over=s["q_over"], dirty=s["q_dirty"]
-    )
+    ), jnp.stack(occ)
 
 
 _run_fused = functools.partial(
@@ -689,10 +702,12 @@ def _run_fused_packed(
 ):
     """Packed-I/O variant: queries arrive as ONE int32[6, Q] array
     (ns, obj, rel, subj, depth, active) and verdicts leave as ONE uint8[Q]
-    (bit0 found, bit1 over, bit2 dirty).  On a tunneled host link every
-    separate host<->device array transfer costs a round-trip; packing turns
-    6 uploads + 3 downloads per batch into 1 + 1."""
-    r = _fused_body(
+    (bit0 found, bit1 over, bit2 dirty), plus the int32[levels] per-level
+    occupancy counts the engine's adaptive scheduler feeds on.  On a
+    tunneled host link every separate host<->device array transfer costs a
+    round-trip; packing turns 6 uploads + 3 downloads per batch into
+    1 + 2 (the occupancy vector is a handful of bytes)."""
+    r, occ = _fused_body(
         g, qpack[0], qpack[1], qpack[2], qpack[3], qpack[4],
         qpack[5].astype(bool),
         schedule=schedule, max_width=max_width,
@@ -701,7 +716,7 @@ def _run_fused_packed(
         r.found.astype(jnp.uint8)
         | (r.over.astype(jnp.uint8) << 1)
         | (r.dirty.astype(jnp.uint8) << 2)
-    )
+    ), occ
 
 
 def run_fast_packed(
@@ -713,14 +728,15 @@ def run_fast_packed(
     max_depth: int = 5,
     max_width: int = 100,
     boost: int = 1,
+    mults: Optional[Tuple[int, ...]] = None,
 ):
     """run_fast over a pre-packed int32[6, Q] query block; returns the
-    (device) uint8 verdict array — the caller fetches it with one
-    np.asarray when it syncs."""
+    (device) uint8 verdict array and the int32[levels] occupancy vector —
+    the caller fetches them with np.asarray when it syncs."""
     Q = qpack.shape[1]
     if Q > frontier:
         raise ValueError(f"batch {Q} exceeds frontier capacity {frontier}")
-    sched = level_schedule(Q, frontier, arena, max_depth, boost)
+    sched = level_schedule(Q, frontier, arena, max_depth, boost, mults)
     return _run_fused_packed(g, qpack, schedule=sched, max_width=max_width)
 
 
@@ -750,7 +766,8 @@ def run_fast(
         raise ValueError(f"batch {Q} exceeds frontier capacity {frontier}")
     act = np.ones((Q,), bool) if active is None else np.asarray(active, bool)
     sched = level_schedule(Q, frontier, arena, max_depth, boost)
-    return _run_fused(
+    res, _occ = _run_fused(
         g, q_ns, q_obj, q_rel, q_subj, q_depth, act,
         schedule=sched, max_width=max_width,
     )
+    return res

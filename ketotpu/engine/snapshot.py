@@ -27,6 +27,7 @@ from typing import Dict, Optional
 import numpy as np
 
 from ketotpu.api.types import RelationTuple, SubjectSet
+from ketotpu.engine import hashtab
 from ketotpu.engine.hashtab import build_table
 from ketotpu.engine.optable import (
     FlatTables,
@@ -306,10 +307,12 @@ def build_snapshot(
         np.fromiter((k[0] for k in uniq), np.int64, n_nodes),
         np.fromiter((k[1] for k in uniq), np.int64, n_nodes),
         np.arange(n_nodes, dtype=np.int32),
+        probe=hashtab.SNAPSHOT_PROBE,
     )
     mem_tab = build_table(
         np.fromiter((p[0] for p in pairs), np.int64, n_tuples),
         np.fromiter((p[1] for p in pairs), np.int64, n_tuples),
+        probe=hashtab.SNAPSHOT_PROBE,
     )
 
     snap = Snapshot(

@@ -39,6 +39,7 @@ this version, SURVEY §2 proto row).
 from __future__ import annotations
 
 import hashlib
+import os
 import threading
 from typing import List, Optional, Sequence
 
@@ -144,6 +145,17 @@ class DeviceCheckEngine:
         self.max_overlay_pairs = 4096
         self.max_overlay_dirty = 512
         self.retry_scale = retry_scale
+        # demand-adaptive level scheduling: EMA of the fused program's
+        # per-level frontier occupancy (units of active roots).  None until
+        # the first batch reports; dispatches then size per-level buffers
+        # to measured demand x headroom instead of the worst case —
+        # per-level device cost scales with buffer sizes, and the retry
+        # tier catches any underestimate (monotone over bits).
+        self._occ_ema: Optional[np.ndarray] = None
+        # measured batch-to-batch occupancy variance on the synth workloads
+        # is a few %; underestimates cost one retry dispatch for the
+        # overflow tail, so a tight margin wins
+        self.occ_headroom = 1.15
         self.fallbacks = 0  # observability: host-fallback counter
         self.retries = 0  # observability: device-retry (tier-2) counter
         self.rebuilds = 0  # observability: full snapshot rebuilds
@@ -166,7 +178,15 @@ class DeviceCheckEngine:
         """Bring the column mirror up to date with the store.  Incremental
         when the change log still covers our cursor; otherwise a full rescan
         (tuples + log head read under one store lock, so no write can land
-        between the scan and the cursor)."""
+        between the scan and the cursor).
+
+        Columnar stores (storage/columnar.py) short-circuit the rescan:
+        their base segment IS the column layout, so the mirror adopts the
+        id arrays wholesale (no per-tuple Python — the 10M-tuple path) and
+        only tail rows replay row-wise.  Adoption requires this engine's
+        vocab to be empty (fresh boot) or already the store's own — after
+        a checkpoint resume the snapshot's vocab owns the id space and the
+        slow path re-interns instead."""
         if self._cols is not None:
             changes, head = self.store.changes_since(self._log_cursor)
             if changes is not None:
@@ -175,6 +195,18 @@ class DeviceCheckEngine:
                 self._log_cursor = head
                 return
             self._cols = None  # change log overflowed past our cursor
+        exporter = getattr(self.store, "export_columns", None)
+        store_vocab = getattr(self.store, "vocab", None)
+        if exporter is not None and (
+            store_vocab is self._vocab or len(self._vocab.subjects) == 0
+        ):
+            cols, alive, tail, head = exporter()
+            self._vocab = store_vocab
+            self._cols = dl.TupleColumns.from_arrays(store_vocab, cols, alive)
+            for t in tail:
+                self._cols.apply(1, t)
+            self._log_cursor = head
+            return
         tuples, head = self.store.tuples_and_head()
         self._cols = dl.TupleColumns(self._vocab)
         for t in tuples:
@@ -249,31 +281,35 @@ class DeviceCheckEngine:
                 for op, t in changes:
                     self._cols.apply(op, t)
             self._log_cursor = head
-            try:
-                dl.apply_changes(self._overlay, self._snap, self._vocab, changes)
-            except dl.OverlayRejected:
+            if not self._overlay_apply(changes):
                 self._rebuild(fingerprint)
                 return self._snap
-            pairs, dirty = self._overlay.size()
-            if pairs > self.max_overlay_pairs or dirty > self.max_overlay_dirty:
-                self._rebuild(fingerprint)
-                return self._snap
-            try:
-                ov = dl.overlay_arrays(
-                    self._overlay, self._snap, pair_cap=self.max_overlay_pairs
-                )
-            except ValueError:  # fixed-shape table could not fit the content
-                self._rebuild(fingerprint)
-                return self._snap
-            if self._base_device is None:  # mesh engine: no overlay serving
-                self._rebuild(fingerprint)
-                return self._snap
-            self._device_arrays = dict(
-                self._base_device, **jax.device_put(ov)
-            )
             self._overlay_active = True
             self.overlay_applies += 1
         return self._snap
+
+    def _overlay_apply(self, changes) -> bool:
+        """Serve ``changes`` through the O(delta) overlay; False = the
+        overlay cannot (or should not) represent them and the caller must
+        fall back to a full rebuild.  The mesh engine overrides this with
+        per-shard overlays routed by the (ns, obj) owner hash."""
+        try:
+            dl.apply_changes(self._overlay, self._snap, self._vocab, changes)
+        except dl.OverlayRejected:
+            return False
+        pairs, dirty = self._overlay.size()
+        if pairs > self.max_overlay_pairs or dirty > self.max_overlay_dirty:
+            return False
+        try:
+            ov = dl.overlay_arrays(
+                self._overlay, self._snap, pair_cap=self.max_overlay_pairs
+            )
+        except ValueError:  # fixed-shape table could not fit the content
+            return False
+        if self._base_device is None:
+            return False
+        self._device_arrays = dict(self._base_device, **jax.device_put(ov))
+        return True
 
     def _sync_view(self):
         """Atomic (snapshot, device_arrays, overlay_active) triple.  Writers
@@ -384,6 +420,51 @@ class DeviceCheckEngine:
         general = ~err & ns_ok & rel_known & snap.taint[nsc, relc]
         return err, general
 
+    # -- demand-adaptive level scheduling -----------------------------------
+
+    def _adaptive_mults(self):
+        """Per-level frontier multipliers from the occupancy EMA, or None
+        (worst-case F_MULT) before the first report.
+
+        Demand is quantized UP to a small preset ladder (uniform base
+        capped by F_MULT) rather than used per-level raw: arbitrary
+        per-level tuples make every EMA wobble a brand-new fused program —
+        hundreds of distinct XLA executables per process (measured: the
+        XLA:CPU backend segfaults under that compile load, and every
+        variant costs ~20s compile on any backend).  The ladder bounds the
+        engine to at most 4 schedule variants per (batch-size, boost)
+        while keeping the buffer-size win of demand sizing."""
+        ema = self._occ_ema
+        if ema is None or os.environ.get("KETO_NO_ADAPTIVE"):
+            return None
+        caps = [
+            fp.F_MULT[min(lvl, len(fp.F_MULT) - 1)]
+            for lvl in range(1, self.max_depth)
+        ]
+        want = [
+            max(1, min(c, int(np.ceil(
+                ema[min(lvl, len(ema) - 1)] * self.occ_headroom
+            ))))
+            for lvl, c in zip(range(1, self.max_depth), caps)
+        ]
+        for base in (1, 2, 4):
+            rung = [min(c, base) for c in caps]
+            if all(r >= w for r, w in zip(rung, want)):
+                return (1, *rung)
+        return None  # worst case: the F_MULT default
+
+    def _update_occ(self, occ: np.ndarray) -> None:
+        """Fold one batch's per-level occupancy counts into the EMA
+        (normalized by the batch's active-root count, occ[0])."""
+        roots = float(occ[0])
+        if roots <= 0:
+            return
+        ratio = occ.astype(np.float64) / roots
+        if self._occ_ema is None or len(self._occ_ema) != len(ratio):
+            self._occ_ema = ratio
+        else:
+            self._occ_ema = 0.5 * self._occ_ema + 0.5 * ratio
+
     # -- public API ---------------------------------------------------------
 
     def check(self, r: RelationTuple, rest_depth: int = 0) -> bool:
@@ -436,13 +517,14 @@ class DeviceCheckEngine:
         qpack = np.stack([*padded, fast_active.astype(np.int32)]).astype(
             np.int32
         )
-        res = fp.run_fast_packed(
+        res, occ = fp.run_fast_packed(
             dev_arrays,
             qpack,
             frontier=self.frontier,
             arena=self.arena,
             max_depth=self.max_depth,
             max_width=self.max_width,
+            mults=self._adaptive_mults(),
         )
         gres = gi = None
         if general.any() and overlay_active:
@@ -465,7 +547,7 @@ class DeviceCheckEngine:
                 max_width=self.max_width,
                 strict=self.strict_mode,
             )
-        return (enc, err, general, res, gi, gres, dev_arrays)
+        return (enc, err, general, res, gi, gres, dev_arrays, occ)
 
     def _collect(self, handle, retry: bool = True):
         """Sync one chunk's results; device-retry the fast-path overflow
@@ -473,7 +555,7 @@ class DeviceCheckEngine:
         The retry runs against the handle's own device arrays — a write
         landing between dispatch and retry must not pair these encodings
         with a newer projection."""
-        enc, err, general, res, gi, gres, dev_arrays = handle
+        enc, err, general, res, gi, gres, dev_arrays, occ = handle
         n = err.shape[0]
         allowed = np.zeros(n, bool)
         fallback = err.copy()
@@ -485,6 +567,7 @@ class DeviceCheckEngine:
             fallback[gi] |= gover | (codes == dev.R_ERR)
 
         codes = np.asarray(res)[:n]  # one D2H fetch for all three masks
+        self._update_occ(np.asarray(occ))
         found = (codes & 1).astype(bool)
         over = ((codes >> 1) & 1).astype(bool)
         dirty = ((codes >> 2) & 1).astype(bool)
@@ -507,7 +590,7 @@ class DeviceCheckEngine:
             rpack = np.stack(
                 [*renc, (np.arange(rpad) < len(ri)).astype(np.int32)]
             ).astype(np.int32)
-            rres = fp.run_fast_packed(
+            rres, _roc = fp.run_fast_packed(
                 dev_arrays,
                 rpack,
                 frontier=self.retry_scale * self.frontier,
@@ -516,7 +599,9 @@ class DeviceCheckEngine:
                 max_width=self.max_width,
                 # scale the per-query schedule too: the tail queries need
                 # retry_scale x the capacity their tier-1 share gave them,
-                # and with a small retry batch the caps alone don't bind
+                # and with a small retry batch the caps alone don't bind.
+                # No adaptive mults here: the retry exists precisely because
+                # the demand-sized tier missed.
                 boost=self.retry_scale,
             )
             rcodes = np.asarray(rres)[: len(ri)]
@@ -548,8 +633,13 @@ class DeviceCheckEngine:
         """Batched device Expand (SURVEY §7 step 5): one fused dispatch for
         all subject-set roots, host-side exact DFS reassembly.  SubjectID
         roots are leaves without touching the engine (expand/handler.go:
-        115-126); overlay-pending or overflowed roots fall back to the
-        sequential oracle expand (live store, exact)."""
+        115-126).  With a write overlay pending, the device still
+        enumerates base rows and the assembly merges the overlay's
+        membership deltas host-side (expand_device.OverlayMembers) —
+        added subject-set subtrees recurse through the sequential engine
+        with the shared visited set, so writes stay exactly visible
+        without the blanket fall-to-oracle r2 shipped.  Only overflowed
+        roots fall back to the sequential oracle expand (live store)."""
         from ketotpu.api.types import SubjectID, SubjectSet, Tree, TreeNodeType
         from ketotpu.engine import expand_device as xd
         from ketotpu.engine.oracle import ExpandEngine
@@ -572,18 +662,17 @@ class DeviceCheckEngine:
         with self._sync_lock:
             snap = self._snapshot_locked()
             overlay_active = self._overlay_active
-            xarrays = None if overlay_active else self._expand_arrays()
-        if overlay_active:
-            # the device membership CSR is stale between rebuilds; expand
-            # reads every member, so answer on the live store
-            for i in set_idx:
-                self.fallbacks += 1
-                out[i] = oracle.build_tree(subjects[i], rest_depth)
-            return out
+            xarrays = self._expand_arrays()
+            ov = (
+                xd.OverlayMembers(self._overlay, snap, self._vocab)
+                if overlay_active else None
+            )
         roots = [subjects[i] for i in set_idx]
         trees, over = xd.run_expand(
             xarrays, snap, roots, rest_depth,
             max_depth=self.max_depth, fanout=fanout, cap=cap,
+            ov=ov,
+            sub_expand=oracle._build,
         )
         for k, i in enumerate(set_idx):
             if over[k]:

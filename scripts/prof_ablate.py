@@ -1,0 +1,122 @@
+"""Ablate expand_phase at the dominant level shape: which gather family
+dominates?  Times jitted variants with each family stubbed out.
+
+Families:
+  lookups  — _node_lookup calls (nt_ hash probes)
+  members  — _member calls (mt_ hash probes)
+  params   — f_css_* / f_ttu_* / f_direct_ok / f_expand_ok small-table rows
+  children — arena child construction (edge gathers + aps indexing)
+  pack     — hash-scatter dedup + compaction
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from unittest import mock
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from ketotpu.engine import fastpath as fp  # noqa: E402
+from ketotpu.engine import hashtab  # noqa: E402
+from ketotpu.engine.tpu import DeviceCheckEngine  # noqa: E402
+from ketotpu.utils.synth import build_synth, synth_queries  # noqa: E402
+
+BATCH = 16384
+
+
+def timeit(fn, n=3, warmup=1):
+    for _ in range(warmup):
+        jax.block_until_ready(fn())
+    ts = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+def main():
+    graph = build_synth(
+        n_users=2000, n_groups=100, n_folders=2000, n_docs=20000, seed=0
+    )
+    eng = DeviceCheckEngine(
+        graph.store, graph.manager, frontier=98304, arena=196608,
+        max_batch=BATCH,
+    )
+    eng.snapshot()
+    snap = eng.snapshot()
+    g = eng._device_arrays
+    print("Kc =", snap.flat.css_rel.shape[2], " Kt =", snap.flat.ttu_via.shape[2],
+          " NS,R =", snap.flat.direct_ok.shape)
+
+    queries = synth_queries(graph, BATCH, seed=7)
+    enc = eng._encode(snap, queries, 0)
+    err, general = eng._classify(snap, enc[0], enc[2])
+    act = ~(err | general)
+    sched = fp.level_schedule(BATCH, eng.frontier, eng.arena, eng.max_depth)
+
+    # drive to level 2 (the bulge) and freeze that state
+    s = fp.init_state(*enc, act, frontier=sched[0][0])
+    s["f_depth"] = jnp.minimum(s["f_depth"], len(sched))
+    for i in range(2):
+        f, a = sched[i]
+        nxt_f = sched[i + 1][0]
+        children, qf, qo, qd = fp.expand_phase(g, s, arena=a, max_width=100)
+        nxt, qo = fp.pack_phase(children, qf, qo, frontier=nxt_f,
+                                ns_dim=g["f_direct_ok"].shape[0],
+                                rel_dim=g["f_direct_ok"].shape[1])
+        s = dict(nxt, q_found=qf, q_over=qo, q_dirty=qd, q_subj=s["q_subj"])
+    s = jax.block_until_ready(jax.jit(lambda x: x)(s))
+    f2, a2 = sched[2]
+    nxt_f2 = sched[3][0]
+    NS, R = g["f_direct_ok"].shape
+
+    def full():
+        c, qf, qo, qd = fp.expand_phase(g, s, arena=a2, max_width=100)
+        nxt, qo = fp.pack_phase(c, qf, qo, frontier=nxt_f2, ns_dim=NS, rel_dim=R)
+        return nxt, qf, qo, qd
+
+    t_full = timeit(jax.jit(full))
+    print(f"full level:            {t_full*1000:7.1f} ms")
+
+    def expand_only():
+        return fp.expand_phase(g, s, arena=a2, max_width=100)
+
+    t_exp = timeit(jax.jit(expand_only))
+    print(f"expand only:           {t_exp*1000:7.1f} ms  "
+          f"(pack = {max(t_full-t_exp,0)*1000:.1f} by difference)")
+
+    # stub node lookups: cheap arithmetic instead of hash probes
+    def fake_node_lookup(g_, ns, obj, rel):
+        num_rels = g_["f_direct_ok"].shape[1]
+        return jnp.where(
+            (ns >= 0) & (obj >= 0) & (rel >= 0),
+            (ns * num_rels + rel + obj) % jnp.int32(1000), -1
+        ).astype(jnp.int32)
+
+    with mock.patch.object(fp, "_node_lookup", fake_node_lookup):
+        t_nolook = timeit(jax.jit(expand_only))
+    print(f"expand, no node-lookups: {t_nolook*1000:5.1f} ms  "
+          f"(lookups = {(t_exp-t_nolook)*1000:.1f})")
+
+    def fake_member(g_, node, subj):
+        return (node + subj) % 7 == 0
+
+    with mock.patch.object(fp, "_member", fake_member):
+        t_nomem = timeit(jax.jit(expand_only))
+    print(f"expand, no member probes: {t_nomem*1000:4.1f} ms  "
+          f"(members = {(t_exp-t_nomem)*1000:.1f})")
+
+    with mock.patch.object(fp, "_node_lookup", fake_node_lookup), \
+         mock.patch.object(fp, "_member", fake_member):
+        t_neither = timeit(jax.jit(expand_only))
+    print(f"expand, neither:       {t_neither*1000:7.1f} ms")
+
+
+if __name__ == "__main__":
+    main()

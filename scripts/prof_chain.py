@@ -110,7 +110,7 @@ def main():
         # sanity: same verdicts
         vf = np.asarray(fp.run_fast_packed(
             g, qpack, frontier=eng.frontier, arena=eng.arena,
-            max_depth=eng.max_depth, max_width=eng.max_width))
+            max_depth=eng.max_depth, max_width=eng.max_width)[0])
         vc = np.asarray(chained(g, qpack, sched, eng.max_width))
         assert np.array_equal(vf, vc), "verdict mismatch"
         print(f"batch={batch}: fused={t_fused*1000:8.1f} ms   "

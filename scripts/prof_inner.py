@@ -1,0 +1,197 @@
+"""Intra-expand ablation: which part of child construction dominates?
+
+Stages (cumulative, each jitted separately, DCE prevented by returning
+the stage's arrays):
+  s1: probes + seg_len + cumsum             (frontier-sized)
+  s2: + arena_assign (scatter + max-scan)   (arena-sized scan)
+  s3: + segment decomposition (cum_p gather, seg_idx, prev_cum)
+  s4: + aps gathers (parent cols) + edge index math
+  s5: + edge gathers + child cols (= full expand_phase)
+Then pack_phase alone, and its hash-scatter vs compaction halves.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from ketotpu.engine import fastpath as fp  # noqa: E402
+from ketotpu.engine.xutil import arena_assign  # noqa: E402
+from ketotpu.engine.tpu import DeviceCheckEngine  # noqa: E402
+from ketotpu.utils.synth import build_synth, synth_queries  # noqa: E402
+
+BATCH = 16384
+
+
+def timeit(fn, n=3, warmup=1):
+    for _ in range(warmup):
+        jax.block_until_ready(fn())
+    ts = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+def main():
+    graph = build_synth(
+        n_users=2000, n_groups=100, n_folders=2000, n_docs=20000, seed=0
+    )
+    eng = DeviceCheckEngine(
+        graph.store, graph.manager, frontier=98304, arena=196608,
+        max_batch=BATCH,
+    )
+    eng.snapshot()
+    snap = eng.snapshot()
+    g = eng._device_arrays
+    queries = synth_queries(graph, BATCH, seed=7)
+    enc = eng._encode(snap, queries, 0)
+    err, general = eng._classify(snap, enc[0], enc[2])
+    act = ~(err | general)
+    sched = fp.level_schedule(BATCH, eng.frontier, eng.arena, eng.max_depth)
+    s = fp.init_state(*enc, act, frontier=sched[0][0])
+    s["f_depth"] = jnp.minimum(s["f_depth"], len(sched))
+    for i in range(2):
+        f, a = sched[i]
+        nxt_f = sched[i + 1][0]
+        children, qf, qo, qd = fp.expand_phase(g, s, arena=a, max_width=100)
+        nxt, qo = fp.pack_phase(children, qf, qo, frontier=nxt_f,
+                                ns_dim=4, rel_dim=8)
+        s = dict(nxt, q_found=qf, q_over=qo, q_dirty=qd, q_subj=s["q_subj"])
+    s = jax.block_until_ready(jax.jit(lambda x: x)(s))
+    A = sched[2][1]
+    F = s["f_qid"].shape[0]
+    max_width = 100
+    print(f"level2 shape: F={F} A={A}")
+
+    def stage(upto):
+        NS, R = g["f_direct_ok"].shape
+        Kc = g["f_css_rel"].shape[2]
+        Kt = g["f_ttu_via"].shape[2]
+        Q = s["q_found"].shape[0]
+        qid, ns, obj, rel = s["f_qid"], s["f_ns"], s["f_obj"], s["f_rel"]
+        d, skip, force = s["f_depth"], s["f_skip"], s["f_force"]
+        q_found, q_over, q_subj = s["q_found"], s["q_over"], s["q_subj"]
+        qc = jnp.clip(qid, 0, Q - 1)
+        live = (qid >= 0) & ~q_found[qc]
+        subj = q_subj[qc]
+        nsc = jnp.clip(ns, 0, NS - 1)
+        relc = jnp.clip(rel, 0, R - 1)
+        cfg = (ns >= 0) & (ns < NS) & (rel >= 0) & (rel < R)
+        node = fp._node_lookup(g, ns, obj, rel)
+        dok = jnp.where(cfg, g["f_direct_ok"][nsc, relc], True) & ~skip
+        eok = jnp.where(cfg, g["f_expand_ok"][nsc, relc], True)
+        self_member = fp._member(g, node, subj)
+        found = live & self_member & ((dok & (d >= 2)) | force)
+        css_rel = jnp.where(cfg[:, None], g["f_css_rel"][nsc, relc], -1)
+        css_dec = g["f_css_dec"][nsc, relc]
+        css_probe = g["f_css_probe"][nsc, relc]
+        css_ok = live[:, None] & (css_rel >= 0) & (d[:, None] - css_dec >= 1)
+        for k in range(Kc):
+            cnode = fp._node_lookup(g, ns, obj, css_rel[:, k])
+            found = found | (css_ok[:, k] & css_probe[:, k]
+                             & fp._member(g, cnode, subj))
+        q_found2 = q_found.at[qc].max(found)
+        live2 = live & ~q_found2[qc]
+        exp_read = live2 & eok & (d >= 2)
+        exp_deg = jnp.where(exp_read, fp._row_deg(g, node), 0)
+        css_need = (css_ok & live2[:, None]
+                    & (d[:, None] - css_dec - 1 >= 1)).astype(jnp.int32)
+        ttu_via = jnp.where(cfg[:, None], g["f_ttu_via"][nsc, relc], -1)
+        ttu_tgt = g["f_ttu_tgt"][nsc, relc]
+        ttu_dec = g["f_ttu_dec"][nsc, relc]
+        ttu_ok = live2[:, None] & (ttu_via >= 0) & (d[:, None] - ttu_dec >= 2)
+        ttu_node_cols = []
+        ttu_deg_cols = []
+        for k in range(Kt):
+            tn = fp._node_lookup(g, ns, obj, ttu_via[:, k])
+            ttu_node_cols.append(tn)
+            ttu_deg_cols.append(jnp.where(ttu_ok[:, k], fp._row_deg(g, tn), 0))
+        ttu_nodes = jnp.stack(ttu_node_cols, axis=1)
+        seg_len = jnp.stack(
+            [exp_deg] + [css_need[:, k] for k in range(Kc)] + ttu_deg_cols,
+            axis=1)
+        seg_cum = jnp.cumsum(seg_len, axis=1)
+        counts = seg_cum[:, -1]
+        if upto == 1:
+            return q_found2, counts, ttu_nodes
+        offsets, _total, ap, ao = arena_assign(counts, A)
+        if upto == 2:
+            return q_found2, offsets, ap, ao
+        fits = offsets + counts <= A
+        q_over2 = q_over.at[qc].max(live2 & (counts > 0) & ~fits)
+        aps = jnp.clip(ap, 0, F - 1)
+        src_ok = (ap >= 0) & fits[aps]
+        cum_p = seg_cum[aps]
+        S = 1 + Kc + Kt
+        seg_idx = jnp.clip(
+            jnp.sum((ao[:, None] >= cum_p).astype(jnp.int32), axis=1), 0, S - 1)
+        prev_cum = jnp.where(
+            seg_idx > 0,
+            jnp.take_along_axis(
+                cum_p, jnp.clip(seg_idx - 1, 0, S - 1)[:, None], 1)[:, 0],
+            0)
+        off = ao - prev_cum
+        if upto == 3:
+            return q_found2, q_over2, seg_idx, off, src_ok
+        p_ns, p_obj, p_d = ns[aps], obj[aps], d[aps]
+        p_qid = qid[aps]
+        is_exp = src_ok & (seg_idx == 0)
+        is_css = src_ok & (seg_idx >= 1) & (seg_idx <= Kc)
+        css_k = jnp.clip(seg_idx - 1, 0, Kc - 1)
+        is_ttu = src_ok & (seg_idx > Kc)
+        ttu_k = jnp.clip(seg_idx - 1 - Kc, 0, Kt - 1)
+        rp = g["row_ptr"]
+        base_exp = rp[jnp.clip(node[aps], 0, rp.shape[0] - 2)]
+        ttu_node_p = jnp.take_along_axis(ttu_nodes[aps], ttu_k[:, None], 1)[:, 0]
+        base_ttu = rp[jnp.clip(ttu_node_p, 0, rp.shape[0] - 2)]
+        eidx = jnp.clip(
+            jnp.where(is_ttu, base_ttu, base_exp) + off, 0,
+            g["edge_ns"].shape[0] - 1)
+        if upto == 4:
+            return q_found2, q_over2, eidx, is_exp, is_css, p_qid, p_d
+        e_ns, e_obj, e_rel = (g["edge_ns"][eidx], g["edge_obj"][eidx],
+                              g["edge_rel"][eidx])
+        css_rel_p = jnp.take_along_axis(css_rel[aps], css_k[:, None], 1)[:, 0]
+        css_dec_p = jnp.take_along_axis(css_dec[aps], css_k[:, None], 1)[:, 0]
+        ttu_tgt_p = jnp.take_along_axis(ttu_tgt[aps], ttu_k[:, None], 1)[:, 0]
+        ttu_dec_p = jnp.take_along_axis(ttu_dec[aps], ttu_k[:, None], 1)[:, 0]
+        ch_ns = jnp.where(is_css, p_ns, e_ns)
+        ch_obj = jnp.where(is_css, p_obj, e_obj)
+        ch_rel = jnp.select([is_css, is_ttu], [css_rel_p, ttu_tgt_p], e_rel)
+        ch_d = jnp.select([is_css, is_ttu],
+                          [p_d - css_dec_p - 1, p_d - ttu_dec_p - 1], p_d - 1)
+        ch_skip = is_exp | is_css
+        ch_qid = jnp.where(src_ok, p_qid, -1)
+        p_exp_deg = exp_deg[aps]
+        trunc = is_exp & (p_exp_deg > max_width) & (off >= max_width - 1)
+        ch_force = is_exp
+        ch_d = jnp.where(trunc, 0, ch_d)
+        alive = src_ok & (is_exp | (ch_d >= 1))
+        alive = alive & ~q_found2[jnp.clip(ch_qid, 0, Q - 1)]
+        return (q_found2, q_over2, ch_ns, ch_obj, ch_rel, ch_d, ch_skip,
+                ch_qid, ch_force, alive)
+
+    prev = 0.0
+    for u in (1, 2, 3, 4, 5):
+        t = timeit(jax.jit(lambda u=u: stage(u)))
+        print(f"stage {u}: {t*1000:7.1f} ms  (delta {1000*(t-prev):+7.1f})")
+        prev = t
+
+    # pack: scatter-dedup half vs compaction half
+    children, qf, qo, qd = jax.block_until_ready(
+        jax.jit(lambda: fp.expand_phase(g, s, arena=A, max_width=100))())
+    t_pack = timeit(jax.jit(lambda: fp.pack_phase(
+        children, qf, qo, frontier=sched[3][0], ns_dim=4, rel_dim=8)))
+    print(f"pack total: {t_pack*1000:7.1f} ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -75,10 +75,12 @@ def main():
     for batch in (2048, 4096, 8192, 16384):
         packs = make_packs(batch, 5)
 
-        def run(p):
-            return fp.run_fast_packed(
+        def run(p, mults=None):
+            r, occ = fp.run_fast_packed(
                 g, p, frontier=eng.frontier, arena=eng.arena,
-                max_depth=eng.max_depth, max_width=eng.max_width)
+                max_depth=eng.max_depth, max_width=eng.max_width,
+                mults=mults)
+            return r
 
         jax.block_until_ready(run(packs[0]))  # compile
         ts = []
@@ -100,6 +102,32 @@ def main():
         t_all = time.perf_counter() - t0
         print(f"  4 batches pipelined: dispatch={t_disp*1000:7.1f} ms  "
               f"total={t_all*1000:8.1f} ms  ({4*batch/t_all:8.0f} checks/s)")
+
+        # demand-adaptive schedule: measure occupancy once, re-run sized
+        _, occ = fp.run_fast_packed(
+            g, packs[0], frontier=eng.frontier, arena=eng.arena,
+            max_depth=eng.max_depth, max_width=eng.max_width)
+        occ = np.asarray(occ).astype(np.float64)
+        ratio = occ / max(occ[0], 1)
+        mults = tuple(
+            [1] + [max(1, min(fp.F_MULT[min(l, len(fp.F_MULT)-1)],
+                              int(np.ceil(ratio[min(l, len(ratio)-1)] * 1.35))))
+                   for l in range(1, eng.max_depth)])
+        print(f"  occupancy ratios {np.round(ratio,2).tolist()} -> mults {mults}")
+        jax.block_until_ready(run(packs[0], mults))
+        ts = []
+        for p in packs[1:]:
+            t0 = time.perf_counter()
+            np.asarray(run(p, mults))
+            ts.append(time.perf_counter() - t0)
+        t2 = min(ts)
+        print(f"  adaptive fused: {t2*1000:8.1f} ms  ({batch/t2:8.0f} checks/s)")
+        t0 = time.perf_counter()
+        hs = [run(p, mults) for p in packs4]
+        _ = [np.asarray(h) for h in hs]
+        t_alla = time.perf_counter() - t0
+        print(f"  adaptive 4 pipelined: {t_alla*1000:8.1f} ms  "
+              f"({4*batch/t_alla:8.0f} checks/s)")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,144 @@
+"""ColumnarTupleStore: Manager-contract parity with the in-memory store,
+engine adoption, and post-bulk-load writes (VERDICT r2 #4 scale path)."""
+
+import numpy as np
+import pytest
+
+from ketotpu.api.types import RelationQuery, RelationTuple, SubjectID
+from ketotpu.engine.tpu import DeviceCheckEngine
+from ketotpu.storage.memory import InMemoryTupleStore
+from ketotpu.utils.synth import build_synth_columnar, synth_queries
+
+T = RelationTuple.from_string
+
+SMALL = dict(n_users=64, n_groups=8, n_folders=32, n_docs=128, seed=3)
+
+
+@pytest.fixture(scope="module")
+def graphs():
+    cg = build_synth_columnar(**SMALL)
+    mem = InMemoryTupleStore()
+    mem.write_relation_tuples(*cg.store.all_tuples())
+    return cg, mem
+
+
+def test_same_content_as_memory_store(graphs):
+    cg, mem = graphs
+    assert len(cg.store) == len(mem)
+    assert sorted(map(str, cg.store.all_tuples())) == sorted(
+        map(str, mem.all_tuples())
+    )
+
+
+def test_query_surface_parity(graphs):
+    cg, mem = graphs
+    queries = [
+        None,
+        RelationQuery(namespace="Folder"),
+        RelationQuery(namespace="Folder", relation="viewers"),
+        RelationQuery(namespace="Doc", object="d3", relation="parents"),
+        RelationQuery(namespace="Group", object="g0", relation="members"),
+        RelationQuery(subject_id="u3"),
+        RelationQuery(namespace="nope"),
+    ]
+    for q in queries:
+        a, _ = cg.store.get_relation_tuples(q, page_size=10_000)
+        b, _ = mem.get_relation_tuples(q, page_size=10_000)
+        assert sorted(map(str, a)) == sorted(map(str, b)), q
+        assert cg.store.exists_relation_tuples(q) == \
+            mem.exists_relation_tuples(q), q
+
+
+def test_pagination_walk(graphs):
+    cg, _ = graphs
+    q = RelationQuery(namespace="Doc")
+    seen, token = [], ""
+    for _ in range(10_000):
+        page, token = cg.store.get_relation_tuples(
+            q, page_token=token, page_size=7
+        )
+        seen.extend(page)
+        if not token:
+            break
+    full, _ = cg.store.get_relation_tuples(q, page_size=10_000)
+    assert list(map(str, seen)) == list(map(str, full))
+
+
+def test_engine_adoption_and_parity(graphs):
+    cg, mem = graphs
+    eng = DeviceCheckEngine(cg.store, cg.manager, frontier=1024, arena=4096)
+    eng.snapshot()
+    # the column mirror was adopted, not re-interned
+    assert eng._vocab is cg.store.vocab
+    from ketotpu.engine.oracle import CheckEngine
+
+    oracle = CheckEngine(mem, cg.manager)
+    queries = synth_queries(cg, 96, seed=4)
+    got = eng.batch_check(queries)
+    want = [oracle.check_is_member(q) for q in queries]
+    assert got == want
+
+
+def test_writes_and_deletes_after_bulk_load(graphs):
+    cg, _ = graphs
+    store = cg.store
+    eng = DeviceCheckEngine(store, cg.manager, frontier=1024, arena=4096)
+    eng.snapshot()
+    # new grant becomes visible (overlay path over adopted columns)
+    t = T("Doc:d1#viewers@newuser")
+    store.write_relation_tuples(t)
+    assert eng.check(T("Doc:d1#view@newuser")) is True
+    # deleting it revokes
+    store.delete_relation_tuples(t)
+    assert eng.check(T("Doc:d1#view@newuser")) is False
+    # deleting a BASE-segment row revokes too (direct doc viewer grant)
+    base_viewer = next(
+        x for x in store.all_tuples()
+        if x.namespace == "Doc" and x.relation == "viewers"
+    )
+    assert eng.check(
+        RelationTuple("Doc", base_viewer.object, "view", base_viewer.subject)
+    ) is True
+    store.delete_relation_tuples(base_viewer)
+    allowed = eng.check(
+        RelationTuple("Doc", base_viewer.object, "view", base_viewer.subject)
+    )
+    # direct grant gone; may still be allowed via the folder chain — the
+    # oracle on the live store is the arbiter
+    want = eng.oracle.check_is_member(
+        RelationTuple("Doc", base_viewer.object, "view", base_viewer.subject)
+    )
+    assert allowed == want
+    # the tuple is gone from reads
+    assert not store.exists_relation_tuples(
+        RelationQuery(
+            namespace="Doc", object=base_viewer.object, relation="viewers",
+        ).with_subject(base_viewer.subject)
+    )
+
+
+def test_delete_all_spans_base_and_tail(graphs):
+    cg, _ = graphs
+    store = cg.store
+    n_before = len(store)
+    store.write_relation_tuples(T("Doc:d2#viewers@tailuser"))
+    q = RelationQuery(namespace="Doc", object="d2", relation="viewers")
+    rows, _ = store.get_relation_tuples(q, page_size=1000)
+    deleted = store.delete_all_relation_tuples(q)
+    assert deleted == len(rows)
+    assert not store.exists_relation_tuples(q)
+    assert len(store) == n_before + 1 - deleted
+
+
+def test_change_log_covers_base_deletes(graphs):
+    cg, _ = graphs
+    store = cg.store
+    head0 = store.log_head
+    victim = next(
+        x for x in store.all_tuples()
+        if x.namespace == "Folder" and x.relation == "owners"
+    )
+    store.delete_relation_tuples(victim)
+    changes, head = store.changes_since(head0)
+    assert (-1, str(victim)) in [(op, str(t)) for op, t in changes]
+    assert head > head0

@@ -182,7 +182,7 @@ def test_extra_migrations_applied(tmp_path):
     )
     reg = Registry(_cfg(tmp_path), options=opts)
     store = reg.store()
-    assert store.migrate_up() == 4  # 3 built-ins + 1 embedder migration
+    assert store.migrate_up() == 5  # 4 built-ins + 1 embedder migration
     store._db.execute("INSERT INTO embedder_audit VALUES (1)")
     assert [v for v, s in store.migration_status() if s == "applied"][-1] \
         == "90000000000001_audit"
