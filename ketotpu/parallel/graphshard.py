@@ -69,30 +69,51 @@ def build_sharded_snapshot(
     manager: Optional[NamespaceManager],
     n_shards: int,
     vocab: Optional[Vocab] = None,
+    cols=None,
 ) -> Tuple[List[Snapshot], Dict[str, np.ndarray]]:
     """Partition the store by owner shard and build one snapshot per shard.
 
     All shards share one vocabulary (ids are global) and are padded to
     common array shapes, so the stacked dict (leading axis = shard) can be
     fed through `shard_map` with the graph partitioned on that axis.
+
+    Partitioning is a vectorized mask over the engine's column mirror
+    (``cols``, engine/delta.TupleColumns — passed by the mesh engine so a
+    rebuild reuses its freshly synced mirror; built here otherwise), not a
+    per-tuple Python loop: each shard's snapshot projects through the same
+    `build_snapshot_cols` numpy path as the single-chip engine.
     """
+    from ketotpu.engine import delta as dl
+
     vocab = vocab if vocab is not None else Vocab()
-    for t in store.all_tuples():
-        vocab.intern_tuple(t)
+    if cols is None:
+        exporter = getattr(store, "export_columns", None)
+        store_vocab = getattr(store, "vocab", None)
+        if exporter is not None and (
+            store_vocab is vocab or len(vocab.subjects) == 0
+        ):
+            carr, alive, tail, _head = exporter()
+            cols = dl.TupleColumns.from_arrays(store_vocab, carr, alive)
+            for t in tail:
+                cols.apply(1, t)
+            vocab = store_vocab
+        else:
+            cols = dl.TupleColumns(vocab)
+            for t in store.all_tuples():
+                cols.apply(1, t)
 
-    parts: List[List[RelationTuple]] = [[] for _ in range(n_shards)]
-    for t in store.all_tuples():
-        ns_id = vocab.namespaces.lookup(t.namespace)
-        obj_id = vocab.objects.lookup(t.object)
-        s = int(shard_of_np(np.array([ns_id]), np.array([obj_id]), n_shards)[0])
-        parts[s].append(t)
-
+    live = np.flatnonzero(cols.alive[: cols.n])
+    shard = shard_of_np(cols.ns[live], cols.obj[live], n_shards)
+    version = getattr(store, "version", -1)
     snaps: List[Snapshot] = []
-    for part in parts:
-        sub = InMemoryTupleStore()
-        if part:
-            sub.write_relation_tuples(*part)
-        snaps.append(build_snapshot(sub, manager, vocab))
+    for s in range(n_shards):
+        keep = np.zeros(cols.n, bool)
+        keep[live[shard == s]] = True
+        snaps.append(
+            dl.build_snapshot_cols(
+                cols.masked(keep), manager, version=version
+            )
+        )
 
     # pad every per-shard array to the maximum shape, then stack
     keys = snaps[0].arrays().keys()
@@ -206,6 +227,7 @@ def sharded_check(
         def local(g, q_ns, q_obj, q_rel, q_subj, q_depth, act):
             # P(axis) leaves a leading block dim of 1 on this shard's slice
             g = jax.tree_util.tree_map(lambda a: a[0], g)
+            NS, R = g["f_direct_ok"].shape
             me = jax.lax.axis_index(axis)
             mine = shard_of_device(q_ns, q_obj, n) == me
             s = fp._init_state(
@@ -213,7 +235,7 @@ def sharded_check(
                 frontier=frontier,
             )
             for _ in range(max_depth):
-                children, q_found, q_over, _ = fp.expand_phase(
+                children, q_found, q_over, q_dirty = fp.expand_phase(
                     g, s, arena=arena, max_width=max_width
                 )
                 children, q_over = _route(children, n, cap, q_over, axis)
@@ -222,13 +244,20 @@ def sharded_check(
                 q_found = (
                     jax.lax.psum(q_found.astype(jnp.int32), axis) > 0
                 )
-                nxt, q_over = pack = fp.pack_phase(
-                    children, q_found, q_over, frontier=frontier
+                # ns_dim/rel_dim unlock the linear hash-scatter dedup — the
+                # sort fallback was the dominant per-level cost on shards
+                nxt, q_over = fp.pack_phase(
+                    children, q_found, q_over, frontier=frontier,
+                    ns_dim=NS, rel_dim=R,
                 )
-                s = dict(nxt, q_found=q_found, q_over=q_over, q_subj=s["q_subj"])
+                s = dict(nxt, q_found=q_found, q_over=q_over,
+                         q_dirty=q_dirty, q_subj=s["q_subj"])
             q_found = jax.lax.psum(s["q_found"].astype(jnp.int32), axis) > 0
             q_over = jax.lax.psum(s["q_over"].astype(jnp.int32), axis) > 0
-            return q_found, q_over
+            # a dirty hit on ANY shard voids that query's device verdict
+            # (unless found: found-bits are overlay-exact and monotone)
+            q_dirty = jax.lax.psum(s["q_dirty"].astype(jnp.int32), axis) > 0
+            return q_found, q_over, q_dirty
 
         return jax.shard_map(
             local,
@@ -237,12 +266,12 @@ def sharded_check(
                 jax.tree_util.tree_map(lambda _: P(axis), g),
                 P(), P(), P(), P(), P(), P(),
             ),
-            out_specs=(P(), P()),
+            out_specs=(P(), P(), P()),
             check_vma=False,
         )(g, q_ns, q_obj, q_rel, q_subj, q_depth, act)
 
-    found, over = run(
+    found, over, dirty = run(
         stacked_g, q_ns, q_obj, q_rel, q_subj, q_depth, act,
         frontier=frontier, arena=arena, max_width=max_width, max_depth=max_depth,
     )
-    return fp.FastResult(found=found, over=over)
+    return fp.FastResult(found=found, over=over, dirty=dirty)

@@ -11,25 +11,30 @@ of replicating.
 
 Inherits the single-device engine's whole host surface (encode, classify,
 oracle fallback, expand, checkpointing of the base projection) and swaps
-only the fast-path dispatch.  Differences forced by sharding:
+the fast-path dispatch.  Sharded differences:
 
-* the delta overlay is disabled (``max_overlay_pairs = 0``): overlay
-  tables are built for the replicated layout, so every write amortizes
-  through a full rebuild instead — writes are the rare path at the scale
-  a mesh serves (SURVEY §7 step 8's snapshot-oriented design);
+* **writes ride per-shard delta overlays**: each change routes to its
+  owner shard (same (ns, obj) hash as the partitioning) and folds into
+  that shard's OverlayState against that shard's snapshot — node ids in
+  overlay tables are shard-local, so one replicated overlay cannot work.
+  EMPTY overlay tables ship with the base stacks so the shard_map
+  program's pytree never changes shape when writes land; a write
+  re-ships only the (small, fixed-shape) overlay stacks.  Probe verdicts
+  stay overlay-exact; queries that touch a dirty CSR row on ANY shard
+  come back ``dirty`` (psum-merged) and fall back to the host oracle.
+* **overflow retries on-device** at ``retry_scale``x frontier/arena
+  before falling back — same two-tier story as the single-chip engine.
 * AND/NOT-reachable ("general") queries go straight to the host oracle —
-  the task-tree interpreter is single-device;
-* the overflow tail falls back to the oracle without a device retry tier
-  (capacity on a mesh is per-shard; a retry would need a second stacked
-  projection at wider caps for a few queries).
+  the task-tree interpreter is single-device.
 """
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import List, Optional
 
 import numpy as np
 
+from ketotpu.engine import delta as dl
 from ketotpu.engine.tpu import DeviceCheckEngine, _bucket
 from ketotpu.parallel import graphshard
 from ketotpu.parallel.mesh import make_mesh
@@ -60,26 +65,113 @@ class MeshCheckEngine(DeviceCheckEngine):
         self.mesh_axis = mesh_axis
         self.n_shards = mesh_devices
         self._stacked = None
-        # overlay tables target the replicated layout; sharded serving
-        # amortizes writes through full rebuilds instead
-        self.max_overlay_pairs = 0
-        self.max_overlay_dirty = 0
+        self._stacked_base = None
+        self._shard_snaps: Optional[List] = None
+        self._shard_overlays: Optional[List[dl.OverlayState]] = None
+        # per-shard overlay table capacity; totals still bound by
+        # max_overlay_pairs/max_overlay_dirty like the single-chip engine
+        self.shard_pair_cap = max(self.max_overlay_pairs // mesh_devices, 256)
 
     def _install_device_arrays(self) -> None:
-        """Ship the SHARDED stacks; the replicated copy (only batch_expand
-        reads it) is built lazily so device 0 doesn't hold the whole graph
-        next to its shard."""
+        """Ship the SHARDED stacks (base + EMPTY overlays); the replicated
+        copy (only batch_expand reads it) is built lazily so device 0
+        doesn't hold the whole graph next to its shard."""
         self._base_device = None
         self._device_arrays = None
-        _, self._stacked = graphshard.build_sharded_snapshot(
-            self.store, self.namespace_manager, self.n_shards, self._vocab
+        self._shard_snaps, self._stacked_base = (
+            graphshard.build_sharded_snapshot(
+                self.store, self.namespace_manager, self.n_shards,
+                self._vocab, cols=self._cols,
+            )
         )
+        # overlay admission checks relation-level pairs against dyn_pairs;
+        # a shard's own slice sees only a subset of the graph's pairs, so
+        # a write whose pair lives on other shards would spuriously
+        # reject -> full reshard.  Taint classification runs on the
+        # replicated snapshot anyway, so sharing the GLOBAL pair set is
+        # exact and strictly reduces resharding.
+        if self._snap is not None:
+            for sn in self._shard_snaps:
+                sn.dyn_pairs = self._snap.dyn_pairs
+        self._shard_overlays = [
+            dl.OverlayState() for _ in range(self.n_shards)
+        ]
+        self._stacked = dict(
+            self._stacked_base, **self._overlay_stacks()
+        )
+
+    def _overlay_stacks(self):
+        """Per-shard overlay arrays, padded to common shapes and stacked
+        (leading axis = shard).  Fixed shapes per rebuild: om_/ovt_ tables
+        by ``shard_pair_cap``, ov_dirty by the max shard node count."""
+        ovs = [
+            dl.overlay_arrays(o, sn, pair_cap=self.shard_pair_cap)
+            for o, sn in zip(self._shard_overlays, self._shard_snaps)
+        ]
+        out = {}
+        for k in ovs[0]:
+            arrs = [np.asarray(ov[k]) for ov in ovs]
+            if arrs[0].ndim == 0:
+                out[k] = np.stack(arrs)
+                continue
+            m = max(a.shape[0] for a in arrs)
+            m = _bucket(m, 64) if k == "ov_dirty" else m
+            arrs = [
+                np.pad(a, [(0, m - a.shape[0])] + [(0, 0)] * (a.ndim - 1))
+                for a in arrs
+            ]
+            out[k] = np.stack(arrs)
+        return out
+
+    def _overlay_apply(self, changes) -> bool:
+        """Route each change to its owner shard's overlay (the same
+        (ns, obj) hash that partitioned the graph) and re-ship only the
+        overlay stacks.  False => full rebuild (re-partition).
+
+        The replicated overlay state (self._overlay) is mirrored first:
+        batch_expand's host-side delta merge reads it against the
+        replicated snapshot (expand_device.OverlayMembers), and its node
+        ids are replicated-snapshot ids — the shard overlays' ids are
+        shard-local and useless to expand."""
+        if self._shard_snaps is None:
+            return False
+        try:
+            dl.apply_changes(self._overlay, self._snap, self._vocab, changes)
+        except dl.OverlayRejected:
+            return False
+        try:
+            for op_, t in changes:
+                ns = self._vocab.namespaces.lookup(t.namespace)
+                obj = self._vocab.objects.lookup(t.object)
+                if ns < 0 or obj < 0:
+                    return False  # ids not even interned: rebuild
+                s = int(graphshard.shard_of_np(
+                    np.array([ns]), np.array([obj]), self.n_shards
+                )[0])
+                dl.apply_changes(
+                    self._shard_overlays[s], self._shard_snaps[s],
+                    self._vocab, [(op_, t)],
+                )
+        except dl.OverlayRejected:
+            return False
+        pairs = sum(o.size()[0] for o in self._shard_overlays)
+        dirty = sum(o.size()[1] for o in self._shard_overlays)
+        if pairs > self.max_overlay_pairs or dirty > self.max_overlay_dirty:
+            return False
+        if any(
+            o.size()[0] > self.shard_pair_cap for o in self._shard_overlays
+        ):
+            return False  # one shard's fixed-shape table would overflow
+        try:
+            stacks = self._overlay_stacks()
+        except ValueError:
+            return False
+        self._stacked = dict(self._stacked_base, **stacks)
+        return True
 
     def _expand_arrays(self):
         if self._device_arrays is None:
             import jax
-
-            from ketotpu.engine import delta as dl
 
             self._base_device = jax.device_put(self._snap.arrays())
             self._device_arrays = dict(
@@ -87,11 +179,24 @@ class MeshCheckEngine(DeviceCheckEngine):
                 **jax.device_put(
                     dl.overlay_arrays(
                         self._overlay, self._snap,
-                        pair_cap=self.max_overlay_pairs,
+                        pair_cap=max(self.max_overlay_pairs, 1),
                     )
                 ),
             )
         return self._device_arrays
+
+    def _sharded_run(self, stacked, padded, active, boost: int = 1):
+        return graphshard.sharded_check(
+            stacked,
+            padded,
+            self.mesh,
+            axis=self.mesh_axis,
+            frontier=boost * self.frontier,
+            arena=boost * self.arena,
+            max_depth=self.max_depth,
+            max_width=self.max_width,
+            active=active,
+        )
 
     def _dispatch(self, queries, rest_depth: int):
         n = len(queries)
@@ -105,30 +210,43 @@ class MeshCheckEngine(DeviceCheckEngine):
         qpad = min(_bucket(n), self.frontier)
         padded = self._pad(enc, n, qpad)
         active = np.pad(~(err | general), (0, qpad - n))
-        res = graphshard.sharded_check(
-            stacked,
-            padded,
-            self.mesh,
-            axis=self.mesh_axis,
-            frontier=self.frontier,
-            arena=self.arena,
-            max_depth=self.max_depth,
-            max_width=self.max_width,
-            active=active,
-        )
+        res = self._sharded_run(stacked, padded, active)
         # general queries are oracle work on this engine (see module doc)
-        return (enc, err | general, res)
+        return (enc, err | general, res, stacked)
 
     def _collect(self, handle, retry: bool = True):
-        enc, fallback_mask, res = handle
+        enc, fallback_mask, res, stacked = handle
         n = fallback_mask.shape[0]
         allowed = np.zeros(n, bool)
         fallback = fallback_mask.copy()
         found = np.asarray(res.found)[:n]
         over = np.asarray(res.over)[:n]
+        dirty = (
+            np.asarray(res.dirty)[:n]
+            if res.dirty is not None else np.zeros(n, bool)
+        )
         fmask = ~fallback_mask
         allowed[fmask] = found[fmask]
-        # found is monotone: overflow voids only not-yet-found queries
-        fallback |= fmask & over & ~found
+        # found is monotone and overlay-exact: a dirty/overflow brush only
+        # voids not-yet-found queries
+        fallback |= fmask & dirty & ~found
+        unres = fmask & over & ~found & ~dirty
+        if retry and unres.any() and self.retry_scale > 1:
+            ri = np.flatnonzero(unres)
+            rpad = min(_bucket(len(ri), 256), self.frontier)
+            renc = self._pad(tuple(a[ri] for a in enc), len(ri), rpad)
+            self.retries += len(ri)
+            ract = np.pad(np.ones(len(ri), bool), (0, rpad - len(ri)))
+            rres = self._sharded_run(
+                stacked, renc, ract, boost=self.retry_scale
+            )
+            rfound = np.asarray(rres.found)[: len(ri)]
+            rover = np.asarray(rres.over)[: len(ri)]
+            rdirty = (
+                np.asarray(rres.dirty)[: len(ri)]
+                if rres.dirty is not None else np.zeros(len(ri), bool)
+            )
+            allowed[ri] = rfound
+            unres[ri] = (rover | rdirty) & ~rfound
+        fallback |= unres
         return allowed, fallback
-
