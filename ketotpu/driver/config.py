@@ -55,11 +55,36 @@ def _deep_merge(base: Dict, extra: Dict) -> Dict:
     return out
 
 
+def _cors_defaults() -> Dict[str, Any]:
+    # mirrors the reference's per-port cors block
+    # (embedx/config.schema.json:214-259, rs/cors option names)
+    return {
+        "enabled": False,
+        "allowed_origins": ["*"],
+        "allowed_methods": ["GET", "POST", "PUT", "PATCH", "DELETE"],
+        "allowed_headers": ["Authorization", "Content-Type"],
+        "exposed_headers": ["Content-Type"],
+        "allow_credentials": False,
+        "max_age": 0,
+        "debug": False,
+    }
+
+
 def _defaults() -> Dict[str, Any]:
     return {
         "dsn": "memory",
         "serve": {
-            name: {"host": "127.0.0.1", "port": port}
+            name: {
+                "host": "127.0.0.1",
+                "port": port,
+                "cors": _cors_defaults(),
+                # reference embedx/config.schema.json:260-296: cert/key as
+                # file path or inline base64 PEM; empty = plaintext port
+                "tls": {
+                    "cert": {"path": "", "base64": ""},
+                    "key": {"path": "", "base64": ""},
+                },
+            }
             for name, port in DEFAULT_PORTS.items()
         },
         "limit": {"max_read_depth": 5, "max_read_width": 100},
@@ -218,6 +243,63 @@ class Provider:
         """The polymorphic namespaces value (provider.go:311-342):
         list of namespace dicts | {"location": file-or-uri} | URI string."""
         return self.get("namespaces")
+
+    def cors_config(self, endpoint: str) -> Optional[Dict[str, Any]]:
+        """The endpoint's CORS settings, or None when disabled
+        (reference `CORS(iface)`, provider.go analog)."""
+        cfg = self.get(f"serve.{endpoint}.cors")
+        if not isinstance(cfg, dict) or not cfg.get("enabled"):
+            return None
+        return _deep_merge(_cors_defaults(), cfg)
+
+    def tls_config(self, endpoint: str) -> Optional[Dict[str, str]]:
+        """{"cert": <pem-path>, "key": <pem-path>} when the endpoint is
+        TLS-terminated, else None.  base64 variants are decoded ONCE per
+        Provider to private temp files (ssl wants file paths), reused on
+        later calls, and unlinked at interpreter exit."""
+        cached = getattr(self, "_tls_paths", None)
+        if cached is None:
+            cached = self._tls_paths = {}
+        if endpoint in cached:
+            return cached[endpoint]
+        tls = self.get(f"serve.{endpoint}.tls") or {}
+        out = {}
+        for part in ("cert", "key"):
+            spec = tls.get(part) or {}
+            path = str(spec.get("path") or "")
+            b64 = str(spec.get("base64") or "")
+            if path:
+                out[part] = path
+            elif b64:
+                import atexit
+                import base64 as b64mod
+                import tempfile
+
+                f = tempfile.NamedTemporaryFile(
+                    "wb", suffix=f".{part}.pem", delete=False
+                )
+                f.write(b64mod.b64decode(b64))
+                f.close()
+                os.chmod(f.name, 0o600)
+
+                def _rm(p=f.name):
+                    try:
+                        os.unlink(p)
+                    except OSError:
+                        pass
+
+                atexit.register(_rm)
+                out[part] = f.name
+        if not out:
+            cached[endpoint] = None
+            return None
+        if len(out) != 2:
+            raise ConfigError(
+                f"serve.{endpoint}.tls",
+                "both cert and key must be configured (or neither)",
+            )
+        cached[endpoint] = out
+        return out
 
     # -- validation ---------------------------------------------------------
 

@@ -24,6 +24,7 @@ period (daemon.go:109-135).
 from __future__ import annotations
 
 import socket
+import ssl
 import threading
 import time
 from concurrent import futures
@@ -92,10 +93,17 @@ def _pump(src: socket.socket, dst: socket.socket) -> None:
 
 
 class _Mux(threading.Thread):
-    """One public port: sniff the preface, splice to gRPC or REST backend."""
+    """One public port: sniff the preface, splice to gRPC or REST backend.
+
+    With ``ssl_ctx`` set, the public listener terminates TLS (the
+    reference's per-port `serve.<iface>.tls`, embedx/config.schema.json:
+    260-296): the handshake runs before protocol sniffing and the
+    localhost backends stay plaintext.  The context advertises ALPN
+    h2 + http/1.1 so gRPC clients negotiate HTTP/2."""
 
     def __init__(self, host: str, port: int, grpc_addr: Tuple[str, int],
-                 rest_addr: Tuple[str, int], logger):
+                 rest_addr: Tuple[str, int], logger,
+                 ssl_ctx: Optional[ssl.SSLContext] = None):
         super().__init__(daemon=True)
         self.listener = socket.create_server(
             (host, port), reuse_port=False, backlog=128
@@ -104,6 +112,7 @@ class _Mux(threading.Thread):
         self.grpc_addr = grpc_addr
         self.rest_addr = rest_addr
         self.logger = logger
+        self.ssl_ctx = ssl_ctx
         self._closing = threading.Event()
 
     def run(self) -> None:
@@ -119,27 +128,27 @@ class _Mux(threading.Thread):
     def _splice(self, conn: socket.socket) -> None:
         try:
             conn.settimeout(10.0)
-            # cmux buffers until it can match; a fragmented preface may
-            # deliver fewer than 4 bytes first, so peek until decidable.
-            # MSG_PEEK returns immediately once any bytes exist, hence the
-            # tiny sleep between re-peeks of a still-matching partial head.
-            deadline = time.monotonic() + 10.0
-            while True:
-                head = conn.recv(len(_H2_PREFACE), socket.MSG_PEEK)
-                if (
-                    not head
-                    or len(head) >= 4
-                    or head != _H2_PREFACE[: len(head)]
-                    or time.monotonic() > deadline
-                ):
+            if self.ssl_ctx is not None:
+                conn = self.ssl_ctx.wrap_socket(conn, server_side=True)
+            # cmux buffers until it can match.  READ (not MSG_PEEK — TLS
+            # sockets cannot peek) until the protocol is decidable; the
+            # sniffed bytes are forwarded to the chosen backend below.
+            head = b""
+            while (
+                len(head) < 4 and head == _H2_PREFACE[: len(head)]
+            ):
+                chunk = conn.recv(len(_H2_PREFACE) - len(head))
+                if not chunk:
                     break
-                time.sleep(0.005)
+                head += chunk
             conn.settimeout(None)
             target = (
                 self.grpc_addr if head.startswith(b"PRI ") else self.rest_addr
             )
             backend = socket.create_connection(target)
-        except OSError as e:
+            if head:
+                backend.sendall(head)
+        except (OSError, ssl.SSLError) as e:
             self.logger.debug("mux splice failed: %s", e)
             conn.close()
             return
@@ -184,6 +193,20 @@ class Server:
         server.start()
         self._grpc_servers.append(server)
         return ("127.0.0.1", port)
+
+    def _ssl_context(self, endpoint: str) -> Optional[ssl.SSLContext]:
+        """TLS context from serve.<endpoint>.tls, or None (plaintext)."""
+        get = getattr(self.registry.config, "tls_config", None)
+        tls = get(endpoint) if get else None
+        if not tls:
+            return None
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(tls["cert"], tls["key"])
+        try:
+            ctx.set_alpn_protocols(["h2", "http/1.1"])
+        except NotImplementedError:  # pragma: no cover - platform quirk
+            pass
+        return ctx
 
     def _rest_backend(self, router: rest.Router) -> Tuple[str, int]:
         httpd = rest.make_http_server(router, "127.0.0.1", 0)
@@ -236,18 +259,31 @@ class Server:
             host, port = r.config.listen_on(name)
             grpc_addr = self._grpc_backend(services)
             rest_addr = self._rest_backend(router)
-            mux = _Mux(host, port, grpc_addr, rest_addr, self.logger)
+            ctx = self._ssl_context(name)
+            mux = _Mux(host, port, grpc_addr, rest_addr, self.logger,
+                       ssl_ctx=ctx)
             mux.start()
             self._muxes.append(mux)
             self.addresses[name] = mux.addr
             self.logger.info(
-                "serving %s on %s:%d (gRPC+REST multiplexed)",
-                name, *mux.addr,
+                "serving %s on %s:%d (gRPC+REST multiplexed%s)",
+                name, *mux.addr, ", TLS" if ctx else "",
             )
 
         # metrics: plain HTTP, no gRPC, no mux (daemon.go:189-228)
         host, port = r.config.listen_on("metrics")
         httpd = rest.make_http_server(rest.metrics_router(r), host, port)
+        ctx = self._ssl_context("metrics")
+        if ctx is not None:
+            # deferred handshake: with do_handshake_on_connect the TLS
+            # handshake would run inside accept() on the serve_forever
+            # thread, so one stalled client blocks every scrape; deferring
+            # moves it into the per-connection handler thread, which also
+            # gets a read timeout
+            httpd.socket = ctx.wrap_socket(
+                httpd.socket, server_side=True,
+                do_handshake_on_connect=False,
+            )
         self._http_servers.append(httpd)
         t = threading.Thread(target=httpd.serve_forever, daemon=True)
         t.start()

@@ -87,12 +87,51 @@ def _max_depth(q: Dict[str, str]) -> int:
         ) from None
 
 
+def cors_headers(
+    cors: Dict, origin: Optional[str], *,
+    request_method: Optional[str] = None, preflight: bool = False,
+) -> Optional[Dict[str, str]]:
+    """rs/cors-shaped decision (the reference wires rs/cors per port,
+    `internal/driver/daemon.go:230-265` + `embedx/config.schema.json:
+    214-259`): response headers for an allowed origin, None otherwise."""
+    import fnmatch
+
+    if not cors or origin is None:
+        return None
+    allowed = any(
+        o == "*" or fnmatch.fnmatch(origin, o)
+        for o in cors["allowed_origins"]
+    )
+    if not allowed:
+        return None
+    h = {"Vary": "Origin"}
+    wildcard = "*" in cors["allowed_origins"] and not cors["allow_credentials"]
+    h["Access-Control-Allow-Origin"] = "*" if wildcard else origin
+    if cors["allow_credentials"]:
+        h["Access-Control-Allow-Credentials"] = "true"
+    if preflight:
+        methods = [m.upper() for m in cors["allowed_methods"]]
+        if request_method and request_method.upper() not in methods:
+            return None
+        h["Access-Control-Allow-Methods"] = ", ".join(methods)
+        h["Access-Control-Allow-Headers"] = ", ".join(cors["allowed_headers"])
+        if cors.get("max_age"):
+            h["Access-Control-Max-Age"] = str(cors["max_age"])
+    elif cors.get("exposed_headers"):
+        h["Access-Control-Expose-Headers"] = ", ".join(
+            cors["exposed_headers"]
+        )
+    return h
+
+
 class Router:
     """Method+path exact-match routing table shared by all ports."""
 
     def __init__(self, registry, endpoint: str):
         self.r = registry
         self.endpoint = endpoint
+        cors_for = getattr(registry.config, "cors_config", None)
+        self.cors = cors_for(endpoint) if cors_for else None
         self.routes: Dict[Tuple[str, str], Callable] = {}
         self._register_common()
 
@@ -375,6 +414,11 @@ def make_http_server(router: Router, host: str, port: int) -> ThreadingHTTPServe
 
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
+        # per-connection read timeout (socketserver applies it in the
+        # handler thread): bounds a stalled client — including a deferred
+        # TLS handshake on the metrics port — to one worker thread for at
+        # most this long, never the accept loop
+        timeout = 30.0
 
         def _serve(self, method: str):
             t0 = time.perf_counter()
@@ -400,6 +444,11 @@ def make_http_server(router: Router, host: str, port: int) -> ThreadingHTTPServe
             self.send_header("Content-Length", str(len(data)))
             for k, v in extra.items():
                 self.send_header(k, v)
+            if router.cors:
+                for k, v in (cors_headers(
+                    router.cors, hdrs.get("origin")
+                ) or {}).items():
+                    self.send_header(k, v)
             self.end_headers()
             if data:
                 self.wfile.write(data)
@@ -414,6 +463,19 @@ def make_http_server(router: Router, host: str, port: int) -> ThreadingHTTPServe
                 logger.debug(
                     "%s %s -> %d (%.1fms)", method, parsed.path, status, dt * 1e3
                 )
+
+        def do_OPTIONS(self):
+            # CORS preflight (rs/cors handles OPTIONS before routing)
+            origin = self.headers.get("Origin")
+            want = self.headers.get("Access-Control-Request-Method")
+            hs = cors_headers(
+                router.cors, origin, request_method=want, preflight=True
+            ) if router.cors else None
+            self.send_response(204 if hs else 405)
+            for k, v in (hs or {}).items():
+                self.send_header(k, v)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
 
         def do_GET(self):
             self._serve("GET")

@@ -1,0 +1,151 @@
+"""TLS termination + CORS middleware e2e (VERDICT r2 missing #1).
+
+Boots the real daemon with `serve.read.tls.{cert,key}` pointing at the
+self-signed fixture and CORS enabled, then exercises both protocols of
+the multiplexed port over TLS and the preflight/response header rules.
+"""
+
+import json
+import ssl
+import urllib.request
+
+import grpc
+import pytest
+
+from ketotpu.api.types import RelationTuple
+from ketotpu.driver import ConfigError, Provider, Registry
+from ketotpu.server import serve_all
+
+FIXDIR = __file__.rsplit("/", 1)[0] + "/fixtures/tls"
+CERT = f"{FIXDIR}/cert.pem"
+KEY = f"{FIXDIR}/key.pem"
+
+
+@pytest.fixture(scope="module")
+def tls_server():
+    cfg = Provider(
+        {
+            "serve": {
+                **{
+                    n: {"host": "127.0.0.1", "port": 0}
+                    for n in ("write", "metrics", "opl")
+                },
+                "read": {
+                    "host": "127.0.0.1",
+                    "port": 0,
+                    "tls": {
+                        "cert": {"path": CERT},
+                        "key": {"path": KEY},
+                    },
+                    "cors": {
+                        "enabled": True,
+                        "allowed_origins": ["https://app.example.com"],
+                        "allowed_methods": ["GET"],
+                        "max_age": 60,
+                    },
+                },
+            },
+            "namespaces": [{"name": "d"}],
+            "engine": {"kind": "tpu", "frontier": 256, "arena": 1024,
+                       "max_batch": 64},
+        }
+    )
+    reg = Registry(cfg).init()
+    reg.store().write_relation_tuples(RelationTuple.from_string("d:o#r@alice"))
+    # compile the engine's check shapes BEFORE clients with timeouts connect
+    reg.check_engine().check(RelationTuple.from_string("d:o#r@alice"))
+    srv = serve_all(reg)
+    yield srv
+    srv.stop()
+
+
+def _client_ctx():
+    ctx = ssl.create_default_context(cafile=CERT)
+    ctx.check_hostname = False
+    return ctx
+
+
+def _get(url, headers=None, method="GET"):
+    req = urllib.request.Request(url, headers=headers or {}, method=method)
+    return urllib.request.urlopen(req, context=_client_ctx(), timeout=60)
+
+
+def test_rest_over_tls(tls_server):
+    host, port = tls_server.addresses["read"]
+    resp = _get(
+        f"https://{host}:{port}/relation-tuples/check/openapi"
+        "?namespace=d&object=o&relation=r&subject_id=alice"
+    )
+    assert resp.status == 200
+    assert json.loads(resp.read())["allowed"] is True
+
+
+def test_grpc_over_tls(tls_server):
+    from ketotpu.proto import check_service_pb2 as cs
+    from ketotpu.proto import relation_tuples_pb2 as rts
+    from ketotpu.proto.services import CheckServiceStub
+
+    host, port = tls_server.addresses["read"]
+    creds = grpc.ssl_channel_credentials(open(CERT, "rb").read())
+    # fixture CN/SAN is localhost; override so 127.0.0.1 verifies
+    with grpc.secure_channel(
+        f"{host}:{port}", creds,
+        options=[("grpc.ssl_target_name_override", "localhost")],
+    ) as ch:
+        resp = CheckServiceStub(ch).Check(
+            cs.CheckRequest(
+                tuple=rts.RelationTuple(
+                    namespace="d", object="o", relation="r",
+                    subject=rts.Subject(id="alice"),
+                )
+            ),
+            timeout=20,
+        )
+    assert resp.allowed is True
+
+
+def test_cors_headers_on_response(tls_server):
+    host, port = tls_server.addresses["read"]
+    resp = _get(
+        f"https://{host}:{port}/health/alive",
+        headers={"Origin": "https://app.example.com"},
+    )
+    assert resp.headers["Access-Control-Allow-Origin"] == \
+        "https://app.example.com"
+    # disallowed origin: no CORS headers
+    resp = _get(
+        f"https://{host}:{port}/health/alive",
+        headers={"Origin": "https://evil.example.net"},
+    )
+    assert resp.headers.get("Access-Control-Allow-Origin") is None
+
+
+def test_cors_preflight(tls_server):
+    host, port = tls_server.addresses["read"]
+    resp = _get(
+        f"https://{host}:{port}/relation-tuples/check",
+        headers={
+            "Origin": "https://app.example.com",
+            "Access-Control-Request-Method": "GET",
+        },
+        method="OPTIONS",
+    )
+    assert resp.status == 204
+    assert "GET" in resp.headers["Access-Control-Allow-Methods"]
+    assert resp.headers["Access-Control-Max-Age"] == "60"
+
+
+def test_tls_requires_both_halves():
+    cfg = Provider({
+        "serve": {"read": {"tls": {"cert": {"path": CERT}}}},
+    })
+    with pytest.raises(ConfigError):
+        cfg.tls_config("read")
+
+
+def test_plaintext_ports_unaffected(tls_server):
+    host, port = tls_server.addresses["write"]
+    resp = urllib.request.urlopen(
+        f"http://{host}:{port}/health/alive", timeout=10
+    )
+    assert resp.status == 200
