@@ -21,11 +21,22 @@ class ReverseStore:
 
     This is the shareable handle: every mapper given the same ReverseStore
     synchronizes on the same lock (the analog of one keto_uuid_mappings
-    table shared by all connections)."""
+    table shared by all connections).  Durable backends implement the same
+    two-method surface (storage/sqlite.SQLiteReverseStore persists the
+    reference's keto_uuid_mappings table, uuid_mapping.go:35-74)."""
 
     def __init__(self):
         self.lock = threading.Lock()
         self.data: dict = {}
+
+    def put(self, u: uuid.UUID, value: str) -> None:
+        """INSERT ... ON CONFLICT DO NOTHING semantics."""
+        with self.lock:
+            self.data.setdefault(u, value)
+
+    def get(self, u: uuid.UUID) -> Optional[str]:
+        with self.lock:
+            return self.data.get(u)
 
 
 _SHARED_REVERSE: dict = {}
@@ -71,16 +82,14 @@ class UUIDMapper:
     def to_uuid(self, value: str) -> uuid.UUID:
         u = uuid.uuid5(self.network_id, value)
         if not self.read_only:
-            with self._store.lock:
-                self._store.data.setdefault(u, value)
+            self._store.put(u, value)
         return u
 
     def to_uuids(self, values: Iterable[str]) -> list:
         return [self.to_uuid(v) for v in values]
 
     def from_uuid(self, u: uuid.UUID) -> Optional[str]:
-        with self._store.lock:
-            return self._store.data.get(u)
+        return self._store.get(u)
 
     def from_uuids(self, uuids: Iterable[uuid.UUID]) -> list:
         return [self.from_uuid(u) for u in uuids]

@@ -307,9 +307,21 @@ class Registry:
     def uuid_mapper(self, read_only: bool = False) -> UUIDMapper:
         with self._lock:
             if self._uuid_mapper is None:
-                self._uuid_mapper = UUIDMapper(self.network_id)
+                # durable stores expose a persistent reverse store
+                # (keto_uuid_mappings, sqlite.py); otherwise the
+                # process-wide per-network ReverseStore is used
+                maker = getattr(self.store(), "uuid_reverse_store", None)
+                self._uuid_mapper = UUIDMapper(
+                    self.network_id,
+                    reverse_store=maker() if maker is not None else None,
+                )
             if read_only:
-                return UUIDMapper(self.network_id, read_only=True)
+                # shares the writable mapper's reverse store: read-only
+                # skips writes but must resolve what others persisted
+                return UUIDMapper(
+                    self.network_id, read_only=True,
+                    reverse_store=self._uuid_mapper._store,
+                )
             return self._uuid_mapper
 
     def mapper(self) -> Mapper:

@@ -166,6 +166,13 @@ class CheckHandler:
             r = self.r.resolve(_md(context))
             src = request.tuple if request.HasField("tuple") else request
             tuple_ = tuple_from_proto(src)
+            if getattr(request, "latest", False):
+                # CheckRequest.latest (check_service.proto:60-66): evaluate
+                # against the freshest possible state.  The device engine
+                # re-projects; the oracle engine reads live anyway.
+                refresh = getattr(r.check_engine(), "refresh", None)
+                if refresh is not None:
+                    refresh()
             allowed = self.check_core(tuple_, int(request.max_depth), r)
             return check_service_pb2.CheckResponse(
                 allowed=allowed, snaptoken=self.snaptoken(r)

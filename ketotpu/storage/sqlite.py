@@ -107,7 +107,60 @@ MIGRATIONS: List[Tuple[str, List[str], List[str]]] = [
         ],
         ["DROP TABLE keto_meta"],
     ),
+    (
+        # the reference persists every string->UUIDv5 mapping so UUID-keyed
+        # reverse lookups survive restart (persistence/sql/uuid_mapping.go:
+        # 35-74, migration 20220513200300000001); same two columns here.
+        # No nid column: the UUIDv5 is already namespaced by network id.
+        "20240101000004_uuid_mappings",
+        [
+            """CREATE TABLE keto_uuid_mappings (
+                id TEXT PRIMARY KEY,
+                string_representation TEXT NOT NULL
+            )""",
+        ],
+        ["DROP TABLE keto_uuid_mappings"],
+    ),
 ]
+
+
+class SQLiteReverseStore:
+    """Durable ReverseStore (api/uuid_map.py surface) over the store's
+    keto_uuid_mappings table, with a bounded write-through cache so the
+    hot mapping path rarely touches SQL."""
+
+    CACHE_CAP = 65536
+
+    def __init__(self, store: "SQLiteTupleStore"):
+        self._s = store
+        self._cache: dict = {}
+        self._cache_lock = threading.Lock()
+
+    def put(self, u, value: str) -> None:
+        with self._cache_lock:
+            if u in self._cache:
+                return  # already persisted by us
+            if len(self._cache) >= self.CACHE_CAP:
+                self._cache.clear()  # reads fall back to the table
+            self._cache[u] = value
+        with self._s._lock:
+            self._s._db.execute(
+                "INSERT OR IGNORE INTO keto_uuid_mappings VALUES (?, ?)",
+                (str(u), value),
+            )
+
+    def get(self, u):
+        with self._cache_lock:
+            v = self._cache.get(u)
+        if v is not None:
+            return v
+        with self._s._lock:
+            row = self._s._db.execute(
+                "SELECT string_representation FROM keto_uuid_mappings"
+                " WHERE id = ?",
+                (str(u),),
+            ).fetchone()
+        return row[0] if row else None
 
 
 class SQLiteTupleStore:
@@ -524,6 +577,13 @@ class SQLiteTupleStore:
                     (self.nid, cursor, head),
                 ).fetchall()
         return [(r[0], self._decode(r[1:])) for r in rows], head
+
+    def uuid_reverse_store(self) -> SQLiteReverseStore:
+        """Durable reverse UUID mappings sharing this store's connection —
+        the registry hands this to UUIDMapper so reverse lookups survive
+        restart (the in-memory store has no such factory and mappers fall
+        back to the process-memory ReverseStore)."""
+        return SQLiteReverseStore(self)
 
     def close(self) -> None:
         with self._lock:

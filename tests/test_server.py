@@ -546,3 +546,36 @@ def test_openapi_spec_matches_routes():
     for path, ops in spec["paths"].items():
         for method in ops:
             assert (method.upper(), path) in routes, (method, path)
+
+
+def test_check_latest_forces_refresh(server, read_channel):
+    # CheckRequest.latest (check_service.proto:60-66): the engine must
+    # re-project before answering; rebuilds counter proves it ran
+    from ketotpu.proto import check_service_pb2 as cs
+
+    eng = server.registry._device_engine()
+    before = eng.rebuilds
+    stub = CheckServiceStub(read_channel)
+    resp = stub.Check(
+        cs.CheckRequest(
+            tuple=rts.RelationTuple(
+                namespace="File", object="keto/README.md", relation="view",
+                subject=rts.Subject(id="bob"),
+            ),
+            latest=True,
+        ),
+        timeout=60,
+    )
+    assert resp.allowed is True
+    assert eng.rebuilds == before + 1
+    # without latest: no rebuild
+    stub.Check(
+        cs.CheckRequest(
+            tuple=rts.RelationTuple(
+                namespace="File", object="keto/README.md", relation="view",
+                subject=rts.Subject(id="bob"),
+            ),
+        ),
+        timeout=60,
+    )
+    assert eng.rebuilds == before + 1

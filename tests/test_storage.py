@@ -350,3 +350,45 @@ class TestDirectoryNamespaceManager:
         assert [n.name for n in reg.namespace_manager().namespaces()] == [
             "videos"
         ]
+
+
+class TestUUIDMappingPersistence:
+    def test_reverse_mapping_survives_restart(self, tmp_path):
+        # reference: keto_uuid_mappings rows persist the reverse direction
+        # (persistence/sql/uuid_mapping.go:35-74); r2 kept them in process
+        # memory, losing UUID-keyed lookups on restart
+        import uuid as uuidlib
+
+        from ketotpu.api.uuid_map import UUIDMapper
+        from ketotpu.storage.sqlite import SQLiteTupleStore
+
+        path = str(tmp_path / "keto.db")
+        nid = uuidlib.UUID("00000000-0000-0000-0000-000000000001")
+        s1 = SQLiteTupleStore(path, auto_migrate=True)
+        m1 = UUIDMapper(nid, reverse_store=s1.uuid_reverse_store())
+        u = m1.to_uuid("alice")
+        assert m1.from_uuid(u) == "alice"
+        s1.close()
+
+        s2 = SQLiteTupleStore(path, auto_migrate=True)
+        m2 = UUIDMapper(nid, reverse_store=s2.uuid_reverse_store())
+        assert m2.from_uuid(u) == "alice"  # fresh process: no memory state
+        # read-only mapper resolves but never writes
+        ro = UUIDMapper(nid, read_only=True,
+                        reverse_store=s2.uuid_reverse_store())
+        assert ro.from_uuid(u) == "alice"
+        v = ro.to_uuid("bob")
+        assert ro.from_uuid(v) is None
+        s2.close()
+
+    def test_registry_wires_durable_reverse_store(self, tmp_path):
+        from ketotpu.driver import Provider, Registry
+        from ketotpu.storage.sqlite import SQLiteReverseStore
+
+        path = str(tmp_path / "keto.db")
+        r = Registry(Provider({"dsn": f"sqlite://{path}"}))
+        r.store().migrate_up()
+        assert isinstance(r.uuid_mapper()._store, SQLiteReverseStore)
+        u = r.uuid_mapper().to_uuid("carol")
+        # the read-only mapper shares the durable store
+        assert r.uuid_mapper(read_only=True).from_uuid(u) == "carol"
