@@ -1,0 +1,102 @@
+"""OPL parser fuzzing (VERDICT r2 missing #2).
+
+The reference ships a libFuzzer entry whose whole property is "the parser
+never panics on arbitrary bytes" (`internal/schema/parser_fuzzer.go:6-9`)
+plus a crash-seed corpus (`.fuzzer/fuzz_parser_seeds/`).  This harness
+re-creates both in pytest form:
+
+* the vendored seed corpus (tests/fixtures/opl_fuzz/, 24 historical
+  crash inputs) must parse without raising;
+* a deterministic mutation loop (byte flips, truncations, splices,
+  unicode injection, token deletion) over real OPL sources must only
+  ever produce (namespaces, [ParseError...]) — no uncaught exceptions,
+  no hangs (the nesting caps bound recursion, limits.py analog).
+"""
+
+import pathlib
+import random
+
+import pytest
+
+from ketotpu.opl.parser import ParseError, parse
+from ketotpu.utils.synth import SYNTH_OPL
+
+SEED_DIR = pathlib.Path(__file__).parent / "fixtures" / "opl_fuzz"
+FIXTURES = pathlib.Path(__file__).parent / "fixtures"
+
+REAL_SOURCES = [SYNTH_OPL]
+for name in ("project_opl.ts", "rewrites_namespaces.keto.ts"):
+    p = FIXTURES / name
+    if p.exists():
+        REAL_SOURCES.append(p.read_text(errors="replace"))
+
+
+def _check(source: str) -> None:
+    """The fuzz property: parse() returns, errors are typed."""
+    namespaces, errors = parse(source)
+    assert isinstance(namespaces, list)
+    for e in errors:
+        assert isinstance(e, ParseError)
+
+
+@pytest.mark.parametrize(
+    "seed", sorted(p.name for p in SEED_DIR.iterdir())
+)
+def test_reference_crash_corpus(seed):
+    data = (SEED_DIR / seed).read_bytes()
+    _check(data.decode("utf-8", errors="replace"))
+
+
+def _mutate(rng: random.Random, s: str) -> str:
+    op = rng.randrange(6)
+    if not s:
+        return chr(rng.randrange(1, 0x300))
+    i = rng.randrange(len(s))
+    j = rng.randrange(len(s))
+    lo, hi = min(i, j), max(i, j)
+    if op == 0:  # truncate
+        return s[:i]
+    if op == 1:  # delete a span
+        return s[:lo] + s[hi:]
+    if op == 2:  # duplicate a span (nesting pressure)
+        return s[:hi] + s[lo:hi] + s[hi:]
+    if op == 3:  # flip a char
+        return s[:i] + chr(rng.randrange(1, 0x3000)) + s[i + 1:]
+    if op == 4:  # splice two sources
+        other = rng.choice(REAL_SOURCES)
+        k = rng.randrange(len(other))
+        return s[:i] + other[k:]
+    # inject a token fragment mid-stream
+    frag = rng.choice(
+        ["(", ")", "{", "}", "&&", "||", "!", "=>", "this.", "related.",
+         "permits.", "class", "implements Namespace", "'", '"', "//",
+         "/*", "ctx.subject", "traverse((", "includes(", "SubjectSet<"]
+    )
+    return s[:i] + frag + s[i:]
+
+
+@pytest.mark.parametrize("round_seed", range(4))
+def test_mutation_fuzz(round_seed):
+    rng = random.Random(0xE70 + round_seed)
+    corpus = list(REAL_SOURCES)
+    corpus += [
+        (SEED_DIR / n).read_bytes().decode("utf-8", errors="replace")
+        for n in sorted(p.name for p in SEED_DIR.iterdir())[:8]
+    ]
+    for it in range(250):
+        base = rng.choice(corpus)
+        s = base
+        for _ in range(rng.randrange(1, 4)):
+            s = _mutate(rng, s)
+        # cap pathological blowup from repeated duplication
+        s = s[:20_000]
+        _check(s)
+        if it % 25 == 0 and len(s) < 5_000:
+            corpus.append(s)  # evolve the corpus
+
+
+def test_valid_sources_still_parse_clean():
+    for src in REAL_SOURCES:
+        namespaces, errors = parse(src)
+        assert not errors
+        assert namespaces
