@@ -123,3 +123,35 @@ def test_namespaces_strict_mode_without_location_boots():
     r = Registry(Provider({"namespaces": {"experimental_strict_mode": True}}))
     assert r.namespace_manager().namespaces() == []
     assert r.config.strict_mode() is True
+
+
+def test_config_schema_document():
+    # spec/config.schema.json is the published contract (reference:
+    # embedx/config.schema.json); the Provider's defaults and accepted
+    # shapes must validate against it, and its rejections must align
+    import json
+    import pathlib
+
+    import jsonschema
+
+    schema = json.loads(
+        (pathlib.Path(__file__).parent.parent / "spec"
+         / "config.schema.json").read_text()
+    )
+    jsonschema.Draft7Validator.check_schema(schema)
+    v = jsonschema.Draft7Validator(schema)
+    assert not list(v.iter_errors(Provider().snapshot()))
+    p2 = Provider({
+        "serve": {"read": {"tls": {"cert": {"path": "/x"},
+                                   "key": {"path": "/y"}},
+                           "cors": {"enabled": True}}},
+        "namespaces": {"location": "file:///ns.ts"},
+        "engine": {"kind": "oracle", "mesh_devices": 4},
+    })
+    assert not list(v.iter_errors(p2.snapshot()))
+    # both reject an unknown engine kind
+    assert list(v.iter_errors({"engine": {"kind": "gpu"}}))
+    import pytest as _pytest
+
+    with _pytest.raises(ConfigError):
+        Provider({"engine": {"kind": "gpu"}})
