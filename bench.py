@@ -7,15 +7,22 @@ Baseline: the reference's checked-in BenchmarkComputedUsersets figure —
 (`benchtest.new.txt:5`), i.e. ~12,303 checks/s/core.  `vs_baseline` is the
 speedup multiple of this engine's batched throughput over that number.
 
-Workload: Drive-style synthetic graph (folder tree, group subject-sets,
-computed-userset + tuple-to-userset view chains — the "5-hop rewrites"
-BASELINE shape), batches of mixed doc-view checks, steady-state timing after
-a warmup batch.  Timing is **end to end through the public batch_check
-surface**: string encode, device dispatch, and any host oracle fallbacks are
-all inside the clock (round-1 counted overflowed queries as done without
-running their fallback; this bench does not).  Runs on whatever JAX platform
-is ambient (the real TPU chip under the driver; set JAX_PLATFORMS=cpu to try
-it without one).
+Sections (the BASELINE.json configs):
+  1. fast-path throughput — Drive-style synth graph (CSS+TTU view chains,
+     the "5-hop rewrites" shape), 16k-query batches through the public
+     batch_check surface (string encode, device dispatch, fallbacks all
+     inside the clock), chunk-pipelined;
+  2. mixed AND/NOT slice (config #4's rewrites) — `edit` =
+     !banned && view routes through the general task-tree interpreter;
+     reported separately as general_checks_per_sec;
+  3. Expand at depth 5 (config #3) — batched device expand, trees/s;
+  4. serving latency (the metric's p50/p99 half) — concurrent single
+     Checks through the real gRPC daemon with the coalescer on;
+  5. 10M-tuple scale (configs #4/#5 scale) — columnar bulk load,
+     projection seconds, device HBM bytes, and checks/s at 10M.
+
+Runs on whatever JAX platform is ambient (the real TPU chip under the
+driver; set JAX_PLATFORMS=cpu to try it without one).
 """
 
 from __future__ import annotations
@@ -30,80 +37,151 @@ BATCH = 16384
 ROUNDS = 4
 
 
-def main() -> None:
+def _engine(graph, **kw):
     from ketotpu.engine.tpu import DeviceCheckEngine
-    from ketotpu.utils.synth import build_synth, synth_queries
 
+    kw.setdefault("frontier", 6 * BATCH)
+    kw.setdefault("arena", 12 * BATCH)
+    # chunked dispatch: several fused programs in flight per batch —
+    # device execution overlaps the host's per-chunk encode/collect
+    kw.setdefault("max_batch", BATCH // 4)
+    return DeviceCheckEngine(graph.store, graph.manager, **kw)
+
+
+def main() -> None:
+    from ketotpu.utils.synth import (
+        build_synth,
+        build_synth_columnar,
+        synth_queries,
+        synth_queries_mixed,
+    )
+
+    out = {}
+    baseline = 1e9 / BASELINE_NS_PER_OP
+
+    # ---- 0. link calibration ---------------------------------------------
+    # Under the driver the chip sits behind a network tunnel; a trivial
+    # dispatch+sync round trip measures the latency FLOOR the link imposes
+    # on every number below (the BASELINE p99 <= 2 ms target presumes
+    # locally attached v5e chips — compare serve_p50_ms against this).
+    import jax
+    import jax.numpy as jnp
+
+    _one = jax.jit(lambda a: a + 1)
+    np.asarray(_one(jnp.ones((8,), jnp.int32)))
+    rtts = []
+    for _ in range(10):
+        t0 = time.perf_counter()
+        np.asarray(_one(jnp.ones((8,), jnp.int32)))
+        rtts.append(time.perf_counter() - t0)
+    out["tunnel_rtt_ms"] = round(1000 * sorted(rtts)[len(rtts) // 2], 1)
+
+    # ---- 1. fast path -----------------------------------------------------
     graph = build_synth(
         n_users=2000, n_groups=100, n_folders=2000, n_docs=20000, seed=0
     )
-    eng = DeviceCheckEngine(
-        graph.store,
-        graph.manager,
-        frontier=6 * BATCH,
-        arena=12 * BATCH,
-        max_batch=BATCH,
-    )
+    eng = _engine(graph)
     eng.snapshot()
-
     queries = synth_queries(graph, BATCH * ROUNDS, seed=2)
     batches = [queries[i * BATCH : (i + 1) * BATCH] for i in range(ROUNDS)]
-
-    # warmup/compile + honest fallback diagnostics
     _, fallback = eng.batch_check_device_only(batches[0])
-    fallback_rate = float(np.mean(fallback))
     eng.batch_check(batches[0])
-
+    eng.batch_check(batches[0])  # second pass compiles the adaptive schedule
     t0 = time.perf_counter()
     done = 0
     times = []
     for b in batches:
         bt = time.perf_counter()
-        res = eng.batch_check(b)
+        done += len(eng.batch_check(b))
         times.append(time.perf_counter() - bt)
-        done += len(res)
     dt = time.perf_counter() - t0
-
     checks_per_sec = done / dt
-    baseline = 1e9 / BASELINE_NS_PER_OP
+    out.update(
+        metric="check_throughput",
+        value=round(checks_per_sec, 1),
+        unit="checks/sec",
+        vs_baseline=round(checks_per_sec / baseline, 3),
+        batch=BATCH,
+        tuples=len(graph.store),
+        device_fallback_rate=round(float(np.mean(fallback)), 5),
+        device_retries=eng.retries,
+        oracle_fallbacks=eng.fallbacks,
+        p50_batch_ms=round(1000 * sorted(times)[len(times) // 2], 1),
+    )
 
-    # -- scaling figure: the same workload at 1M+ tuples (VERDICT r1 #1) --
-    big = build_synth(
-        n_users=100_000, n_groups=2000, n_folders=50_000, n_docs=700_000,
-        seed=0,
+    # ---- 2. mixed AND/NOT (BASELINE config #4 rewrites) -------------------
+    mixed = synth_queries_mixed(graph, 10_000, seed=6, general_frac=0.3)
+    eng.batch_check(mixed[:4096])  # compile general-path shapes
+    t0 = time.perf_counter()
+    got = eng.batch_check(mixed)
+    mixed_cps = len(got) / (time.perf_counter() - t0)
+    n_general = sum(q.relation == "edit" for q in mixed)
+    pure_general = [q for q in mixed if q.relation == "edit"]
+    t0 = time.perf_counter()
+    eng.batch_check(pure_general)
+    general_cps = len(pure_general) / (time.perf_counter() - t0)
+    out.update(
+        mixed_10k_checks_per_sec=round(mixed_cps, 1),
+        mixed_general_frac=round(n_general / len(mixed), 3),
+        general_checks_per_sec=round(general_cps, 1),
+        general_fallbacks=eng.fallbacks - out["oracle_fallbacks"],
     )
-    beng = DeviceCheckEngine(
-        big.store, big.manager,
-        frontier=6 * BATCH, arena=12 * BATCH, max_batch=BATCH,
+
+    # ---- 3. Expand at depth 5 (BASELINE config #3) ------------------------
+    from ketotpu.api.types import SubjectSet
+
+    rng = np.random.default_rng(9)
+    roots = [
+        SubjectSet("Doc", graph.docs[int(rng.integers(len(graph.docs)))], "parents")
+        for _ in range(512)
+    ]
+    fb0 = eng.fallbacks
+    eng.batch_expand(roots[:64], 5)  # compile
+    t0 = time.perf_counter()
+    trees = eng.batch_expand(roots, 5)
+    expand_tps = len(trees) / (time.perf_counter() - t0)
+    out.update(
+        expand_trees_per_sec=round(expand_tps, 1),
+        expand_depth=5,
+        expand_fallback_rate=round((eng.fallbacks - fb0) / (len(roots) + 64), 4),
     )
-    beng.snapshot()
+
+    # ---- 4. serving latency (RPS + p50/p99 through the daemon) ------------
+    from bench_serve import run_serving_bench
+
+    out.update(
+        run_serving_bench(graph, concurrency=64, duration=10.0)
+    )
+
+    # ---- 5. 10M-tuple scale (columnar load + projection + checks) ---------
+    t0 = time.perf_counter()
+    big = build_synth_columnar(seed=0)
+    build_s = time.perf_counter() - t0
+    beng = _engine(big)
+    t0 = time.perf_counter()
+    snap = beng.snapshot()
+    projection_s = time.perf_counter() - t0
+    hbm_bytes = sum(
+        int(np.asarray(v).nbytes) for v in beng._device_arrays.values()
+    )
     bqs = synth_queries(big, 2 * BATCH, seed=3)
-    _, bfb = beng.batch_check_device_only(bqs[:BATCH])  # warmup/compile
+    _, bfb = beng.batch_check_device_only(bqs[:BATCH])
     beng.batch_check(bqs[:BATCH])
-    bt0 = time.perf_counter()
+    beng.batch_check(bqs[:BATCH])
+    t0 = time.perf_counter()
     bdone = len(beng.batch_check(bqs[BATCH:]))
-    big_cps = bdone / (time.perf_counter() - bt0)
-
-    print(
-        json.dumps(
-            {
-                "metric": "check_throughput",
-                "value": round(checks_per_sec, 1),
-                "unit": "checks/sec",
-                "vs_baseline": round(checks_per_sec / baseline, 3),
-                "batch": BATCH,
-                "tuples": len(graph.store),
-                "device_fallback_rate": round(fallback_rate, 5),
-                "device_retries": eng.retries,
-                "oracle_fallbacks": eng.fallbacks,
-                "p50_batch_ms": round(1000 * sorted(times)[len(times) // 2], 1),
-                "tuples_1m": len(big.store),
-                "checks_per_sec_1m": round(big_cps, 1),
-                "vs_baseline_1m": round(big_cps / baseline, 3),
-                "device_fallback_rate_1m": round(float(np.mean(bfb)), 5),
-            }
-        )
+    big_cps = bdone / (time.perf_counter() - t0)
+    out.update(
+        tuples_10m=len(big.store),
+        build_10m_s=round(build_s, 1),
+        projection_s=round(projection_s, 1),
+        hbm_bytes=hbm_bytes,
+        checks_per_sec_10m=round(big_cps, 1),
+        vs_baseline_10m=round(big_cps / baseline, 3),
+        device_fallback_rate_10m=round(float(np.mean(bfb)), 5),
     )
+
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":
