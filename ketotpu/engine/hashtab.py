@@ -31,13 +31,19 @@ import numpy as np
 
 PROBE = 8  # default probe depth; the build guarantees max bucket <= probe
 PROBE_SHALLOW = 4  # for small side tables on hot probe paths (delta overlay)
-# the big snapshot tables (node resolution + tuple membership) build at a
+# the big snapshot tables (node resolution + tuple membership) TARGET a
 # shallower probe: every probe round is 2 frontier/arena-sized gathers in
 # the hot BFS loop, and halving the rounds measured ~25% off whole-batch
-# device time on a v5 lite chip.  The build pays with more buckets (the
-# salt/doubling loop runs until the largest bucket fits), i.e. a bigger
-# int32 ptr array — noise next to the key/edge arrays.
+# device time on a v5 lite chip.  It is a target, not a guarantee: at the
+# 10M-entry scale forcing max-bucket <= 4 needs ~32x-entry bucket arrays
+# and dozens of multi-GB hash/bincount passes (measured: the dominant
+# cost of a 10M projection).  The build doubles buckets only up to
+# BUCKET_BUDGET x entries, then settles for the best salt's actual max
+# bucket; the achieved depth rides in the table itself as the `pw` array's
+# SHAPE, so jitted lookups unroll exactly that many rounds (shape changes
+# recompile naturally).
 SNAPSHOT_PROBE = 4
+BUCKET_BUDGET = 4  # max buckets per entry before relaxing the probe target
 
 def subtables(g, prefix):
     """Extract the sub-dict of a packed table by key prefix: the device
@@ -116,21 +122,37 @@ def build_table(
             raise ValueError(f"{n} entries exceed fixed cap {fixed_shape[1]}")
     else:
         buckets = _bucket_pow2(max(2 * n, 1), min_buckets)
+    max_buckets = (
+        buckets if fixed_shape is not None
+        else max(_bucket_pow2(max(BUCKET_BUDGET * n, 1), min_buckets), buckets)
+    )
     salt_i = 0
+    best = None  # (max_bucket, salt_i, h, counts) at the final size
+    probe_eff = probe
     while True:
         h = _mix_np(key_a, key_b, _SALTS[salt_i]) & np.uint32(buckets - 1)
         counts = np.bincount(h.astype(np.int64), minlength=buckets)
-        if n == 0 or counts.max() <= probe:
+        top = int(counts.max()) if n else 0
+        if n == 0 or top <= probe:
+            probe_eff = max(top, 1)
             break
+        if buckets >= max_buckets and (best is None or top < best[0]):
+            best = (top, salt_i, h, counts)
         if salt_i + 1 < len(_SALTS):
             salt_i += 1
         elif fixed_shape is not None:
             raise ValueError(
                 f"no salt fits {n} entries in {buckets} buckets at probe {probe}"
             )
-        else:
+        elif buckets < max_buckets:
             salt_i = 0
             buckets *= 2
+        else:
+            # budget exhausted: settle for the best salt's actual bound —
+            # lookups pay extra probe rounds instead of the build paying
+            # unbounded bucket doubling (the 10M-scale projection cliff)
+            probe_eff, salt_i, h, counts = best
+            break
     order = np.argsort(h, kind="stable") if n else np.zeros(0, np.int64)
     cap = fixed_shape[1] if fixed_shape is not None else _bucket_pow2(max(n, 1), 16)
     ta = np.full(cap, -1, np.int32)
@@ -144,6 +166,14 @@ def build_table(
         "key_a": ta,
         "key_b": tb,
         "meta": np.array([salt_i, buckets - 1], np.int32),
+        # probe depth as SHAPE: jitted lookups read it statically at trace
+        # time, so a table that settled for a deeper bound (or achieved a
+        # shallower one) unrolls exactly the right number of rounds with
+        # no API threading.  Fixed-shape tables pin it to the requested
+        # probe so re-shipped overlays never change the pytree.
+        "pw": np.zeros(
+            (probe if fixed_shape is not None else probe_eff,), np.int8
+        ),
     }
     if val is not None:
         tv = np.full(cap, -1, np.int32)
@@ -156,12 +186,16 @@ def lookup(t: Dict, a, b, *, probe: int = PROBE) -> Tuple:
     """Device probe: (val_or_index, found).  Negative queries never match.
 
     With ``val`` built, returns the payload of the first match; otherwise
-    the entry index.  At most ``probe`` static gather rounds (the table
-    must have been built with the same bound) — no data-dependent control
-    flow, safe anywhere in a jitted program.
+    the entry index.  Static gather rounds, no data-dependent control
+    flow, safe anywhere in a jitted program.  The round count comes from
+    the table's own ``pw`` shape when present (the build records the
+    achieved max-bucket bound there); ``probe`` is the fallback for
+    tables predating it.
     """
     import jax.numpy as jnp
 
+    if "pw" in t:
+        probe = t["pw"].shape[0]
     salt = t["meta"][0]
     mask = t["meta"][1]
     salt_v = jnp.asarray(_SALTS, np.uint32)[jnp.clip(salt, 0, len(_SALTS) - 1)]

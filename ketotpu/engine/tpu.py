@@ -535,19 +535,42 @@ class DeviceCheckEngine:
             general = np.zeros_like(general)
         if general.any():
             gi = np.flatnonzero(general)
-            gpad = _bucket(len(gi), 32)
-            genc = self._pad(tuple(a[gi] for a in enc), len(gi), gpad)
-            gres = dev.run_batch(
+            gres = self._run_general(dev_arrays, enc, gi)
+        return (enc, err, general, res, gi, gres, dev_arrays, occ)
+
+    #: task-tree slots budgeted per general root: an AND/NOT program tree
+    #: plus its subtree expansions measure ~8-16 live tasks per query on
+    #: the synth rewrite shapes, so cap//16 roots leaves 16 slots each
+    GENERAL_TASKS_PER_ROOT = 16
+
+    def _run_general(self, dev_arrays, enc, gi, boost: int = 1):
+        """Dispatch general (AND/NOT) roots through the task-tree
+        interpreter in sub-batches sized so ``cap`` task slots and ``vcap``
+        visited slots are plausibly enough for every root — a whole-chunk
+        general batch (thousands of roots in an 8k-task arena) used to
+        overflow wholesale and drain to the sequential oracle.  Returns
+        (codes, over) aligned with ``gi``."""
+        cap = boost * self.cap
+        chunk = max(32, cap // self.GENERAL_TASKS_PER_ROOT)
+        codes = np.empty(len(gi), np.int8)
+        over = np.empty(len(gi), bool)
+        for s in range(0, len(gi), chunk):
+            part = gi[s : s + chunk]
+            gpad = _bucket(len(part), 32)
+            genc = self._pad(tuple(a[part] for a in enc), len(part), gpad)
+            r = dev.run_batch(
                 dev_arrays,
                 *genc,
-                cap=self.cap,
-                arena=self.gen_arena,
-                vcap=self.vcap,
+                cap=cap,
+                arena=boost * self.gen_arena,
+                vcap=boost * self.vcap,
                 max_iters=self.max_iters,
                 max_width=self.max_width,
                 strict=self.strict_mode,
             )
-        return (enc, err, general, res, gi, gres, dev_arrays, occ)
+            codes[s : s + chunk] = np.asarray(r.result)[: len(part)]
+            over[s : s + chunk] = np.asarray(r.overflow)[: len(part)]
+        return codes, over
 
     def _collect(self, handle, retry: bool = True):
         """Sync one chunk's results; device-retry the fast-path overflow
@@ -561,9 +584,22 @@ class DeviceCheckEngine:
         fallback = err.copy()
 
         if gres is not None:
-            codes = np.asarray(gres.result)[: len(gi)]
-            gover = np.asarray(gres.overflow)[: len(gi)]
+            codes, gover = gres
             allowed[gi] = codes == dev.R_IS
+            # overflow retry tier for the general path, mirroring the fast
+            # path: re-run just the overflowed roots at boosted caps (small
+            # batch => ample per-root slots) before any oracle fallback
+            gunres = gover & (codes != dev.R_ERR)
+            if retry and gunres.any() and self.retry_scale > 1:
+                ri = gi[np.flatnonzero(gunres)]
+                self.retries += len(ri)
+                rcodes, rover = self._run_general(
+                    dev_arrays, enc, ri, boost=self.retry_scale
+                )
+                allowed[ri] = rcodes == dev.R_IS
+                gover[gunres] = rover | (rcodes == dev.R_ERR)
+                codes = codes.copy()
+                codes[np.flatnonzero(gunres)] = rcodes
             fallback[gi] |= gover | (codes == dev.R_ERR)
 
         codes = np.asarray(res)[:n]  # one D2H fetch for all three masks
