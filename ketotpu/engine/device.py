@@ -392,9 +392,13 @@ def check_step(
     base_exp = g["row_ptr"][jnp.clip(node_self[aps], 0, g["row_ptr"].shape[0] - 2)]
     base_ttu = g["row_ptr"][jnp.clip(node_ttu[aps], 0, g["row_ptr"].shape[0] - 2)]
     eidx = jnp.clip(
-        jnp.where(c_ttu, base_ttu, base_exp) + ao, 0, g["edge_ns"].shape[0] - 1
+        jnp.where(c_ttu, base_ttu, base_exp) + ao, 0, g["edge_hi"].shape[0] - 1
     )
-    e_ns, e_obj, e_rel = g["edge_ns"][eidx], g["edge_obj"][eidx], g["edge_rel"][eidx]
+    # packed (ns, rel) word + VPU decode: one less arena-sized HBM gather
+    num_rels_ = g["prog_root"].shape[1]
+    e_hi, e_obj = g["edge_hi"][eidx], g["edge_obj"][eidx]
+    e_ns = jnp.where(e_hi >= 0, e_hi // num_rels_, -1)
+    e_rel = jnp.where(e_hi >= 0, e_hi % num_rels_, -1)
     e_node = g["edge_node"][eidx]
 
     # prog CSR gathers

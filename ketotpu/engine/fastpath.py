@@ -349,9 +349,13 @@ def expand_phase(
     ttu_node_p = jnp.take_along_axis(ttu_nodes[aps], ttu_k[:, None], 1)[:, 0]
     base_ttu = rp[jnp.clip(ttu_node_p, 0, rp.shape[0] - 2)]
     eidx = jnp.clip(
-        jnp.where(is_ttu, base_ttu, base_exp) + off, 0, g["edge_ns"].shape[0] - 1
+        jnp.where(is_ttu, base_ttu, base_exp) + off, 0, g["edge_hi"].shape[0] - 1
     )
-    e_ns, e_obj, e_rel = g["edge_ns"][eidx], g["edge_obj"][eidx], g["edge_rel"][eidx]
+    # one packed gather for (ns, rel) + one for obj; div/mod decode is VPU
+    # arithmetic, each avoided gather is an arena-sized HBM read
+    e_hi, e_obj = g["edge_hi"][eidx], g["edge_obj"][eidx]
+    e_ns = jnp.where(e_hi >= 0, e_hi // R, -1)
+    e_rel = jnp.where(e_hi >= 0, e_hi % R, -1)
 
     css_rel_p = jnp.take_along_axis(css_rel[aps], css_k[:, None], 1)[:, 0]
     css_dec_p = jnp.take_along_axis(css_dec[aps], css_k[:, None], 1)[:, 0]

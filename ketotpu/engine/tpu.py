@@ -538,10 +538,13 @@ class DeviceCheckEngine:
             gres = self._run_general(dev_arrays, enc, gi)
         return (enc, err, general, res, gi, gres, dev_arrays, occ)
 
-    #: task-tree slots budgeted per general root: an AND/NOT program tree
-    #: plus its subtree expansions measure ~8-16 live tasks per query on
-    #: the synth rewrite shapes, so cap//16 roots leaves 16 slots each
-    GENERAL_TASKS_PER_ROOT = 16
+    #: task-tree slots budgeted per general root.  The interpreter's task
+    #: buffer is a bump allocator (tasks are never freed), so this bounds
+    #: the TOTAL tree a root may allocate across all levels — measured
+    #: 64-128 on Drive-style chain graphs (an `edit = !banned && view`
+    #: root walks the whole view closure: per folder hop a prog node, CSS
+    #: probes, expansion and TTU children)
+    GENERAL_TASKS_PER_ROOT = 128
 
     def _run_general(self, dev_arrays, enc, gi, boost: int = 1):
         """Dispatch general (AND/NOT) roots through the task-tree
@@ -549,9 +552,12 @@ class DeviceCheckEngine:
         visited slots are plausibly enough for every root — a whole-chunk
         general batch (thousands of roots in an 8k-task arena) used to
         overflow wholesale and drain to the sequential oracle.  Returns
-        (codes, over) aligned with ``gi``."""
+        (codes, over) aligned with ``gi``.
+
+        ``boost`` both widens the buffers and shrinks the sub-batch, so a
+        retry gives each root boost^2 the task budget of tier 1."""
         cap = boost * self.cap
-        chunk = max(32, cap // self.GENERAL_TASKS_PER_ROOT)
+        chunk = max(32, cap // self.GENERAL_TASKS_PER_ROOT // boost)
         codes = np.empty(len(gi), np.int8)
         over = np.empty(len(gi), bool)
         for s in range(0, len(gi), chunk):
@@ -567,6 +573,10 @@ class DeviceCheckEngine:
                 max_iters=self.max_iters,
                 max_width=self.max_width,
                 strict=self.strict_mode,
+                # 6 fused levels per dispatch: on a tunneled link the
+                # per-window flags sync (~75ms) dwarfs a few extra no-op
+                # steps, and typical trees resolve in 1-2 windows
+                steps_per_dispatch=6,
             )
             codes[s : s + chunk] = np.asarray(r.result)[: len(part)]
             over[s : s + chunk] = np.asarray(r.overflow)[: len(part)]
