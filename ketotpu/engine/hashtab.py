@@ -17,10 +17,11 @@ Layout (all host-built with vectorized numpy, no per-row Python):
 * ``val``: int32[capacity] payload (node ids), optional,
 * ``meta``: int32[2] = (salt index, bucket mask) as device scalars.
 
-The build doubles the bucket count (and walks a salt schedule) until the
-largest bucket fits in ``PROBE`` slots, so device probes never miss a
-present key.  Keys are non-negative; -1 is the empty/pad sentinel and
-negative queries never match.
+The build hashes into a fixed 2n-bucket table, walking a salt schedule
+for the flattest distribution; the achieved max-bucket depth is carried in
+the table's ``pw`` array shape and lookups unroll exactly that many probe
+rounds, so device probes never miss a present key.  Keys are non-negative;
+-1 is the empty/pad sentinel and negative queries never match.
 """
 
 from __future__ import annotations
@@ -32,18 +33,17 @@ import numpy as np
 PROBE = 8  # default probe depth; the build guarantees max bucket <= probe
 PROBE_SHALLOW = 4  # for small side tables on hot probe paths (delta overlay)
 # the big snapshot tables (node resolution + tuple membership) TARGET a
-# shallower probe: every probe round is 2 frontier/arena-sized gathers in
-# the hot BFS loop, and halving the rounds measured ~25% off whole-batch
-# device time on a v5 lite chip.  It is a target, not a guarantee: at the
-# 10M-entry scale forcing max-bucket <= 4 needs ~32x-entry bucket arrays
-# and dozens of multi-GB hash/bincount passes (measured: the dominant
-# cost of a 10M projection).  The build doubles buckets only up to
-# BUCKET_BUDGET x entries, then settles for the best salt's actual max
-# bucket; the achieved depth rides in the table itself as the `pw` array's
-# SHAPE, so jitted lookups unroll exactly that many rounds (shape changes
-# recompile naturally).
+# shallower probe than the guaranteed default: fewer unrolled gather
+# rounds in the hot BFS loop.  It is a target, not a guarantee: buckets
+# are fixed at 2x entries (forcing max-bucket <= 4 at the 10M-entry scale
+# needs ~32x-entry bucket arrays and dozens of multi-GB hash/bincount
+# passes — measured as the dominant cost of a 10M projection — and every
+# bucket is 4 bytes of ptr array uploaded over a ~20-40MB/s link, while
+# extra probe rounds measured ~free on-chip).  The salt schedule picks
+# the flattest distribution and the achieved depth rides in the table's
+# `pw` array SHAPE, so jitted lookups unroll exactly that many rounds
+# (shape changes recompile naturally).
 SNAPSHOT_PROBE = 4
-BUCKET_BUDGET = 4  # max buckets per entry before relaxing the probe target
 
 def subtables(g, prefix):
     """Extract the sub-dict of a packed table by key prefix: the device
@@ -122,12 +122,8 @@ def build_table(
             raise ValueError(f"{n} entries exceed fixed cap {fixed_shape[1]}")
     else:
         buckets = _bucket_pow2(max(2 * n, 1), min_buckets)
-    max_buckets = (
-        buckets if fixed_shape is not None
-        else max(_bucket_pow2(max(BUCKET_BUDGET * n, 1), min_buckets), buckets)
-    )
     salt_i = 0
-    best = None  # (max_bucket, salt_i, h, counts) at the final size
+    best = None  # flattest (max_bucket, salt_i, h, counts) seen
     probe_eff = probe
     while True:
         h = _mix_np(key_a, key_b, _SALTS[salt_i]) & np.uint32(buckets - 1)
@@ -136,7 +132,7 @@ def build_table(
         if n == 0 or top <= probe:
             probe_eff = max(top, 1)
             break
-        if buckets >= max_buckets and (best is None or top < best[0]):
+        if best is None or top < best[0]:
             best = (top, salt_i, h, counts)
         if salt_i + 1 < len(_SALTS):
             salt_i += 1
@@ -144,13 +140,10 @@ def build_table(
             raise ValueError(
                 f"no salt fits {n} entries in {buckets} buckets at probe {probe}"
             )
-        elif buckets < max_buckets:
-            salt_i = 0
-            buckets *= 2
         else:
-            # budget exhausted: settle for the best salt's actual bound —
-            # lookups pay extra probe rounds instead of the build paying
-            # unbounded bucket doubling (the 10M-scale projection cliff)
+            # salt schedule exhausted: settle for the flattest salt's
+            # actual bound — lookups pay extra probe rounds instead of the
+            # build paying bucket doubling (the 10M-scale projection cliff)
             probe_eff, salt_i, h, counts = best
             break
     order = np.argsort(h, kind="stable") if n else np.zeros(0, np.int64)
