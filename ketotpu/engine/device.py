@@ -40,7 +40,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ketotpu.engine.xutil import arena_assign, lex_searchsorted, lex_sort
+from ketotpu.engine import hashtab
+from ketotpu.engine.xutil import arena_assign
 
 _I32MAX = jnp.iinfo(jnp.int32).max
 
@@ -57,6 +58,10 @@ P_OR, P_AND, P_NOT, P_CSS, P_TTU, P_BATCHCSS = 0, 1, 2, 3, 4, 5
 
 # flag bits returned per step
 F_PENDING, F_CHANGED, F_ALL_ROOTS_DONE = 1, 2, 4
+
+# linear-probe window of the visited hash set (open addressing at load
+# factor <= 0.5; a miss after _VPROBE rounds => per-query overflow)
+_VPROBE = 8
 
 
 class RunResult(NamedTuple):
@@ -136,9 +141,15 @@ def init_state(
     )
     return dict(
         T=T,
-        vlog=tuple(jnp.full((vcap,), _I32MAX, jnp.int32) for _ in range(4)),
+        # visited hash set: ~2x slots per entry budget (lambda<=0.5 keeps
+        # the _VPROBE linear-probe window ~always sufficient), rounded to
+        # a power of two — the probe loop masks with (slots - 1)
+        vset=tuple(
+            jnp.full((hashtab._bucket_pow2(2 * vcap, 16),), _I32MAX,
+                     jnp.int32)
+            for _ in range(4)
+        ),
         cursor=jnp.int32(Q),
-        vcursor=jnp.int32(0),
         q_over=jnp.zeros((Q,), bool),
         q_subj=jnp.asarray(q_subj, jnp.int32),
         flags=jnp.int32(F_PENDING),
@@ -254,7 +265,7 @@ def check_step(
 
     T = dict(s["T"])
     q_subj = s["q_subj"]
-    cursor, vcursor, q_over = s["cursor"], s["vcursor"], s["q_over"]
+    cursor, q_over = s["cursor"], s["q_over"]
 
     # ---- phase A: classify pending tasks ------------------------------
     pending = T["state"] == S_PENDING
@@ -473,44 +484,69 @@ def check_step(
     alive = alive & ~(c_is_expand & (deg > max_width) & (ao >= max_width - 1))
 
     # ---- phase F: visited scopes --------------------------------------
+    # The visited set is an open-addressed hash SET of (vscope, ns, obj,
+    # rel) keys: 4 parallel int32 key columns over 2*vcap slots, _I32MAX =
+    # empty.  The sorted-log design this replaces paid two arena/vcap-
+    # sized multi-key bitonic sorts EVERY step — the dominant general-path
+    # step cost, the same sort the fastpath's pack replaced with a
+    # scatter.  One linear-probe loop now does membership, in-batch
+    # first-occurrence dedup (same-slot contenders resolve by min arena
+    # index; losers with an identical key read it back as a dup), and
+    # insertion; a key that finds neither itself nor a free slot within
+    # _VPROBE rounds flags its query `over` (host fallback) — exact or
+    # fallback, never a wrong verdict.
     evc = c_is_expand & alive
-    k1 = jnp.where(evc, ch_vscope, _I32MAX)
+    k1 = jnp.where(evc, ch_vscope, _I32MAX)  # vscope >= 0 for evc items
     k2 = jnp.where(evc, ch_ns, _I32MAX)
     k3 = jnp.where(evc, ch_obj, _I32MAX)
     k4 = jnp.where(evc, ch_rel, _I32MAX)
-    _, seen = lex_searchsorted(s["vlog"], (k1, k2, k3, k4))
-    alive = alive & ~(evc & seen)
-    evc = evc & ~seen
-    # in-batch first-occurrence dedup
+    v1, v2, v3, v4 = s["vset"]
+    VS = v1.shape[0]
+    salts = jnp.asarray(hashtab._SALTS, jnp.uint32)
+    h = (
+        hashtab.mix_device(
+            hashtab.mix_device(k1, k2, salts[0]).astype(jnp.int32),
+            hashtab.mix_device(k3, k4, salts[1]).astype(jnp.int32),
+            salts[2],
+        )
+        & jnp.uint32(VS - 1)
+    ).astype(jnp.int32)
     aidx = jnp.arange(arena, dtype=jnp.int32)
-    sk, (sj,) = lex_sort(
-        (jnp.where(evc, k1, _I32MAX), jnp.where(evc, k2, _I32MAX),
-         jnp.where(evc, k3, _I32MAX), jnp.where(evc, k4, _I32MAX), aidx),
-        aidx,
-    )
-    same_prev = (
-        (sk[0] == jnp.roll(sk[0], 1)) & (sk[1] == jnp.roll(sk[1], 1))
-        & (sk[2] == jnp.roll(sk[2], 1)) & (sk[3] == jnp.roll(sk[3], 1))
-    )
-    same_prev = same_prev.at[0].set(False) & (sk[0] != _I32MAX)
-    dup = jnp.zeros((arena,), bool).at[sj].set(same_prev)
-    alive = alive & ~(evc & dup)
-    evc = evc & ~dup
-    # append new keys to the log
-    nadd = jnp.sum(evc.astype(jnp.int32))
-    vover = vcursor + nadd > vcap
-    q_over = q_over.at[jnp.clip(pqid, 0, Q - 1)].max(evc & vover)
-    write_v = evc & ~vover
-    # dead slots scatter out of bounds and are dropped
-    vpos = jnp.where(
-        write_v, vcursor + jnp.cumsum(evc.astype(jnp.int32)) - 1, vcap
-    )
-    vlog = list(s["vlog"])
-    for i, col in enumerate((k1, k2, k3, k4)):
-        vlog[i] = vlog[i].at[vpos].set(col, mode="drop")
-    vkeys, _ = lex_sort(tuple(vlog))
-    vlog = tuple(vkeys)
-    vcursor = jnp.where(vover, vcursor, vcursor + nadd)
+    seen = jnp.zeros((arena,), bool)
+    vpend = evc
+    for i in range(_VPROBE):
+        j = (h + i) & (VS - 1)
+        match = (
+            vpend & (v1[j] == k1) & (v2[j] == k2)
+            & (v3[j] == k3) & (v4[j] == k4)
+        )
+        seen = seen | match  # visited in a prior step
+        vpend = vpend & ~match
+        empty = v1[j] == _I32MAX
+        # min-arena-index ownership among contenders for this free slot
+        claim = jnp.full((VS,), _I32MAX, jnp.int32).at[j].min(
+            jnp.where(vpend & empty, aidx, _I32MAX), mode="drop"
+        )
+        won = vpend & empty & (claim[j] == aidx)
+        tgt = jnp.where(won, j, VS)  # losers scatter out of bounds
+        v1 = v1.at[tgt].set(k1, mode="drop")
+        v2 = v2.at[tgt].set(k2, mode="drop")
+        v3 = v3.at[tgt].set(k3, mode="drop")
+        v4 = v4.at[tgt].set(k4, mode="drop")
+        vpend = vpend & ~won
+        # in-batch duplicate: an identical key just claimed this slot
+        nowmatch = (
+            vpend & (v1[j] == k1) & (v2[j] == k2)
+            & (v3[j] == k3) & (v4[j] == k4)
+        )
+        seen = seen | nowmatch
+        vpend = vpend & ~nowmatch
+    alive = alive & ~seen  # seen only ever set where evc
+    # probe window exhausted: conservative per-query overflow, child dies
+    # (its query is host-fallback work either way)
+    q_over = q_over.at[jnp.clip(pqid, 0, Q - 1)].max(vpend)
+    alive = alive & ~vpend
+    vset = (v1, v2, v3, v4)
 
     # ---- phase G: write surviving children ----------------------------
     alive32 = alive.astype(jnp.int32)
@@ -566,9 +602,8 @@ def check_step(
 
     return dict(
         T=T,
-        vlog=vlog,
+        vset=vset,
         cursor=cursor,
-        vcursor=vcursor,
         q_over=q_over,
         q_subj=q_subj,
         flags=flags,

@@ -36,6 +36,10 @@ def _lex_eq(a: Sequence[jax.Array], b: Sequence[jax.Array]) -> jax.Array:
     return eq
 
 
+# NOTE: lex_searchsorted / lex_sort have no production callers since the
+# general-path visited log moved to a hash set (device.py phase F); they
+# remain as tested utilities for host-side tooling and as the documented
+# alternative where sorted semantics (ordered output) are required.
 def lex_searchsorted(
     keys: Sequence[jax.Array], queries: Sequence[jax.Array]
 ) -> Tuple[jax.Array, jax.Array]:

@@ -97,14 +97,14 @@ def shard_fast_check(
 def _lift(s: Dict) -> Dict:
     """Scalars -> [1] arrays so per-device values concatenate on 'data'."""
     s = dict(s)
-    for k in ("cursor", "vcursor", "flags"):
+    for k in ("cursor", "flags"):
         s[k] = s[k][None]
     return s
 
 
 def _unlift(s: Dict) -> Dict:
     s = dict(s)
-    for k in ("cursor", "vcursor", "flags"):
+    for k in ("cursor", "flags"):
         s[k] = s[k][0]
     return s
 
@@ -119,9 +119,8 @@ def _specs(q: int):
                 "prog cop nchild ndone nis nnot nerr delivered"
             ).split()
         },
-        vlog=(P("data"),) * 4,
+        vset=(P("data"),) * 4,
         cursor=P("data"),
-        vcursor=P("data"),
         q_over=P("data"),
         q_subj=P("data"),
         flags=P("data"),
