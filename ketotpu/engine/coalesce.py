@@ -120,9 +120,22 @@ class CoalescingEngine:
             by_depth.setdefault(s.depth, []).append(s)
         for depth, slots in by_depth.items():
             try:
-                verdicts = self.inner.batch_check(
-                    [s.tuple for s in slots], depth
-                )
+                # one bounded whole-batch retry: a transient device /
+                # runtime hiccup should not error up to max_pending
+                # concurrent callers when a second dispatch would have
+                # succeeded (per-query degradation is still avoided —
+                # it would serialize the wave on this one thread)
+                for attempt in range(2):
+                    try:
+                        verdicts = self.inner.batch_check(
+                            [s.tuple for s in slots], depth
+                        )
+                        break
+                    except KetoAPIError:
+                        raise
+                    except Exception:  # noqa: BLE001
+                        if attempt:
+                            raise
                 for s, v in zip(slots, verdicts):
                     s.result = bool(v)
             except KetoAPIError:
@@ -136,10 +149,7 @@ class CoalescingEngine:
                     except Exception as e:  # noqa: BLE001
                         s.error = e
             except Exception as e:  # noqa: BLE001
-                # transient device/runtime failure: degrading the whole wave
-                # to per-query dispatches would serialize up to max_pending
-                # full dispatches on this one thread while new checks queue
-                # behind them — raise to every caller instead and let them
+                # retry also failed: raise to every caller and let them
                 # retry against a (hopefully) recovered engine
                 for s in slots:
                     s.error = e

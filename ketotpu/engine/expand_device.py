@@ -184,13 +184,35 @@ class OverlayMembers:
     would move it to the end."""
 
     def __init__(self, overlay, snap, vocab: Vocab):
+        from ketotpu.engine import delta as dl
+
         self.added: Dict[int, List[int]] = {}
         self.deleted: Dict[int, set] = {}
         for (node, subj), net in overlay.pair_net.items():
-            if net > 0:
-                self.added.setdefault(node, []).append(subj)
-            elif net < 0:
+            # classify against the BASE pair count, exactly like
+            # overlay_arrays (delta.py): the sign of net alone diverges
+            # from live-store membership under duplicate-tuple
+            # multiplicity (the in-memory store permits exact duplicate
+            # rows), e.g. delete-one-of-two must not drop the member
+            base = (
+                dl._base_pair_count(snap, node, subj)
+                if node < snap.n_nodes
+                else 0
+            )
+            now = base + net
+            if now <= 0:
+                if base > 0:
+                    self.deleted.setdefault(node, set()).add(subj)
+            elif now > base:
+                # one entry per extra copy: duplicate inserts appear as
+                # duplicate rows in live-store pagination
+                self.added.setdefault(node, []).extend([subj] * (now - base))
+            elif now < base:
+                # delete-all-then-reinsert-fewer: drop the base copies and
+                # append the surviving count (live pagination also moves
+                # the re-inserted copies to the end)
                 self.deleted.setdefault(node, set()).add(subj)
+                self.added.setdefault(node, []).extend([subj] * now)
         self.new_nodes = dict(overlay.new_nodes)
         self._snap = snap
         self._vocab = vocab

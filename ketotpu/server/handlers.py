@@ -168,11 +168,14 @@ class CheckHandler:
             tuple_ = tuple_from_proto(src)
             if getattr(request, "latest", False):
                 # CheckRequest.latest (check_service.proto:60-66): evaluate
-                # against the freshest possible state.  The device engine
-                # re-projects; the oracle engine reads live anyway.
-                refresh = getattr(r.check_engine(), "refresh", None)
-                if refresh is not None:
-                    refresh()
+                # against the freshest possible state.  snapshot() drains
+                # the change log into the write-exact overlay; a full
+                # refresh() rebuild is stronger than needed and would let
+                # any latest=true client stall all traffic for a
+                # reprojection at 10M-tuple scale.
+                sync = getattr(r.check_engine(), "snapshot", None)
+                if sync is not None:
+                    sync()
             allowed = self.check_core(tuple_, int(request.max_depth), r)
             return check_service_pb2.CheckResponse(
                 allowed=allowed, snaptoken=self.snaptoken(r)

@@ -94,8 +94,14 @@ class ColumnarTupleStore(InMemoryTupleStore):
             self._b_alive = np.ones(self._b_n, bool)
             self._next_seq = self._b_n
             self._fwd_order = self._fwd_keys = self._sub_order = None
+            # advance past every pre-load log entry AND the loaded base
+            # rows, so every cursor issued before this load falls behind
+            # _log_start and forces the full-rescan/export_columns path
+            # (advancing by n alone would let a cursor taken after
+            # write-then-delete churn read an empty delta and miss the
+            # whole bulk-loaded segment)
+            self._log_start += len(self._log) + n
             self._log.clear()
-            self._log_start += n  # old cursors fall behind: full rescan
             self._bump()
 
     def export_columns(self):

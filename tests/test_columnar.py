@@ -142,3 +142,39 @@ def test_change_log_covers_base_deletes(graphs):
     changes, head = store.changes_since(head0)
     assert (-1, str(victim)) in [(op, str(t)) for op, t in changes]
     assert head > head0
+
+
+def test_bulk_load_after_write_delete_churn_invalidates_cursors():
+    """ADVICE r3: a cursor taken after write-then-delete churn (empty
+    _rows, non-empty log) must fall behind _log_start on bulk load and
+    get the None full-rescan sentinel — not an empty delta that silently
+    misses the whole base segment."""
+    from ketotpu.storage.columnar import ColumnarTupleStore
+
+    store = ColumnarTupleStore()
+    t = T("Doc:d0#viewers@churn")
+    store.write_relation_tuples(t)
+    store.delete_relation_tuples(t)
+    _, cursor = store.changes_since(0)  # log head after the churn
+
+    v = store.vocab
+    v.intern_tuple(T("Doc:d1#viewers@u1"))
+    ids = dict(
+        ns=[v.namespaces.lookup("Doc")],
+        obj=[v.objects.lookup("d1")],
+        rel=[v.relations.lookup("viewers")],
+        subj=[v.subjects.lookup(SubjectID("u1").unique_id())],
+        is_set=[0],
+        s_ns=[-1],
+        s_obj=[-1],
+        s_rel=[-1],
+    )
+    store.bulk_load_ids({k: np.asarray(c, np.int32) for k, c in ids.items()})
+
+    changes, head = store.changes_since(cursor)
+    assert changes is None  # full rescan, not a silent empty delta
+    # and a fresh cursor from the new head works normally
+    t2 = T("Doc:d2#viewers@u2")
+    store.write_relation_tuples(t2)
+    changes, _ = store.changes_since(head)
+    assert changes is not None and len(changes) == 1

@@ -188,3 +188,78 @@ class TestEngineSurface:
         assert eng.rebuilds == rebuilds0, "overlay write must not rebuild"
         assert eng.fallbacks == fb0, "no blanket oracle fallback"
         assert _trees_equal(out[0], oracle.build_tree(s))
+
+
+class TestOverlayMultiplicity:
+    def test_double_insert_appears_twice(self):
+        """ADVICE r3: OverlayMembers must classify against the BASE pair
+        count like overlay_arrays, and a pair inserted twice
+        post-snapshot must appear twice in the expand tree — matching
+        live-store pagination, which keeps exact duplicate rows."""
+        from ketotpu.engine.oracle import ExpandEngine
+
+        graph = build_synth(n_users=32, n_groups=4, n_folders=16, n_docs=64)
+        eng = DeviceCheckEngine(graph.store, graph.manager)
+        eng.snapshot()
+        doc = next(
+            t for t in graph.store.all_tuples() if t.relation == "viewers"
+        )
+        dup = RelationTuple.from_string(
+            f"{doc.namespace}:{doc.object}#viewers@twice"
+        )
+        # insert the same tuple twice post-snapshot, then delete once —
+        # the in-memory store keeps duplicate rows, so one copy survives
+        graph.store.write_relation_tuples(dup)
+        graph.store.write_relation_tuples(dup)
+        s = SubjectSet(doc.namespace, doc.object, "viewers")
+        out = eng.batch_expand([s])
+        oracle = ExpandEngine(graph.store, max_depth=eng.max_depth)
+        assert _trees_equal(out[0], oracle.build_tree(s))
+        assert str(out[0].to_json()).count("twice") == 2
+
+    def test_base_pair_delete_then_reinsert_fewer(self):
+        """base=2 copies in the snapshot, delete-all then reinsert one:
+        the tree must show exactly one surviving copy (count parity with
+        the live store, which also moves it to the row end)."""
+        from ketotpu.engine.oracle import ExpandEngine
+
+        graph = build_synth(n_users=32, n_groups=4, n_folders=16, n_docs=64)
+        doc = next(
+            t for t in graph.store.all_tuples() if t.relation == "viewers"
+        )
+        dup = RelationTuple.from_string(
+            f"{doc.namespace}:{doc.object}#viewers@twice"
+        )
+        graph.store.write_relation_tuples(dup)
+        graph.store.write_relation_tuples(dup)  # base will hold 2 copies
+        eng = DeviceCheckEngine(graph.store, graph.manager)
+        eng.snapshot()
+        graph.store.delete_relation_tuples(dup)  # removes BOTH copies
+        graph.store.write_relation_tuples(dup)   # one survives
+        s = SubjectSet(doc.namespace, doc.object, "viewers")
+        out = eng.batch_expand([s])
+        oracle = ExpandEngine(graph.store, max_depth=eng.max_depth)
+        assert _trees_equal(out[0], oracle.build_tree(s))
+        assert str(out[0].to_json()).count("twice") == 1
+
+    def test_base_pair_duplicate_insert_over_existing(self):
+        """base=1 copy plus one post-snapshot duplicate insert: two
+        copies in the tree, like live-store pagination."""
+        from ketotpu.engine.oracle import ExpandEngine
+
+        graph = build_synth(n_users=32, n_groups=4, n_folders=16, n_docs=64)
+        doc = next(
+            t for t in graph.store.all_tuples() if t.relation == "viewers"
+        )
+        dup = RelationTuple.from_string(
+            f"{doc.namespace}:{doc.object}#viewers@twice"
+        )
+        graph.store.write_relation_tuples(dup)
+        eng = DeviceCheckEngine(graph.store, graph.manager)
+        eng.snapshot()
+        graph.store.write_relation_tuples(dup)
+        s = SubjectSet(doc.namespace, doc.object, "viewers")
+        out = eng.batch_expand([s])
+        oracle = ExpandEngine(graph.store, max_depth=eng.max_depth)
+        assert _trees_equal(out[0], oracle.build_tree(s))
+        assert str(out[0].to_json()).count("twice") == 2
