@@ -31,12 +31,13 @@ from ketotpu.engine.vocab import Interner, Vocab
 #: bump on ANY structural change to the serialized snapshot layout
 #: (v2: node/membership hash tables build at SNAPSHOT_PROBE=4 — a v1
 #: checkpoint's deeper-bucket tables would silently miss entries under
-#: the shallower lookup unroll)
-SNAPSHOT_FORMAT = 2
+#: the shallower lookup unroll; v3: err_reach closure table added for
+#: the algebra path's short-circuit gate)
+SNAPSHOT_FORMAT = 3
 
 _SCALARS = ("num_rels", "n_nodes", "n_edges", "n_tuples", "version")
 _ARRAYS = (
-    "taint", "node_hi", "node_lo", "row_ptr",
+    "taint", "err_reach", "node_hi", "node_lo", "row_ptr",
     "edge_ns", "edge_obj", "edge_rel", "edge_node",
     "mem_node", "mem_subj", "mem_row_ptr", "mem_ord_subj",
     "sub_ns", "sub_obj", "sub_rel",

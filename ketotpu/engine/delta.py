@@ -320,7 +320,7 @@ def build_snapshot_cols(
     flat = compile_flat_tables(
         manager, vocab, strict=strict, num_ns=num_ns, num_rel=num_rels
     )
-    taint = _compute_taint(flat, op, dyn, num_ns, num_rels)
+    taint, err_reach = _compute_taint(flat, op, dyn, num_ns, num_rels)
 
     node_tab = hashtab.build_table(
         node_hi[:n_nodes].astype(np.int64),
@@ -338,6 +338,7 @@ def build_snapshot_cols(
         op=op,
         flat=flat,
         taint=taint,
+        err_reach=err_reach,
         num_rels=num_rels,
         node_hi=node_hi,
         node_lo=node_lo,

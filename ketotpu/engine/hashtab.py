@@ -96,7 +96,11 @@ def build_table(
     key_b: np.ndarray,
     val: Optional[np.ndarray] = None,
     *,
-    min_buckets: int = 16,
+    # floor raised 16->128 so every toy-scale table (tests, fuzz seeds)
+    # lands on ONE shape: distinct shapes mean distinct XLA programs,
+    # and per-config recompiles are the suite's dominant cost AND the
+    # trigger for the XLA:CPU compile-load crash (tests/conftest.py)
+    min_buckets: int = 128,
     probe: int = PROBE,
     fixed_shape: Optional[Tuple[int, int]] = None,
 ) -> Dict[str, np.ndarray]:
@@ -146,8 +150,16 @@ def build_table(
             # build paying bucket doubling (the 10M-scale projection cliff)
             probe_eff, salt_i, h, counts = best
             break
+    if n <= 512 and fixed_shape is None:
+        # pin the probe depth (== the pw array SHAPE) for small tables:
+        # the achieved max-bucket is data-dependent (1 vs 2 vs 3 on a few
+        # dozen keys), and a different pw shape is a different jitted
+        # program — toy configs (tests, fuzz seeds) must share one
+        # compile.  Costs at most probe-1 extra unrolled gather rounds on
+        # tables this small; the 10M-scale adaptive depth is untouched.
+        probe_eff = max(probe_eff, probe)
     order = np.argsort(h, kind="stable") if n else np.zeros(0, np.int64)
-    cap = fixed_shape[1] if fixed_shape is not None else _bucket_pow2(max(n, 1), 16)
+    cap = fixed_shape[1] if fixed_shape is not None else _bucket_pow2(max(n, 1), 64)
     ta = np.full(cap, -1, np.int32)
     tb = np.full(cap, -1, np.int32)
     ta[:n] = key_a[order]

@@ -283,8 +283,8 @@ def compile_flat_tables(
                 else:
                     impure[ns_id, rel_id] = True
 
-    kc = _bucket(max((len(c) for c, _ in entries.values()), default=1), 1)
-    kt = _bucket(max((len(t) for _, t in entries.values()), default=1), 1)
+    kc = _bucket(max((len(c) for c, _ in entries.values()), default=1), 4)
+    kt = _bucket(max((len(t) for _, t in entries.values()), default=1), 4)
     css_rel = np.full((num_ns, num_rel, kc), -1, np.int32)
     css_dec = np.zeros((num_ns, num_rel, kc), np.int32)
     css_probe = np.zeros((num_ns, num_rel, kc), bool)
@@ -371,25 +371,25 @@ def compile_op_table(
         prog_root[ns_id, rel_id] = root
 
     num_p = len(b.p_kind)
-    ppad = _bucket(max(num_p, 1), 8)
+    ppad = _bucket(max(num_p, 1), 64)
     child_ptr = np.zeros(ppad + 1, np.int32)
     for i, ch in enumerate(b.p_children):
         child_ptr[i + 1] = child_ptr[i] + len(ch)
     child_ptr[num_p:] = child_ptr[num_p]
     n_child = int(child_ptr[num_p])
-    cpad = _bucket(max(n_child, 1), 8)
+    cpad = _bucket(max(n_child, 1), 128)
     child_idx = np.zeros(cpad, np.int32)
     child_dec = np.zeros(cpad, np.int32)
     child_idx[:n_child] = [c for ch in b.p_children for c in ch]
     child_dec[:n_child] = [d for ds in b.p_child_decs for d in ds]
 
-    bpad = _bucket(max(len(b.b_rows), 1), 4)
+    bpad = _bucket(max(len(b.b_rows), 1), 16)
     b_ptr = np.zeros(bpad + 1, np.int32)
     for i, row in enumerate(b.b_rows):
         b_ptr[i + 1] = b_ptr[i] + len(row)
     b_ptr[len(b.b_rows):] = b_ptr[len(b.b_rows)]
     n_brel = int(b_ptr[len(b.b_rows)])
-    btpad = _bucket(max(n_brel, 1), 8)
+    btpad = _bucket(max(n_brel, 1), 64)
     b_rel = np.zeros(btpad, np.int32)
     b_probe = np.zeros(btpad, bool)
     b_rel[:n_brel] = [r for row in b.b_rows for r in row]

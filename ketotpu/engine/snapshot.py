@@ -78,6 +78,12 @@ class Snapshot:
     node_tab: Dict[str, np.ndarray] = None  # hash table (hi, lo) -> node id
     mem_tab: Dict[str, np.ndarray] = None  # hash set of (node, subject)
 
+    # bool[NS, R]: relation can reach a client-error lookup (err-only
+    # closure, a subset of taint).  The algebra path's direct-hit
+    # short-circuit is legal only where this is False — a device IS must
+    # never hide an error the oracle would raise (engine/algebra.py).
+    err_reach: np.ndarray = None
+
     # membership CSR over nodes (device Expand: a row's full member list,
     # leaf subjects included — the CSR above holds only subject-set edges).
     # mem_ord_subj is grouped by node in INSERTION order within each row
@@ -132,6 +138,15 @@ class Snapshot:
             "prog_root": self.op.prog_root,
             "rel_err": self.op.rel_err,
             "can_sset": self.op.can_sset,
+            # algebra-path routing tables (engine/algebra.py): tainted
+            # subchecks expand as tree tasks, pure ones delegate to the
+            # fused BFS; err_reach gates the IS short-circuit
+            "taint": self.taint,
+            "err_reach": (
+                self.err_reach
+                if self.err_reach is not None
+                else np.ones_like(self.taint)
+            ),
         }
 
     def node_key(self, ns_id: int, obj_id: int, rel_id: int):
@@ -179,16 +194,21 @@ def _compute_taint(
                     src.append(base)
                     dst.append(ens * num_rel + tgt)
     taint = (flat.impure | op.rel_err).ravel().copy()
+    # err-only closure (subset of taint): gates the algebra path's IS
+    # short-circuit — a subtree that cannot raise may be pruned on a
+    # direct hit, one that can must evaluate so the oracle owns the raise
+    err_reach = op.rel_err.ravel().copy()
     if src:
         src_a = np.asarray(src, np.int64)
         dst_a = np.asarray(dst, np.int64)
-        for _ in range(num_ns * num_rel):
-            new = taint.copy()
-            np.logical_or.at(new, src_a, taint[dst_a])
-            if (new == taint).all():
-                break
-            taint = new
-    return taint.reshape(num_ns, num_rel)
+        for seeds in (taint, err_reach):
+            for _ in range(num_ns * num_rel):
+                new = seeds.copy()
+                np.logical_or.at(new, src_a, seeds[dst_a])
+                if (new == seeds).all():
+                    break
+                seeds[:] = new
+    return taint.reshape(num_ns, num_rel), err_reach.reshape(num_ns, num_rel)
 
 
 def build_snapshot(
@@ -309,7 +329,7 @@ def build_snapshot(
     flat = compile_flat_tables(
         manager, vocab, strict=strict, num_ns=num_ns, num_rel=num_rels
     )
-    taint = _compute_taint(flat, op, dyn_pairs, num_ns, num_rels)
+    taint, err_reach = _compute_taint(flat, op, dyn_pairs, num_ns, num_rels)
 
     # O(1) device lookups (see hashtab.py)
     node_tab = build_table(
@@ -329,6 +349,7 @@ def build_snapshot(
         op=op,
         flat=flat,
         taint=taint,
+        err_reach=err_reach,
         num_rels=num_rels,
         node_hi=node_hi,
         node_lo=node_lo,

@@ -14,8 +14,10 @@ answers allow/deny.  Internally it
    * **fast path** (`fastpath.run_fast`) — pure-OR rewrite closure:
      depth-bounded reachability with a monotone found-bit, `max_depth`
      async device steps, no host syncs;
-   * **general path** (`device.run_batch`) — relations that can reach
-     AND / NOT: the task-tree interpreter with three-valued propagation;
+   * **general path** (`algebra.run_general_packed`) — relations that can
+     reach AND / NOT: one fused leveled program that builds the algebra
+     skeleton, delegates every pure-OR subtree to the fast path's BFS,
+     and resolves combiners bottom-up (three-valued semantics);
    * **host path** — queries whose top-level lookup is a client error
      (namespace/definitions.go:61): the oracle raises the reference's
      exact typed error;
@@ -47,6 +49,7 @@ import jax
 import numpy as np
 
 from ketotpu.api.types import RelationTuple
+from ketotpu.engine import algebra as alg
 from ketotpu.engine import delta as dl
 from ketotpu.engine import device as dev
 from ketotpu.engine import fastpath as fp
@@ -66,6 +69,34 @@ def _bucket(n: int, floor: int = 256) -> int:
     while b < n:
         b *= 2
     return b
+
+
+def _bucket15(n: int, floor: int = 64) -> int:
+    """Smallest of {2^k, 1.5*2^k} >= n: pow2 rounding wastes up to ~50%
+    of every buffer (and per-level device cost scales with buffer size);
+    the half-octave step bounds waste at ~33% while adding at most one
+    extra compile variant per octave."""
+    b = floor
+    while b < n:
+        if b * 3 // 2 >= n:
+            return b * 3 // 2
+        b *= 2
+    return b
+
+
+#: per-level task multipliers (units of general roots) for the algebra
+#: skeleton: level 1 holds the rewrite roots plus root expansion edges,
+#: the prog structure fans out over the next few levels, then tainted
+#: recursion thins out (pure subtrees leave the skeleton as fast leaves)
+_GEN_MULT_HEAD = (3, 4, 4, 4, 3, 3, 2, 2, 2, 2)
+
+
+def _gen_mults(d: int):
+    return tuple(
+        _GEN_MULT_HEAD[i] if i < len(_GEN_MULT_HEAD) else 1 for i in range(d)
+    )
+
+
 
 
 def config_fingerprint(manager: Optional[NamespaceManager]) -> int:
@@ -103,9 +134,10 @@ class DeviceCheckEngine:
         cap: int = 8192,
         gen_arena: int = 8192,
         vcap: int = 4096,
-        max_iters: int = 64,
         max_batch: int = 8192,
         retry_scale: int = 4,
+        gen_levels: int = 12,
+        gen_levels_max: int = 24,
     ):
         self.store = store
         self.namespace_manager = namespace_manager
@@ -117,7 +149,8 @@ class DeviceCheckEngine:
         self.cap = cap  # general-path task capacity
         self.gen_arena = gen_arena
         self.vcap = vcap
-        self.max_iters = max_iters
+        self.gen_levels = gen_levels
+        self.gen_levels_max = gen_levels_max
         self.max_batch = min(max_batch, frontier)
         self.oracle = CheckEngine(
             store,
@@ -152,6 +185,16 @@ class DeviceCheckEngine:
         # per-level device cost scales with buffer sizes, and the retry
         # tier catches any underestimate (monotone over bits).
         self._occ_ema: Optional[np.ndarray] = None
+        # general-path (algebra) occupancy EMAs: skeleton per-level tasks
+        # per root, fast leaves per root, BFS sub-run per-level occupancy
+        self._gen_occ_ema: Optional[np.ndarray] = None
+        self._gen_fast_ema: Optional[float] = None
+        self._gen_fast_occ_ema: Optional[np.ndarray] = None
+        self._gen_sched_cache: dict = {}
+        # guards the schedule cache + gen EMAs: two serving threads racing
+        # _gen_schedule before the freeze landed would mint two distinct
+        # fused programs (a multi-minute recompile on a tunneled chip)
+        self._gen_lock = threading.Lock()
         # measured batch-to-batch occupancy variance on the synth workloads
         # is a few %; underestimates cost one retry dispatch for the
         # overflow tail, so a tight margin wins
@@ -227,6 +270,7 @@ class DeviceCheckEngine:
         self._overlay_active = False
         self._install_device_arrays()
         self.rebuilds += 1
+        self._gen_sched_cache.clear()  # new graph, re-adapt once
         if self.checkpoint_path:
             from ketotpu.engine import checkpoint as ckpt
 
@@ -538,49 +582,135 @@ class DeviceCheckEngine:
             gres = self._run_general(dev_arrays, enc, gi)
         return (enc, err, general, res, gi, gres, dev_arrays, occ)
 
-    #: task-tree slots budgeted per general root.  The interpreter's task
-    #: buffer is a bump allocator (tasks are never freed), so this bounds
-    #: the TOTAL tree a root may allocate across all levels — measured
-    #: 64-128 on Drive-style chain graphs (an `edit = !banned && view`
-    #: root walks the whole view closure: per folder hop a prog node, CSS
-    #: probes, expansion and TTU children)
-    GENERAL_TASKS_PER_ROOT = 128
+    def _gen_schedule(self, q: int, boost: int):
+        """Static shapes for one fused algebra dispatch (engine/algebra.py).
+
+        The level budget D is FIXED per tier (``gen_levels``, retry at
+        ``gen_levels_max``) rather than derived from the loaded config:
+        a config-dependent D made every namespace-config variant a brand
+        new fused program, and XLA:CPU dies under that compile load (the
+        fuzz suite compiles a fresh OPL per seed; see tests/conftest.py
+        on the codegen-split segfault).  Typical AND/NOT skeletons are
+        shallow — pure subtrees delegate to the BFS instead of consuming
+        levels — so tier 1 covers them; a root that exhausts it resolves
+        UNKNOWN+over, retries deeper, and only then falls back.
+        """
+        with self._gen_lock:
+            return self._gen_schedule_locked(q, boost)
+
+    def _gen_schedule_locked(self, q: int, boost: int):
+        cached = self._gen_sched_cache.get((q, boost))
+        if cached is not None:
+            return cached
+        D = self.gen_levels if boost <= 1 else self.gen_levels_max
+        cap = boost * self.gen_arena
+        adaptive = (
+            boost <= 1
+            and self._gen_occ_ema is not None
+            and not os.environ.get("KETO_NO_ADAPTIVE")
+        )
+        if adaptive:
+            # direct demand sizing: per-level skeleton capacity = measured
+            # tasks-per-root x headroom, half-octave bucketed.  The freeze
+            # below is what bounds compile variants, so no rung ladder is
+            # needed — and a ladder's coarse steps left the skeleton at
+            # near-worst-case sizes (measured ~5x the live demand, with
+            # every padded slot paying the multi-probe classification)
+            want = self._gen_occ_ema[:D] * self.occ_headroom
+            sizes = tuple(
+                int(min(_bucket15(max(int(np.ceil(w * q)), 64), 64), cap))
+                for w in want
+            )
+        else:
+            sizes = tuple(
+                int(min(_bucket15(m * q * boost, 64), cap))
+                for m in _gen_mults(D)
+            )
+        # fast-leaf buffer: measured leaves-per-root x headroom (default 2)
+        fmul = 2.0
+        if adaptive and self._gen_fast_ema is not None:
+            fmul = max(self._gen_fast_ema * self.occ_headroom, 1 / 16)
+        f_cap = boost * self.frontier
+        a_cap = boost * self.arena
+        fast_b = int(min(
+            _bucket15(int(np.ceil(fmul * q)) * boost, 256), f_cap
+        ))
+        if adaptive and self._gen_fast_occ_ema is not None:
+            # BFS levels demand-sized in units of roots (stable when
+            # fast_b itself adapts); level 0 is the leaf buffer
+            fls = [fast_b] + [
+                int(min(_bucket15(max(int(np.ceil(w * q)), 64), 64), f_cap))
+                for w in self._gen_fast_occ_ema[1:] * self.occ_headroom
+            ]
+            fast_sched = tuple(
+                (fl,
+                 fp.PROBE_ONLY_ARENA if i == len(fls) - 1
+                 else min(4 * fl if i == 0 else 2 * fl, a_cap))
+                for i, fl in enumerate(fls)
+            )
+        else:
+            fast_sched = fp.level_schedule(
+                fast_b, f_cap, a_cap, self.max_depth
+            )
+        out = (sizes, fast_b, fast_sched, boost * self.vcap)
+        if adaptive:
+            # FREEZE the first demand-adapted pick: the EMAs keep updating
+            # but must never mint another program shape — a schedule flip
+            # mid-serving costs a multi-minute recompile on a tunneled
+            # chip (observed landing inside a timed bench run).  Cleared
+            # on rebuild (workload regime changes come with new graphs).
+            self._gen_sched_cache[(q, boost)] = out
+        return out
+
+    def _update_gen_occ(self, occ: np.ndarray, fast_b: int) -> None:
+        """Fold one tier-1 algebra dispatch's occupancy vector into the
+        EMAs — all in units of active roots, so the feedback stays stable
+        as the adapted buffer sizes themselves change."""
+        D = self.gen_levels
+        roots = float(occ[0])
+        if roots <= 0:
+            return
+        lev = occ[1: D + 1].astype(np.float64) / roots
+        fleaves = float(occ[D + 1]) / roots
+        focc = occ[D + 2:].astype(np.float64) / roots
+        with self._gen_lock:
+            if self._gen_occ_ema is None or len(self._gen_occ_ema) != len(lev):
+                self._gen_occ_ema = lev
+                self._gen_fast_ema = fleaves
+                self._gen_fast_occ_ema = focc
+            else:
+                self._gen_occ_ema = 0.5 * self._gen_occ_ema + 0.5 * lev
+                self._gen_fast_ema = 0.5 * self._gen_fast_ema + 0.5 * fleaves
+                if len(focc) == len(self._gen_fast_occ_ema):
+                    self._gen_fast_occ_ema = (
+                        0.5 * self._gen_fast_occ_ema + 0.5 * focc
+                    )
+                else:
+                    self._gen_fast_occ_ema = focc
 
     def _run_general(self, dev_arrays, enc, gi, boost: int = 1):
-        """Dispatch general (AND/NOT) roots through the task-tree
-        interpreter in sub-batches sized so ``cap`` task slots and ``vcap``
-        visited slots are plausibly enough for every root — a whole-chunk
-        general batch (thousands of roots in an 8k-task arena) used to
-        overflow wholesale and drain to the sequential oracle.  Returns
-        (codes, over) aligned with ``gi``.
-
-        ``boost`` both widens the buffers and shrinks the sub-batch, so a
-        retry gives each root boost^2 the task budget of tier 1."""
-        cap = boost * self.cap
-        chunk = max(32, cap // self.GENERAL_TASKS_PER_ROOT // boost)
-        codes = np.empty(len(gi), np.int8)
-        over = np.empty(len(gi), bool)
-        for s in range(0, len(gi), chunk):
-            part = gi[s : s + chunk]
-            gpad = _bucket(len(part), 32)
-            genc = self._pad(tuple(a[part] for a in enc), len(part), gpad)
-            r = dev.run_batch(
-                dev_arrays,
-                *genc,
-                cap=cap,
-                arena=boost * self.gen_arena,
-                vcap=boost * self.vcap,
-                max_iters=self.max_iters,
-                max_width=self.max_width,
-                strict=self.strict_mode,
-                # 6 fused levels per dispatch: on a tunneled link the
-                # per-window flags sync (~75ms) dwarfs a few extra no-op
-                # steps, and typical trees resolve in 1-2 windows
-                steps_per_dispatch=6,
-            )
-            codes[s : s + chunk] = np.asarray(r.result)[: len(part)]
-            over[s : s + chunk] = np.asarray(r.overflow)[: len(part)]
-        return codes, over
+        """Enqueue ONE fused algebra dispatch for the general (AND/NOT)
+        roots — whole-chunk batches, no host round-trips (the round-3
+        host-stepped interpreter paid a flags sync per 6 levels and
+        ~128-task-slots-per-root sub-batching; VERDICT r3 #1).  Returns an
+        uncollected (codes, occ, n) device handle; ``boost`` widens every
+        capacity for the retry tier."""
+        n = len(gi)
+        qpad = min(_bucket(n, 256), self.max_batch)
+        genc = self._pad(tuple(a[gi] for a in enc), n, qpad)
+        active = np.arange(qpad) < n
+        qpack = np.stack([*genc, active.astype(np.int32)]).astype(np.int32)
+        sizes, fast_b, fast_sched, vcap = self._gen_schedule(qpad, boost)
+        codes, occ = alg.run_general_packed(
+            dev_arrays,
+            qpack,
+            sizes=sizes,
+            fast_b=fast_b,
+            fast_sched=fast_sched,
+            max_width=self.max_width,
+            vcap=vcap,
+        )
+        return codes, occ, n, fast_b
 
     def _collect(self, handle, retry: bool = True):
         """Sync one chunk's results; device-retry the fast-path overflow
@@ -594,7 +724,10 @@ class DeviceCheckEngine:
         fallback = err.copy()
 
         if gres is not None:
-            codes, gover = gres
+            packed = np.asarray(gres[0])[: gres[2]]  # one D2H fetch
+            self._update_gen_occ(np.asarray(gres[1]), gres[3])
+            codes = (packed & 3).astype(np.int8)
+            gover = ((packed >> 2) & 1).astype(bool)
             allowed[gi] = codes == dev.R_IS
             # overflow retry tier for the general path, mirroring the fast
             # path: re-run just the overflowed roots at boosted caps (small
@@ -603,9 +736,12 @@ class DeviceCheckEngine:
             if retry and gunres.any() and self.retry_scale > 1:
                 ri = gi[np.flatnonzero(gunres)]
                 self.retries += len(ri)
-                rcodes, rover = self._run_general(
+                rh = self._run_general(
                     dev_arrays, enc, ri, boost=self.retry_scale
                 )
+                rpacked = np.asarray(rh[0])[: rh[2]]
+                rcodes = (rpacked & 3).astype(np.int8)
+                rover = ((rpacked >> 2) & 1).astype(bool)
                 allowed[ri] = rcodes == dev.R_IS
                 gover[gunres] = rover | (rcodes == dev.R_ERR)
                 codes = codes.copy()

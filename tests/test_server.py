@@ -17,7 +17,7 @@ import urllib.request
 import grpc
 import pytest
 
-from ketotpu.api.types import RelationTuple
+from ketotpu.api.types import RelationTuple, SubjectID
 from ketotpu.driver import Provider, Registry
 from ketotpu.proto import (
     check_service_pb2 as cs,
@@ -548,34 +548,34 @@ def test_openapi_spec_matches_routes():
             assert (method.upper(), path) in routes, (method, path)
 
 
-def test_check_latest_forces_refresh(server, read_channel):
+def test_check_latest_serves_fresh_state_without_rebuild(server, read_channel):
     # CheckRequest.latest (check_service.proto:60-66): the engine must
-    # re-project before answering; rebuilds counter proves it ran
+    # answer against the freshest state — by draining the change log into
+    # the write-exact overlay, NOT a full reprojection (ADVICE r3: a
+    # latest=true client must not stall traffic behind a 10M-tuple
+    # rebuild; overlay probes are already exact).
     from ketotpu.proto import check_service_pb2 as cs
 
     eng = server.registry._device_engine()
+    eng.snapshot()  # absorb the fixture's seed writes (new vocab ids
+    # force a reprojection; this test is about the incremental path)
     before = eng.rebuilds
     stub = CheckServiceStub(read_channel)
+    # a write landed in the store but not yet in the device snapshot;
+    # every id is already interned (bob, File:private#owners pre-exist),
+    # so the O(delta) overlay can admit it without a reprojection
+    server.registry.store().write_relation_tuples(
+        RelationTuple("File", "private", "owners", SubjectID("bob"))
+    )
     resp = stub.Check(
         cs.CheckRequest(
             tuple=rts.RelationTuple(
-                namespace="File", object="keto/README.md", relation="view",
+                namespace="File", object="private", relation="view",
                 subject=rts.Subject(id="bob"),
             ),
             latest=True,
         ),
         timeout=60,
     )
-    assert resp.allowed is True
-    assert eng.rebuilds == before + 1
-    # without latest: no rebuild
-    stub.Check(
-        cs.CheckRequest(
-            tuple=rts.RelationTuple(
-                namespace="File", object="keto/README.md", relation="view",
-                subject=rts.Subject(id="bob"),
-            ),
-        ),
-        timeout=60,
-    )
-    assert eng.rebuilds == before + 1
+    assert resp.allowed is True  # the pending write is visible
+    assert eng.rebuilds == before  # ...without a full reprojection
