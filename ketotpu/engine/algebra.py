@@ -124,6 +124,7 @@ def _init_roots(qpack, Q: int) -> Dict[str, jax.Array]:
         qid=jnp.where(act, iota, -1),
         vscope=neg,
         parent=neg,
+        neg=jnp.zeros((Q,), bool),
     )
 
 
@@ -164,13 +165,37 @@ def _classify_level(g, t, q_subj):
     # direct counts at depth-1 with its own <=0 guard => d >= 2
     # (engine.go:242,:167-208); a forced probe ignores depth (it stands in
     # for the parent-side EXISTS / batched-CSS probe)
+    is_fast = active & (t["kind"] == K_FAST)
     seed = is_check & member & (t["force"] | (dok & (d >= 2)))
-    deg = jnp.where(is_check & eok & (d >= 2), _row_deg(g, node), 0)
+    deg = jnp.where(
+        (is_check | is_fast) & eok & (d >= 2), _row_deg(g, node), 0
+    )
     errable = cfg & g["err_reach"][nsc, relc]
     chk_count = jnp.where(d >= 1, has_rw.astype(i32) + deg, 0)
 
+    # trivial fast leaves — no rewrite program and no reachable
+    # subject-set edge — are a single membership probe; resolving them
+    # here keeps plain relations (e.g. a !banned operand) out of the BFS
+    # sub-batch entirely (the probes above are computed for every slot
+    # anyway, so this is free)
+    triv = is_fast & ~has_rw & (deg == 0)
+    found_t = member & (t["force"] | (dok & (d >= 2)))
+
+    # -- root-prog adoption -------------------------------------------------
+    # A CHECK whose only child would be its rewrite program (no direct
+    # seed, no expansion edges, no error) is OR-of-one: it may BECOME the
+    # program root in place — OR(x) = x for the IS/NOT/ERR a root
+    # combiner yields, and the or/and depth guard coincides with the
+    # CHECK's.  Saves one full skeleton level per general root.
+    adopt = (
+        is_check & ~err & ~seed & has_rw & (deg == 0) & (d >= 1)
+    )
+    is_check = is_check & ~adopt
+    is_prog = is_prog | adopt
+    prog_eff = jnp.where(adopt, prog_root, t["prog"])
+
     # -- rewrite-program nodes ---------------------------------------------
-    pp = jnp.clip(t["prog"], 0, P - 1)
+    pp = jnp.clip(prog_eff, 0, P - 1)
     pk = g["p_kind"][pp]
     p_deg = g["p_child_ptr"][pp + 1] - g["p_child_ptr"][pp]
     node_ttu = _node_lookup(g, ns, obj, g["p_a"][pp])
@@ -211,6 +236,12 @@ def _classify_level(g, t, q_subj):
          jnp.full((F,), R_UNKNOWN, i32)],
         jnp.where(r_empty, R_NOT, R_UNKNOWN),
     )
+    res = jnp.where(
+        triv,
+        jnp.where(found_t, R_IS, jnp.where(d >= 1, R_NOT, R_UNKNOWN)),
+        res,
+    )
+    resolved = resolved | triv
     cop = jnp.select(
         [p_oan & (pk == P_AND), p_not, p_css],
         [jnp.full((F,), OP_AND, i32), jnp.full((F,), OP_NOT, i32),
@@ -220,6 +251,10 @@ def _classify_level(g, t, q_subj):
 
     t = dict(
         t,
+        # persist root-prog adoption: the construction phase routes
+        # children by kind/prog
+        kind=jnp.where(adopt, K_PROG, t["kind"]),
+        prog=prog_eff,
         resolved=resolved,
         res=res,
         cop=cop,
@@ -348,6 +383,15 @@ def _construct_level(
     )
     prog_child = g["p_child_idx"][pci]
     prog_dec = g["p_child_dec"][pci]
+    prog_neg = g["p_child_neg"][pci]
+    # CSS hop collapse: a P_CSS node is a pure relation remap with no row
+    # gather of its own (child = CHECK(ns, obj, p_a) at the same depth,
+    # rewrites.go:208-230; its d<0 guard is subsumed by the CHECK's d<=0
+    # guard) — emitting the subcheck directly removes one skeleton level
+    # per computed-subject-set under AND/NOT
+    pk2 = g["p_kind"][jnp.clip(prog_child, 0, g["p_kind"].shape[0] - 1)]
+    c_cssdir = c_oan & (pk2 == P_CSS)
+    css_dir_rel = g["p_a"][jnp.clip(prog_child, 0, g["p_kind"].shape[0] - 1)]
 
     # batched-CSS row gathers
     bi = jnp.clip(
@@ -359,8 +403,8 @@ def _construct_level(
 
     ch_ns = jnp.where(c_edge | c_ttu, e_ns, pns)
     ch_obj = jnp.where(c_edge | c_ttu, e_obj, pobj)
-    ch_rel = jnp.select([c_edge, c_ttu, c_css, c_bat],
-                        [e_rel, ppb, ppa, brel], prel)
+    ch_rel = jnp.select([c_edge, c_ttu, c_css, c_bat, c_cssdir],
+                        [e_rel, ppb, ppa, brel, css_dir_rel], prel)
     # depth math: expansion / TTU / batched-CSS children at depth-1
     # (engine.go:245, rewrites.go:281,:86); nested rewrite children at
     # depth - dec (rewrites.go:118); rewrite root and CSS keep depth
@@ -370,9 +414,13 @@ def _construct_level(
         [pd - 1, pd - prog_dec],
         pd,
     )
-    ch_prog = jnp.select([c_rw, c_oan], [aux["prog_root"][aps], prog_child], -1)
+    ch_prog = jnp.select(
+        [c_rw, c_oan & ~c_cssdir], [aux["prog_root"][aps], prog_child], -1
+    )
     ch_skip = c_edge | c_bat  # skip_direct (engine.go:161, rewrites.go:86)
     ch_force = c_edge | (c_bat & bprobe)
+    # folded InvertResult parity: flips the child's verdict on delivery
+    ch_neg = c_oan & prog_neg
     # visited scope: expansion children open a scope at the first
     # expanding ancestor (engine.go:119); slot ids are globally unique
     # via the static level base
@@ -385,7 +433,7 @@ def _construct_level(
     in_cfg = (ch_ns >= 0) & (ch_ns < NS) & (ch_rel >= 0) & (ch_rel < R)
     tainted = in_cfg & g["taint"][ch_nsc, ch_relc]
     ch_kind = jnp.where(
-        c_rw | c_oan,
+        c_rw | (c_oan & ~c_cssdir),
         K_PROG,
         jnp.where(tainted, K_CHECK, K_FAST),
     )
@@ -421,6 +469,7 @@ def _construct_level(
         qid=jnp.where(valid, pqid, -1),
         vscope=jnp.where(valid, ch_vscope, -1),
         parent=jnp.where(valid, ap, neg),
+        neg=valid & ch_neg,
     )
     return t, child, vset, q_over
 
@@ -441,7 +490,8 @@ def _collect_fast(levels, q_subj, q_over, B: int, Q: int):
     base = jnp.int32(0)
     out_levels = []
     for t in levels:
-        m = (t["kind"] == K_FAST) & (t["qid"] >= 0)
+        # trivially-resolved leaves (no rewrite, no edges) stay out
+        m = (t["kind"] == K_FAST) & (t["qid"] >= 0) & ~t["resolved"]
         pos = base + jnp.cumsum(m.astype(i32)) - 1
         ok = m & (pos < B)
         tgt = jnp.where(ok, pos, B)
@@ -600,8 +650,12 @@ def run_general_packed(
         val = ch["qid"] >= 0
         pt = jnp.where(val, jnp.clip(ch["parent"], 0, Fp - 1), Fp)
         zero = jnp.zeros((Fp,), jnp.int32)
-        nis = zero.at[pt].add((ch["res"] == R_IS).astype(jnp.int32), mode="drop")
-        nnot = zero.at[pt].add((ch["res"] == R_NOT).astype(jnp.int32), mode="drop")
+        # folded-NOT parity: a negated edge delivers IS as NOT and vice
+        # versa; UNKNOWN and ERR pass through (rewrites.go:186-200)
+        eff_is = jnp.where(ch["neg"], ch["res"] == R_NOT, ch["res"] == R_IS)
+        eff_not = jnp.where(ch["neg"], ch["res"] == R_IS, ch["res"] == R_NOT)
+        nis = zero.at[pt].add(eff_is.astype(jnp.int32), mode="drop")
+        nnot = zero.at[pt].add(eff_not.astype(jnp.int32), mode="drop")
         nerr = zero.at[pt].add((ch["res"] == R_ERR).astype(jnp.int32), mode="drop")
         unres = (par["qid"] >= 0) & ~par["resolved"]
         val_or = jnp.where((nis > 0) | par["seed"], R_IS, R_NOT)

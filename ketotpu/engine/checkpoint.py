@@ -32,8 +32,10 @@ from ketotpu.engine.vocab import Interner, Vocab
 #: (v2: node/membership hash tables build at SNAPSHOT_PROBE=4 — a v1
 #: checkpoint's deeper-bucket tables would silently miss entries under
 #: the shallower lookup unroll; v3: err_reach closure table added for
-#: the algebra path's short-circuit gate)
-SNAPSHOT_FORMAT = 3
+#: the algebra path's short-circuit gate; v4: InvertResult folds into
+#: the p_child_neg edge-parity column — a v3 OpTable still has P_NOT
+#: nodes the folded interpreters would mis-handle)
+SNAPSHOT_FORMAT = 4
 
 _SCALARS = ("num_rels", "n_nodes", "n_edges", "n_tuples", "version")
 _ARRAYS = (

@@ -138,6 +138,9 @@ def init_state(
         nnot=jnp.zeros((cap,), jnp.int32),
         nerr=jnp.zeros((cap,), jnp.int32),
         delivered=jnp.zeros((cap,), bool),
+        # verdict inverted on delivery (the folded InvertResult parity,
+        # optable.p_child_neg: IS<->NOT, UNKNOWN/ERR preserved)
+        neg=jnp.zeros((cap,), bool),
     )
     return dict(
         T=T,
@@ -168,9 +171,13 @@ def _propagate(T, q_over, Q, cap, iota, passes: int):
         deliver = (T["state"] == S_DONE) & ~T["delivered"] & (T["parent"] >= 0)
         d32 = deliver.astype(jnp.int32)
         T = dict(T)
+        # folded-NOT parity: a negated edge delivers IS as NOT and vice
+        # versa; UNKNOWN and ERR pass through (rewrites.go:186-200)
+        eff_is = jnp.where(T["neg"], T["result"] == R_NOT, T["result"] == R_IS)
+        eff_not = jnp.where(T["neg"], T["result"] == R_IS, T["result"] == R_NOT)
         T["ndone"] = T["ndone"].at[psafe].add(d32)
-        T["nis"] = T["nis"].at[psafe].add(d32 * (T["result"] == R_IS))
-        T["nnot"] = T["nnot"].at[psafe].add(d32 * (T["result"] == R_NOT))
+        T["nis"] = T["nis"].at[psafe].add(d32 * eff_is)
+        T["nnot"] = T["nnot"].at[psafe].add(d32 * eff_not)
         T["nerr"] = T["nerr"].at[psafe].add(d32 * (T["result"] == R_ERR))
         T["delivered"] = T["delivered"] | deliver
 
@@ -419,6 +426,7 @@ def check_step(
     )
     prog_child = g["p_child_idx"][pci]
     prog_dec = g["p_child_dec"][pci]
+    prog_neg = g["p_child_neg"][pci]
 
     # batch CSR gathers
     bbase = g["b_ptr"][jnp.clip(g["p_a"][pp], 0, g["b_ptr"].shape[0] - 2)]
@@ -568,6 +576,7 @@ def check_step(
     T["vscope"] = scat(T["vscope"], ch_vscope)
     T["parent"] = scat(T["parent"], ap)
     T["prog"] = scat(T["prog"], ch_prog)
+    T["neg"] = scat(T["neg"], c_or_and_not & prog_neg)
     for f in ("nchild", "ndone", "nis", "nnot", "nerr"):
         T[f] = scat(T[f], jnp.zeros_like(newpos))
     T["delivered"] = scat(T["delivered"], jnp.zeros_like(newpos, dtype=bool))

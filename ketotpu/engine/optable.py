@@ -68,6 +68,14 @@ class OpTable:
     prog_root: np.ndarray  # int32[NS, R]: -1 = no rewrite
     rel_err: np.ndarray  # bool[NS, R]: lookup raises "relation does not exist"
     can_sset: np.ndarray  # bool[NS, R]: strict-mode subject-set expansion gate
+    # bool[C]: the child's verdict is INVERTED on delivery (IS<->NOT,
+    # UNKNOWN preserved — rewrites.go:186-195).  InvertResult nodes fold
+    # into this edge flag at compile time: a NOT node is a pure one-child
+    # pass-through (depth dec 0, no guard a child's own guard does not
+    # subsume), so folding removes a whole task level per negation
+    # without changing any verdict.  (Defaulted last for dataclass field
+    # ordering; compile_op_table always fills it.)
+    p_child_neg: np.ndarray = None
 
 
 @dataclass
@@ -77,6 +85,7 @@ class _Builder:
     p_b: List[int] = field(default_factory=list)
     p_children: List[List[int]] = field(default_factory=list)
     p_child_decs: List[List[int]] = field(default_factory=list)
+    p_child_negs: List[List[bool]] = field(default_factory=list)
     b_rows: List[List[int]] = field(default_factory=list)
     b_probes: List[List[bool]] = field(default_factory=list)
 
@@ -86,6 +95,7 @@ class _Builder:
         self.p_b.append(b)
         self.p_children.append([])
         self.p_child_decs.append([])
+        self.p_child_negs.append([])
         return len(self.p_kind) - 1
 
 
@@ -99,23 +109,28 @@ def _has_own_rewrite(ns: ast.Namespace, relation: str) -> bool:
 
 def _compile_child(
     b: _Builder, vocab: Vocab, ns: ast.Namespace, child: ast.Child, strict: bool
-) -> int:
+):
+    """Compile one rewrite child; returns (node index, negate-on-delivery).
+
+    InvertResult folds into the parity bit instead of a P_NOT node: NOT
+    keeps depth and has no guard its child's own guard does not subsume
+    (rewrites.go:136-200), so the edge flag is verdict-identical and the
+    interpreters skip a whole task level per negation.  Nested !!x folds
+    to parity 0.
+    """
+    if isinstance(child, ast.InvertResult):
+        inner, neg = _compile_child(b, vocab, ns, child.child, strict)
+        return inner, not neg
     if isinstance(child, ast.SubjectSetRewrite):
-        return _compile_rewrite(b, vocab, ns, child, strict)
+        return _compile_rewrite(b, vocab, ns, child, strict), False
     if isinstance(child, ast.ComputedSubjectSet):
-        return b.node(P_CSS, a=vocab.relations.intern(child.relation))
+        return b.node(P_CSS, a=vocab.relations.intern(child.relation)), False
     if isinstance(child, ast.TupleToSubjectSet):
         return b.node(
             P_TTU,
             a=vocab.relations.intern(child.relation),
             b=vocab.relations.intern(child.computed_subject_set_relation),
-        )
-    if isinstance(child, ast.InvertResult):
-        n = b.node(P_NOT)
-        c = _compile_child(b, vocab, ns, child.child, strict)
-        b.p_children[n].append(c)
-        b.p_child_decs[n].append(0)  # NOT children keep depth (rewrites.go:136-200)
-        return n
+        ), False
     raise TypeError(f"unknown rewrite child {type(child)!r}")
 
 
@@ -150,14 +165,21 @@ def _compile_rewrite(
             batch = b.node(P_BATCHCSS, a=row)
             b.p_children[n].append(batch)
             b.p_child_decs[n].append(0)
+            b.p_child_negs[n].append(False)
 
     for i, c in enumerate(rw.children):
         if i in handled:
             continue
-        ci = _compile_child(b, vocab, ns, c, strict)
+        ci, neg = _compile_child(b, vocab, ns, c, strict)
         b.p_children[n].append(ci)
-        # nested or/and recurse at depth-1 (rewrites.go:118); leaves keep depth
-        b.p_child_decs[n].append(1 if isinstance(c, ast.SubjectSetRewrite) else 0)
+        # nested or/and recurse at depth-1 (rewrites.go:118); leaves keep
+        # depth, and so do NOT-wrapped children of ANY shape — the
+        # reference's inverted path recurses at the same depth
+        # (rewrites.go:136-200, oracle._check_inverted)
+        b.p_child_decs[n].append(
+            1 if isinstance(c, ast.SubjectSetRewrite) else 0
+        )
+        b.p_child_negs[n].append(neg)
     return n
 
 
@@ -283,8 +305,13 @@ def compile_flat_tables(
                 else:
                     impure[ns_id, rel_id] = True
 
-    kc = _bucket(max((len(c) for c, _ in entries.values()), default=1), 4)
-    kt = _bucket(max((len(t) for _, t in entries.values()), default=1), 4)
+    # floors balance two costs: every unit of Kc/Kt is an unrolled
+    # probe loop in the hot BFS (arena-sized gathers per unit), while
+    # differing widths across configs mean distinct compiled programs
+    # (the fuzz suite's crash mode).  Floor 2/1 keeps every toy config
+    # on one shape without padding the bench config's real 2/1 widths.
+    kc = _bucket(max((len(c) for c, _ in entries.values()), default=1), 2)
+    kt = _bucket(max((len(t) for _, t in entries.values()), default=1), 1)
     css_rel = np.full((num_ns, num_rel, kc), -1, np.int32)
     css_dec = np.zeros((num_ns, num_rel, kc), np.int32)
     css_probe = np.zeros((num_ns, num_rel, kc), bool)
@@ -380,8 +407,10 @@ def compile_op_table(
     cpad = _bucket(max(n_child, 1), 128)
     child_idx = np.zeros(cpad, np.int32)
     child_dec = np.zeros(cpad, np.int32)
+    child_neg = np.zeros(cpad, bool)
     child_idx[:n_child] = [c for ch in b.p_children for c in ch]
     child_dec[:n_child] = [d for ds in b.p_child_decs for d in ds]
+    child_neg[:n_child] = [g for gs in b.p_child_negs for g in gs]
 
     bpad = _bucket(max(len(b.b_rows), 1), 16)
     b_ptr = np.zeros(bpad + 1, np.int32)
@@ -409,6 +438,7 @@ def compile_op_table(
         p_child_ptr=child_ptr,
         p_child_idx=child_idx,
         p_child_dec=child_dec,
+        p_child_neg=child_neg,
         b_ptr=b_ptr,
         b_rel=b_rel,
         b_probe=b_probe,

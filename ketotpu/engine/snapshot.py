@@ -132,6 +132,7 @@ class Snapshot:
             "p_child_ptr": self.op.p_child_ptr,
             "p_child_idx": self.op.p_child_idx,
             "p_child_dec": self.op.p_child_dec,
+            "p_child_neg": self.op.p_child_neg,
             "b_ptr": self.op.b_ptr,
             "b_rel": self.op.b_rel,
             "b_probe": self.op.b_probe,

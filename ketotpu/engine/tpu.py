@@ -652,7 +652,15 @@ class DeviceCheckEngine:
             fast_sched = fp.level_schedule(
                 fast_b, f_cap, a_cap, self.max_depth
             )
-        out = (sizes, fast_b, fast_sched, boost * self.vcap)
+        vcap = boost * self.vcap
+        if adaptive:
+            # the visited set serves tainted-rel expansion children only
+            # (typically a small fraction of the skeleton); its probe loop
+            # pays VS-sized claim scatters every level, so shrink the
+            # table toward demand — an overflow is a per-query over bit
+            # and a boosted retry, never a wrong verdict
+            vcap = int(min(vcap, max(1024, _bucket15(4 * q))))
+        out = (sizes, fast_b, fast_sched, vcap)
         if adaptive:
             # FREEZE the first demand-adapted pick: the EMAs keep updating
             # but must never mint another program shape — a schedule flip
@@ -696,7 +704,10 @@ class DeviceCheckEngine:
         uncollected (codes, occ, n) device handle; ``boost`` widens every
         capacity for the retry tier."""
         n = len(gi)
-        qpad = min(_bucket(n, 256), self.max_batch)
+        # half-octave padding: every buffer in the fused program scales
+        # with qpad, so pow2 rounding (e.g. 3046 -> 4096) taxed the whole
+        # dispatch ~33%
+        qpad = min(_bucket15(n, 256), self.max_batch)
         genc = self._pad(tuple(a[gi] for a in enc), n, qpad)
         active = np.arange(qpad) < n
         qpack = np.stack([*genc, active.astype(np.int32)]).astype(np.int32)

@@ -116,7 +116,7 @@ def _specs(q: int):
             k: P("data")
             for k in (
                 "state result qid kind ns obj rel depth skip vscope parent "
-                "prog cop nchild ndone nis nnot nerr delivered"
+                "prog cop nchild ndone nis nnot nerr delivered neg"
             ).split()
         },
         vset=(P("data"),) * 4,
