@@ -193,6 +193,8 @@ def main() -> None:
         tuples_10m=len(big.store),
         build_10m_s=round(build_s, 1),
         projection_s=round(projection_s, 1),
+        projection_build_s=round(beng.projection_build_s, 1),
+        projection_upload_s=round(beng.projection_upload_s, 1),
         hbm_bytes=hbm_bytes,
         checks_per_sec_10m=round(big_cps, 1),
         vs_baseline_10m=round(big_cps / baseline, 3),

@@ -198,6 +198,14 @@ class Registry:
                 network_id=nid,
                 extra_migrations=self.options.extra_migrations,
             )
+        if dsn.startswith(("postgres://", "postgresql://")):
+            from ketotpu.storage.postgres import PostgresTupleStore
+
+            return PostgresTupleStore(
+                dsn,
+                network_id=nid,
+                extra_migrations=self.options.extra_migrations,
+            )
         raise ConfigError("dsn", f"unsupported dsn {dsn!r}")
 
     def namespace_manager(self):

@@ -326,11 +326,11 @@ def build_snapshot_cols(
         node_hi[:n_nodes].astype(np.int64),
         node_lo[:n_nodes].astype(np.int64),
         np.arange(n_nodes, dtype=np.int32),
-        probe=hashtab.SNAPSHOT_PROBE,
+        lean=True, probe=2 * hashtab.SNAPSHOT_PROBE,
     )
     mem_tab = hashtab.build_table(
         mem_node_v.astype(np.int64), mem_subj_v.astype(np.int64),
-        probe=hashtab.SNAPSHOT_PROBE,
+        lean=True, probe=2 * hashtab.SNAPSHOT_PROBE,
     )
 
     snap = Snapshot(

@@ -101,6 +101,14 @@ def build_table(
     # and per-config recompiles are the suite's dominant cost AND the
     # trigger for the XLA:CPU compile-load crash (tests/conftest.py)
     min_buckets: int = 128,
+    # lean tables allocate ~n buckets instead of ~2n: at the 10M-tuple
+    # scale the bucket POINTER array alone is 134MB of (tunnel-bound)
+    # device upload per table, while the deeper buckets only add probe
+    # rounds — measured ~free on this path (r3: ablating all hash probes
+    # changed per-level time by ~0).  Pair with a probe bound the higher
+    # load factor can satisfy on the first salt, or the build burns the
+    # whole salt schedule (a bincount+mix per salt) before settling.
+    lean: bool = False,
     probe: int = PROBE,
     fixed_shape: Optional[Tuple[int, int]] = None,
 ) -> Dict[str, np.ndarray]:
@@ -125,7 +133,7 @@ def build_table(
         if n > fixed_shape[1]:
             raise ValueError(f"{n} entries exceed fixed cap {fixed_shape[1]}")
     else:
-        buckets = _bucket_pow2(max(2 * n, 1), min_buckets)
+        buckets = _bucket_pow2(max(n if lean else 2 * n, 1), min_buckets)
     salt_i = 0
     best = None  # flattest (max_bucket, salt_i, h, counts) seen
     probe_eff = probe

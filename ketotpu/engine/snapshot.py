@@ -41,6 +41,16 @@ from ketotpu.storage.namespaces import NamespaceManager
 
 _I32MAX = np.iinfo(np.int32).max
 
+#: arrays only the device Expand pass reads (expand_device.py) — shipped
+#: lazily on first batch_expand, so Check serving never pays their
+#: ~160MB upload at the 10M-tuple scale (the tunnel is the bottleneck)
+EXPAND_ONLY_KEYS = ("mem_row_ptr", "mem_ord_subj", "sub_ns", "sub_obj",
+                    "sub_rel")
+#: read only by the legacy task-tree interpreter (device.py, the mesh
+#: general tier) — the single-chip fastpath/algebra programs never
+#: gather it
+MESH_ONLY_KEYS = ("edge_node",)
+
 
 def _bucket(n: int, floor: int = 64) -> int:
     b = floor
@@ -149,6 +159,12 @@ class Snapshot:
                 else np.ones_like(self.taint)
             ),
         }
+
+    def check_arrays(self) -> Dict[str, np.ndarray]:
+        """arrays() minus the expand-only and mesh-interpreter-only
+        tables — the upload the single-chip Check path actually needs."""
+        skip = set(EXPAND_ONLY_KEYS) | set(MESH_ONLY_KEYS)
+        return {k: v for k, v in self.arrays().items() if k not in skip}
 
     def node_key(self, ns_id: int, obj_id: int, rel_id: int):
         return ns_id * self.num_rels + rel_id, obj_id
@@ -337,12 +353,12 @@ def build_snapshot(
         np.fromiter((k[0] for k in uniq), np.int64, n_nodes),
         np.fromiter((k[1] for k in uniq), np.int64, n_nodes),
         np.arange(n_nodes, dtype=np.int32),
-        probe=hashtab.SNAPSHOT_PROBE,
+        lean=True, probe=2 * hashtab.SNAPSHOT_PROBE,
     )
     mem_tab = build_table(
         np.fromiter((p[0] for p in pairs), np.int64, n_tuples),
         np.fromiter((p[1] for p in pairs), np.int64, n_tuples),
-        probe=hashtab.SNAPSHOT_PROBE,
+        lean=True, probe=2 * hashtab.SNAPSHOT_PROBE,
     )
 
     snap = Snapshot(

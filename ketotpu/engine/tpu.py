@@ -43,6 +43,7 @@ from __future__ import annotations
 import hashlib
 import os
 import threading
+import time
 from typing import List, Optional, Sequence
 
 import jax
@@ -202,6 +203,9 @@ class DeviceCheckEngine:
         self.fallbacks = 0  # observability: host-fallback counter
         self.retries = 0  # observability: device-retry (tier-2) counter
         self.rebuilds = 0  # observability: full snapshot rebuilds
+        self.projection_build_s = 0.0  # host-side snapshot build
+        self.projection_upload_s = 0.0  # device upload (blocked)
+        self._expand_extra = None  # lazily shipped expand tables
         self.overlay_applies = 0  # observability: O(delta) write applications
         # when set, every full rebuild refreshes this projection checkpoint
         # (engine/checkpoint.py); save failures count, never raise
@@ -257,6 +261,7 @@ class DeviceCheckEngine:
         self._log_cursor = head
 
     def _rebuild(self, fingerprint: int) -> None:
+        t0 = time.perf_counter()
         self._sync_cols()
         self._cols.compact()
         self._snap = dl.build_snapshot_cols(
@@ -265,10 +270,14 @@ class DeviceCheckEngine:
             strict=self.strict_mode,
             version=self.store.version,
         )
+        self.projection_build_s = time.perf_counter() - t0
         self._snap_fingerprint = fingerprint
         self._overlay = dl.OverlayState()
         self._overlay_active = False
+        t0 = time.perf_counter()
         self._install_device_arrays()
+        jax.block_until_ready(jax.tree_util.tree_leaves(self._device_arrays))
+        self.projection_upload_s = time.perf_counter() - t0
         self.rebuilds += 1
         self._gen_sched_cache.clear()  # new graph, re-adapt once
         if self.checkpoint_path:
@@ -290,7 +299,8 @@ class DeviceCheckEngine:
         before and after the first write — overlay activation must never
         trigger a recompile.  (The mesh engine overrides this: it ships
         sharded stacks instead and builds the replicated copy lazily.)"""
-        self._base_device = jax.device_put(self._snap.arrays())
+        self._base_device = jax.device_put(self._snap.check_arrays())
+        self._expand_extra = None  # expand-only tables ship on first use
         self._device_arrays = dict(
             self._base_device,
             **jax.device_put(
@@ -301,9 +311,18 @@ class DeviceCheckEngine:
         )
 
     def _expand_arrays(self):
-        """Device arrays for batch_expand (the mesh engine builds its
-        replicated copy lazily here)."""
-        return self._device_arrays
+        """Device arrays for batch_expand: the Check dict plus the
+        expand-only tables, shipped lazily — Check serving at 10M tuples
+        skips ~160MB of tunnel-bound upload this way.  (The mesh engine
+        overrides this with its replicated copy.)"""
+        if self._expand_extra is None:
+            from ketotpu.engine.snapshot import EXPAND_ONLY_KEYS
+
+            full = self._snap.arrays()
+            self._expand_extra = jax.device_put(
+                {k: full[k] for k in EXPAND_ONLY_KEYS}
+            )
+        return dict(self._device_arrays, **self._expand_extra)
 
     def snapshot(self) -> Snapshot:
         with self._sync_lock:

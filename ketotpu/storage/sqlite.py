@@ -166,6 +166,9 @@ class SQLiteReverseStore:
 class SQLiteTupleStore:
     """Durable Manager-contract store; one network id per handle."""
 
+    #: the dialect's migration set (subclasses substitute their DDL)
+    BASE_MIGRATIONS = MIGRATIONS
+
     def __init__(
         self,
         path: str = ":memory:",
@@ -181,7 +184,7 @@ class SQLiteTupleStore:
         # embedder migrations append after the built-ins (the reference's
         # MigrationBox merges keto + embedder migrations,
         # registry_default.go:247-273 / ketoctx WithExtraMigrations)
-        self.migrations = MIGRATIONS + list(extra_migrations)
+        self.migrations = type(self).BASE_MIGRATIONS + list(extra_migrations)
         self._log_cap = log_cap
         # trim probes walk O(log_cap) index entries; amortize them
         self._trim_interval = max(1, min(1024, log_cap // 4))
@@ -189,14 +192,12 @@ class SQLiteTupleStore:
         self._listeners: List[Callable[[int], None]] = []
         # autocommit connection; transactions are explicit (_tx) so that
         # (a) DDL participates in migration transactions and (b) multi-
-        # statement reads see one WAL snapshot even across handles
-        self._db = sqlite3.connect(
-            path, check_same_thread=False, isolation_level=None
-        )
-        self._db.execute("PRAGMA foreign_keys=ON")
-        if path != ":memory:":
-            self._db.execute("PRAGMA journal_mode=WAL")
-            self._db.execute("PRAGMA synchronous=NORMAL")
+        # statement reads see one WAL snapshot even across handles.
+        # _open is the dialect seam: the Postgres persister overrides it
+        # (and BASE_MIGRATIONS) while inheriting every query verbatim —
+        # the reference runs one persister over a DSN-selected dialect
+        # matrix the same way (internal/persistence/sql/full_test.go:32).
+        self._db = self._open(path)
         self._db.execute(
             """CREATE TABLE IF NOT EXISTS keto_migrations (
                 version TEXT PRIMARY KEY, applied_at REAL NOT NULL)"""
@@ -205,9 +206,21 @@ class SQLiteTupleStore:
         # (registry_default.go:316-327); file-backed stores migrate
         # explicitly via `keto-tpu migrate up` unless told otherwise
         if auto_migrate is None:
-            auto_migrate = path == ":memory:"
+            auto_migrate = self._default_auto_migrate(path)
         if auto_migrate:
             self.migrate_up()
+
+    def _open(self, path: str):
+        db = sqlite3.connect(path, check_same_thread=False, isolation_level=None)
+        db.execute("PRAGMA foreign_keys=ON")
+        if path != ":memory:":
+            db.execute("PRAGMA journal_mode=WAL")
+            db.execute("PRAGMA synchronous=NORMAL")
+        return db
+
+    @staticmethod
+    def _default_auto_migrate(path: str) -> bool:
+        return path == ":memory:"
 
     @contextmanager
     def _tx(self, mode: str = "DEFERRED"):

@@ -29,7 +29,7 @@ def _parity(store, manager, roots, rest_depth=0, **kw):
     snap = eng.snapshot()
     oracle = ExpandEngine(store, max_depth=eng.max_depth)
     trees, over = xd.run_expand(
-        eng._device_arrays, snap, roots, rest_depth,
+        eng._expand_arrays(), snap, roots, rest_depth,
         max_depth=eng.max_depth, **kw,
     )
     assert not over.any(), "unexpected overflow"
@@ -91,7 +91,7 @@ class TestParity:
         eng = DeviceCheckEngine(store, None)
         snap = eng.snapshot()
         trees, over = xd.run_expand(
-            eng._device_arrays, snap, [SubjectSet("g", "none", "m")], 0,
+            eng._expand_arrays(), snap, [SubjectSet("g", "none", "m")], 0,
             max_depth=eng.max_depth,
         )
         assert trees == [None] and not over.any()

@@ -151,8 +151,13 @@ class d implements Namespace {
     queries = [T(f"d:o{i}#finalize@u{i % 5}") for i in range(16)]
     enc = tuple(np.asarray(a) for a in eng._encode(eng.snapshot(), queries, 0))
     mesh = make_mesh(8)
+    # the interpreter needs edge_node, which the single-chip Check
+    # dict no longer ships (snapshot.MESH_ONLY_KEYS) - use the full set
+    import jax as _jax
+
     res = shard_batch_check(
-        eng._device_arrays, enc, mesh, cap=2048, arena=2048, vcap=1024
+        _jax.device_put(eng.snapshot().arrays()), enc, mesh,
+        cap=2048, arena=2048, vcap=1024,
     )
     got = (np.asarray(res.result) == 1).tolist()
     over = np.asarray(res.overflow)

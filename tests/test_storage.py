@@ -25,11 +25,30 @@ T = RelationTuple.from_string
 
 
 # the reference exports its persister suite to run over every configured
-# backend (manager_requirements.go:25, full_test.go); same pattern here
-@pytest.fixture(params=["memory", "sqlite"])
+# backend (manager_requirements.go:25, full_test.go); same pattern here.
+# Postgres is DSN-gated exactly like the reference's dialect matrix
+# (dsn_testutils.go:106-160): set KETO_TEST_PG_DSN to a live server (CI
+# provides a service container) or the param skips cleanly.
+@pytest.fixture(params=["memory", "sqlite", "postgres"])
 def store(request):
     if request.param == "memory":
         return InMemoryTupleStore()
+    if request.param == "postgres":
+        import os
+        import uuid
+
+        dsn = os.environ.get("KETO_TEST_PG_DSN")
+        if not dsn:
+            pytest.skip("KETO_TEST_PG_DSN not set")
+        from ketotpu.storage.postgres import PostgresTupleStore
+
+        # fresh network id per test: rows are nid-isolated, so the suite
+        # never needs to truncate shared tables
+        s = PostgresTupleStore(
+            dsn, network_id=f"t-{uuid.uuid4().hex[:12]}", auto_migrate=True
+        )
+        request.addfinalizer(s.close)
+        return s
     return SQLiteTupleStore(":memory:")
 
 
