@@ -109,6 +109,12 @@ def _defaults() -> Dict[str, Any]:
             "checkpoint": "",
         },
         "log": {"level": "info", "format": "text"},
+        # OTLP trace export (the otelx seam, registry_default.go:151-168):
+        # provider "otlp" ships spans/events to server_url + /v1/traces
+        "tracing": {
+            "provider": "",
+            "otlp": {"server_url": "", "flush_interval_ms": 2000},
+        },
     }
 
 

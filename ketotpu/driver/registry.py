@@ -107,7 +107,25 @@ class Registry:
     def tracer(self) -> Tracer:
         with self._lock:
             if self._tracer is None:
-                t = Tracer(self.metrics(), self.logger())
+                provider = str(self.config.get("tracing.provider", "") or "")
+                endpoint = str(
+                    self.config.get("tracing.otlp.server_url", "") or ""
+                )
+                if provider in ("otlp", "otel") and endpoint:
+                    from ketotpu.otlp import OTLPTracer
+
+                    t = OTLPTracer(
+                        endpoint,
+                        metrics=self.metrics(),
+                        logger=self.logger(),
+                        flush_interval=float(
+                            self.config.get(
+                                "tracing.otlp.flush_interval_ms", 2000
+                            )
+                        ) / 1000.0,
+                    )
+                else:
+                    t = Tracer(self.metrics(), self.logger())
                 if self.options.tracer_wrapper is not None:
                     t = self.options.tracer_wrapper(t)
                 self._tracer = t

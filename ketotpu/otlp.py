@@ -1,0 +1,197 @@
+"""OTLP/HTTP trace export for the homegrown tracer.
+
+The reference traces every layer through otelx (OpenTelemetry wired in
+`internal/driver/registry_default.go:151-168`, instrumented SQL in
+`persistence/sql/pop_connection.go:26-31`).  The repo's `Tracer`
+(observability.py) keeps the same span/event call sites but records only
+local histograms; this module adds the missing *export* half without new
+dependencies: an `OTLPTracer` subclass that builds OTLP/JSON trace
+payloads by hand and ships them to a collector's ``/v1/traces`` endpoint
+over HTTP on a background flusher thread.
+
+Call sites are unchanged — the registry swaps the tracer in when
+``tracing.provider: otlp`` is configured (`ketoctx.WithTracerWrapper``
+still wraps whatever tracer the registry builds, so embedders compose).
+
+Wire format: OTLP 1.x JSON (`opentelemetry-proto` ExportTraceServiceRequest
+with camelCase keys and hex-encoded ids), the encoding every OTLP/HTTP
+collector accepts alongside protobuf.  Export failures increment a
+counter and drop the batch — tracing must never take serving down.
+"""
+
+from __future__ import annotations
+
+import json
+import secrets
+import threading
+import time
+import urllib.request
+from contextlib import contextmanager
+from typing import Dict, List, Optional
+
+from ketotpu.observability import Metrics, Tracer
+
+
+def _attr(key: str, value) -> Dict:
+    if isinstance(value, bool):
+        return {"key": key, "value": {"boolValue": value}}
+    if isinstance(value, int):
+        return {"key": key, "value": {"intValue": str(value)}}
+    if isinstance(value, float):
+        return {"key": key, "value": {"doubleValue": value}}
+    return {"key": key, "value": {"stringValue": str(value)}}
+
+
+class OTLPTracer(Tracer):
+    """Tracer with OTLP/HTTP-JSON export.
+
+    Spans nest through a thread-local stack (children link to the
+    enclosing span and share its trace id); events attach to the current
+    span, or emit as zero-duration spans when none is open.
+    """
+
+    def __init__(
+        self,
+        endpoint: str,
+        *,
+        metrics: Optional[Metrics] = None,
+        logger=None,
+        service_name: str = "keto-tpu",
+        flush_interval: float = 2.0,
+        max_batch: int = 512,
+        max_queue: int = 8192,
+    ):
+        super().__init__(metrics, logger)
+        self.endpoint = endpoint.rstrip("/")
+        if not self.endpoint.endswith("/v1/traces"):
+            self.endpoint += "/v1/traces"
+        self.service_name = service_name
+        self.flush_interval = flush_interval
+        self.max_batch = max_batch
+        self.max_queue = max_queue
+        self.exported = 0
+        self.dropped = 0
+        self.export_errors = 0
+        self._q: List[Dict] = []
+        self._qlock = threading.Lock()
+        self._local = threading.local()
+        self._wake = threading.Event()
+        self._closed = False
+        self._flusher = threading.Thread(
+            target=self._run, name="otlp-flusher", daemon=True
+        )
+        self._flusher.start()
+
+    # -- tracer surface (call sites unchanged) ------------------------------
+
+    @contextmanager
+    def span(self, name: str, **attrs):
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        parent = stack[-1] if stack else None
+        rec = {
+            "traceId": parent["traceId"] if parent else secrets.token_hex(16),
+            "spanId": secrets.token_hex(8),
+            "name": name,
+            "kind": 1,  # SPAN_KIND_INTERNAL
+            "startTimeUnixNano": str(time.time_ns()),
+            "attributes": [_attr(k, v) for k, v in attrs.items()],
+            "events": [],
+        }
+        if parent is not None:
+            rec["parentSpanId"] = parent["spanId"]
+        stack.append(rec)
+        t0 = time.perf_counter()
+        try:
+            yield self
+        finally:
+            stack.pop()
+            rec["endTimeUnixNano"] = str(time.time_ns())
+            self._enqueue(rec)
+            # keep the local histogram behavior (observability.py)
+            if self.metrics is not None:
+                self.metrics.observe(
+                    "keto_span_duration_seconds",
+                    time.perf_counter() - t0,
+                    help="span wall time", span=name,
+                )
+
+    def event(self, name: str, **attrs):
+        super().event(name, **attrs)
+        stack = getattr(self._local, "stack", None)
+        if stack:
+            stack[-1]["events"].append({
+                "name": name,
+                "timeUnixNano": str(time.time_ns()),
+                "attributes": [_attr(k, v) for k, v in attrs.items()],
+            })
+            return
+        now = str(time.time_ns())
+        self._enqueue({
+            "traceId": secrets.token_hex(16),
+            "spanId": secrets.token_hex(8),
+            "name": name,
+            "kind": 1,
+            "startTimeUnixNano": now,
+            "endTimeUnixNano": now,
+            "attributes": [_attr(k, v) for k, v in attrs.items()],
+            "events": [],
+        })
+
+    # -- batching / export ---------------------------------------------------
+
+    def _enqueue(self, rec: Dict) -> None:
+        with self._qlock:
+            if len(self._q) >= self.max_queue:
+                self.dropped += 1
+                return
+            self._q.append(rec)
+            full = len(self._q) >= self.max_batch
+        if full:
+            self._wake.set()
+
+    def _run(self) -> None:
+        while not self._closed:
+            self._wake.wait(self.flush_interval)
+            self._wake.clear()
+            self.flush()
+
+    def flush(self) -> None:
+        """Ship everything queued; safe to call from tests/shutdown."""
+        with self._qlock:
+            batch, self._q = self._q, []
+        if not batch:
+            return
+        payload = {
+            "resourceSpans": [{
+                "resource": {
+                    "attributes": [_attr("service.name", self.service_name)],
+                },
+                "scopeSpans": [{
+                    "scope": {"name": "ketotpu"},
+                    "spans": batch,
+                }],
+            }]
+        }
+        req = urllib.request.Request(
+            self.endpoint,
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            urllib.request.urlopen(req, timeout=5).read()
+            self.exported += len(batch)
+        except Exception:  # noqa: BLE001 - export must never break serving
+            self.export_errors += 1
+            if self.metrics is not None:
+                self.metrics.counter(
+                    "keto_otlp_export_errors_total", 1,
+                    help="failed OTLP trace exports",
+                )
+
+    def close(self) -> None:
+        self._closed = True
+        self._wake.set()
+        self.flush()

@@ -205,3 +205,70 @@ def test_tenant_cache_is_bounded(tmp_path):
     assert reg.for_network("t0").store().all_tuples() == [
         T("doc:d#viewers@a")
     ]
+
+
+class TestOTLPExport:
+    def test_spans_and_events_ship_otlp_json(self):
+        """OTLP/HTTP export adapter (registry_default.go:151-168 parity):
+        spans nest, events attach, payload is valid OTLP JSON."""
+        import http.server
+        import json as _json
+        import threading
+
+        got = []
+
+        class Sink(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                body = self.rfile.read(int(self.headers["Content-Length"]))
+                got.append((self.path, _json.loads(body)))
+                self.send_response(200)
+                self.end_headers()
+                self.wfile.write(b"{}")
+
+            def log_message(self, *a):
+                pass
+
+        srv = http.server.HTTPServer(("127.0.0.1", 0), Sink)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        try:
+            from ketotpu.otlp import OTLPTracer
+
+            tr = OTLPTracer(
+                f"http://127.0.0.1:{srv.server_port}", flush_interval=60
+            )
+            with tr.span("check.Engine.CheckIsMember", depth=5):
+                with tr.span("inner"):
+                    tr.event("PermissionsChecked", allowed=True)
+            tr.flush()
+            assert tr.exported == 2 and tr.export_errors == 0
+            path, payload = got[0]
+            assert path == "/v1/traces"
+            spans = payload["resourceSpans"][0]["scopeSpans"][0]["spans"]
+            by_name = {s["name"]: s for s in spans}
+            outer = by_name["check.Engine.CheckIsMember"]
+            inner = by_name["inner"]
+            assert inner["parentSpanId"] == outer["spanId"]
+            assert inner["traceId"] == outer["traceId"]
+            assert inner["events"][0]["name"] == "PermissionsChecked"
+            assert int(outer["endTimeUnixNano"]) >= int(
+                outer["startTimeUnixNano"])
+        finally:
+            srv.shutdown()
+
+    def test_registry_builds_otlp_tracer_from_config(self):
+        from ketotpu.driver import Provider, Registry
+        from ketotpu.otlp import OTLPTracer
+
+        reg = Registry(Provider({
+            "tracing": {
+                "provider": "otlp",
+                "otlp": {"server_url": "http://127.0.0.1:9"},
+            },
+        }))
+        assert isinstance(reg.tracer(), OTLPTracer)
+        # export errors never raise into serving
+        with reg.tracer().span("x"):
+            pass
+        reg.tracer().flush()
+        assert reg.tracer().export_errors >= 1
