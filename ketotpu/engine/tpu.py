@@ -880,6 +880,14 @@ class DeviceCheckEngine:
                 if overlay_active else None
             )
         roots = [subjects[i] for i in set_idx]
+        if xarrays is None:
+            # mesh replica over budget: the oracle expands from the live
+            # store (exact), instead of silently materializing the whole
+            # graph on one device
+            for i in set_idx:
+                self.fallbacks += 1
+                out[i] = oracle.build_tree(subjects[i], rest_depth)
+            return out
         trees, over = xd.run_expand(
             xarrays, snap, roots, rest_depth,
             max_depth=self.max_depth, fanout=fanout, cap=cap,

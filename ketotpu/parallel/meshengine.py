@@ -24,8 +24,12 @@ the fast-path dispatch.  Sharded differences:
   come back ``dirty`` (psum-merged) and fall back to the host oracle.
 * **overflow retries on-device** at ``retry_scale``x frontier/arena
   before falling back — same two-tier story as the single-chip engine.
-* AND/NOT-reachable ("general") queries go straight to the host oracle —
-  the task-tree interpreter is single-device.
+* AND/NOT-reachable ("general") queries run the fused algebra program
+  (engine/algebra.py) **data-parallel** over a lazily-replicated,
+  budget-bounded copy of the graph (checks are independent — no
+  collectives on this axis); the host oracle is only the final fallback
+  (overflow, errors, pending-write overlays, or a graph too large for
+  the replica budget).
 """
 
 from __future__ import annotations
@@ -35,7 +39,8 @@ from typing import List, Optional
 import numpy as np
 
 from ketotpu.engine import delta as dl
-from ketotpu.engine.tpu import DeviceCheckEngine, _bucket
+from ketotpu.engine import device as dev
+from ketotpu.engine.tpu import DeviceCheckEngine, _bucket, _bucket15
 from ketotpu.parallel import graphshard
 from ketotpu.parallel.mesh import make_mesh
 
@@ -50,6 +55,7 @@ class MeshCheckEngine(DeviceCheckEngine):
         *,
         mesh_devices: int,
         mesh_axis: str = "shard",
+        replica_budget_mb: int = 8192,
         **kwargs,
     ):
         super().__init__(store, namespace_manager, **kwargs)
@@ -68,6 +74,12 @@ class MeshCheckEngine(DeviceCheckEngine):
         self._stacked_base = None
         self._shard_snaps: Optional[List] = None
         self._shard_overlays: Optional[List[dl.OverlayState]] = None
+        # ceiling on the lazily-replicated full-graph copy the general
+        # (AND/NOT) tier and batch_expand use: replication forfeits the
+        # per-device-memory-scales-down property, so past this budget
+        # those paths fall back to the host oracle instead of silently
+        # materializing the whole graph per device (VERDICT r3 #5/#6)
+        self.replica_budget_bytes = replica_budget_mb << 20
         # per-shard overlay table capacity; totals still bound by
         # max_overlay_pairs/max_overlay_dirty like the single-chip engine
         self.shard_pair_cap = max(self.max_overlay_pairs // mesh_devices, 256)
@@ -78,6 +90,7 @@ class MeshCheckEngine(DeviceCheckEngine):
         doesn't hold the whole graph next to its shard."""
         self._base_device = None
         self._device_arrays = None
+        self._expand_extra = None
         self._shard_snaps, self._stacked_base = (
             graphshard.build_sharded_snapshot(
                 self.store, self.namespace_manager, self.n_shards,
@@ -169,11 +182,19 @@ class MeshCheckEngine(DeviceCheckEngine):
         self._stacked = dict(self._stacked_base, **stacks)
         return True
 
-    def _expand_arrays(self):
+    def _replica_arrays(self):
+        """Bounded lazily-replicated Check arrays (+ overlay tables), or
+        None when the full graph would exceed ``replica_budget_bytes``
+        per device — callers fall back to the host oracle then."""
         if self._device_arrays is None:
             import jax
 
-            self._base_device = jax.device_put(self._snap.arrays())
+            est = sum(
+                v.nbytes for v in self._snap.check_arrays().values()
+            )
+            if est > self.replica_budget_bytes:
+                return None
+            self._base_device = jax.device_put(self._snap.check_arrays())
             self._device_arrays = dict(
                 self._base_device,
                 **jax.device_put(
@@ -184,6 +205,13 @@ class MeshCheckEngine(DeviceCheckEngine):
                 ),
             )
         return self._device_arrays
+
+    def _expand_arrays(self):
+        if self._replica_arrays() is None:
+            return None  # over budget: batch_expand goes to the oracle
+        # the expand-only tables extend the bounded replica lazily,
+        # exactly like the single-chip engine
+        return super()._expand_arrays()
 
     def _sharded_run(self, stacked, padded, active, boost: int = 1):
         return graphshard.sharded_check(
@@ -198,6 +226,31 @@ class MeshCheckEngine(DeviceCheckEngine):
             active=active,
         )
 
+    def _run_general_mesh(self, replica, enc, gi, boost: int = 1):
+        """One data-parallel fused algebra dispatch over the mesh for the
+        general (AND/NOT) roots — the single-chip program per device with
+        the query block sharded on the mesh axis (parallel/mesh.py
+        shard_general_check).  Returns (codes, occ_rows, n, fast_b)."""
+        from ketotpu.parallel.mesh import shard_general_check
+
+        n = len(gi)
+        # _bucket15 values at floor 256 divide by any power-of-two mesh
+        qpad = min(
+            _bucket15(max(n, self.n_shards), 256), self.max_batch
+        )
+        genc = self._pad(tuple(a[gi] for a in enc), n, qpad)
+        active = np.arange(qpad) < n
+        qpack = np.stack([*genc, active.astype(np.int32)]).astype(np.int32)
+        sizes, fast_b, fast_sched, vcap = self._gen_schedule(
+            qpad // self.n_shards, boost
+        )
+        codes, occ = shard_general_check(
+            replica, qpack, self.mesh, axis=self.mesh_axis,
+            sizes=sizes, fast_b=fast_b, fast_sched=fast_sched,
+            max_width=self.max_width, vcap=vcap,
+        )
+        return codes, occ, n, fast_b
+
     def _dispatch(self, queries, rest_depth: int):
         n = len(queries)
         if n == 0:
@@ -205,27 +258,63 @@ class MeshCheckEngine(DeviceCheckEngine):
         with self._sync_lock:
             snap = self._snapshot_locked()
             stacked = self._stacked
+            overlay_active = self._overlay_active
         enc = self._encode(snap, queries, rest_depth)
         err, general = self._classify(snap, enc[0], enc[2])
         qpad = min(_bucket(n), self.frontier)
         padded = self._pad(enc, n, qpad)
         active = np.pad(~(err | general), (0, qpad - n))
         res = self._sharded_run(stacked, padded, active)
-        # general queries are oracle work on this engine (see module doc)
-        return (enc, err | general, res, stacked)
+        gres = gi = None
+        replica = None
+        if general.any() and not overlay_active:
+            # general tier: the algebra program data-parallel over the
+            # bounded replica; the oracle is only the final fallback
+            replica = self._replica_arrays()
+        if replica is not None and general.any() and not overlay_active:
+            gi = np.flatnonzero(general)
+            gres = self._run_general_mesh(replica, enc, gi)
+        elif general.any():
+            err = err | general  # over budget / overlay: oracle answers
+            general = np.zeros_like(general)
+        return (enc, err, general, res, gi, gres, stacked, replica)
 
     def _collect(self, handle, retry: bool = True):
-        enc, fallback_mask, res, stacked = handle
+        enc, fallback_mask, general, res, gi, gres, stacked, replica = handle
         n = fallback_mask.shape[0]
         allowed = np.zeros(n, bool)
         fallback = fallback_mask.copy()
+
+        if gres is not None:
+            packed = np.asarray(gres[0])[: gres[2]]
+            self._update_gen_occ(
+                np.asarray(gres[1]).sum(axis=0), gres[3]
+            )
+            codes = (packed & 3).astype(np.int8)
+            gover = ((packed >> 2) & 1).astype(bool)
+            allowed[gi] = codes == dev.R_IS
+            gunres = gover & (codes != dev.R_ERR)
+            if retry and gunres.any() and self.retry_scale > 1:
+                ri = gi[np.flatnonzero(gunres)]
+                self.retries += len(ri)
+                rh = self._run_general_mesh(
+                    replica, enc, ri, boost=self.retry_scale
+                )
+                rpacked = np.asarray(rh[0])[: rh[2]]
+                rcodes = (rpacked & 3).astype(np.int8)
+                rover = ((rpacked >> 2) & 1).astype(bool)
+                allowed[ri] = rcodes == dev.R_IS
+                gover[gunres] = rover | (rcodes == dev.R_ERR)
+                codes = codes.copy()
+                codes[np.flatnonzero(gunres)] = rcodes
+            fallback[gi] |= gover | (codes == dev.R_ERR)
         found = np.asarray(res.found)[:n]
         over = np.asarray(res.over)[:n]
         dirty = (
             np.asarray(res.dirty)[:n]
             if res.dirty is not None else np.zeros(n, bool)
         )
-        fmask = ~fallback_mask
+        fmask = ~(fallback_mask | general)
         allowed[fmask] = found[fmask]
         # found is monotone and overlay-exact: a dirty/overflow brush only
         # voids not-yet-found queries

@@ -13,7 +13,7 @@ a real `jax.sharding.Mesh` and runs the multi-device paths the driver's
 import numpy as np
 import pytest
 
-from ketotpu.api.types import RelationTuple
+from ketotpu.api.types import RelationTuple, SubjectSet
 from ketotpu.engine.tpu import DeviceCheckEngine
 from ketotpu.parallel import (
     build_sharded_snapshot,
@@ -353,3 +353,83 @@ def test_mesh_engine_expand_sees_overlay_writes():
     )
     assert eng.rebuilds == rebuilds0, "expand write must ride the overlay"
     assert "mesh-newbie" in str(out[0].to_json())
+
+
+def test_mesh_engine_general_tier_on_device():
+    """VERDICT r3 #5: AND/NOT queries run the fused algebra program
+    data-parallel over the bounded replica — WITHOUT the host oracle."""
+    from ketotpu.opl.parser import parse
+    from ketotpu.parallel import MeshCheckEngine
+    from ketotpu.storage import StaticNamespaceManager
+
+    opl = """
+import { Namespace, Context } from "@ory/keto-namespace-types"
+class User implements Namespace {}
+class d implements Namespace {
+  related: { editors: User[], signers: User[] }
+  permits = {
+    finalize: (ctx: Context): boolean =>
+      this.related.editors.includes(ctx.subject) &&
+      this.related.signers.includes(ctx.subject),
+  }
+}
+"""
+    namespaces, errs = parse(opl)
+    assert not errs
+    store = InMemoryTupleStore()
+    store.write_relation_tuples(
+        *[T(f"d:o{i}#editors@u{i % 4}") for i in range(16)],
+        *[T(f"d:o{i}#signers@u{i % 3}") for i in range(16)],
+    )
+    eng = MeshCheckEngine(
+        store, StaticNamespaceManager(namespaces),
+        mesh_devices=8, frontier=512, arena=1024, gen_arena=2048, vcap=1024,
+    )
+    queries = [T(f"d:o{i}#finalize@u{i % 5}") for i in range(24)]
+    want = [eng.oracle.check_is_member(q) for q in queries]
+    fb0 = eng.fallbacks
+    allowed, fallback = eng.batch_check_device_only(queries)
+    assert not any(fallback), "general tier must answer on-device"
+    assert allowed == want
+    assert eng.fallbacks == fb0
+
+
+def test_mesh_engine_replica_budget_falls_back_to_oracle():
+    """Over-budget replicas must NOT materialize: general checks and
+    expand both answer via the oracle (exact), bounded memory."""
+    from ketotpu.opl.parser import parse
+    from ketotpu.parallel import MeshCheckEngine
+    from ketotpu.storage import StaticNamespaceManager
+
+    opl = """
+import { Namespace, Context } from "@ory/keto-namespace-types"
+class User implements Namespace {}
+class d implements Namespace {
+  related: { editors: User[], signers: User[] }
+  permits = {
+    finalize: (ctx: Context): boolean =>
+      this.related.editors.includes(ctx.subject) &&
+      this.related.signers.includes(ctx.subject),
+  }
+}
+"""
+    namespaces, errs = parse(opl)
+    assert not errs
+    store = InMemoryTupleStore()
+    store.write_relation_tuples(
+        *[T(f"d:o{i}#editors@u{i % 4}") for i in range(8)],
+        *[T(f"d:o{i}#signers@u{i % 3}") for i in range(8)],
+    )
+    eng = MeshCheckEngine(
+        store, StaticNamespaceManager(namespaces),
+        mesh_devices=8, frontier=512, arena=1024,
+        replica_budget_mb=0,  # nothing fits: always oracle
+    )
+    q = T("d:o1#finalize@u1")
+    want = eng.oracle.check_is_member(q)
+    allowed, fallback = eng.batch_check_device_only([q])
+    assert fallback == [True]  # routed to the oracle tier
+    assert eng.check(q) is want  # full path answers exactly
+    out = eng.batch_expand([SubjectSet("d", "o1", "editors")])
+    assert out[0] is not None  # oracle expand, no replica materialized
+    assert eng._device_arrays is None
