@@ -141,6 +141,29 @@ def main() -> None:
         general_fallbacks=eng.fallbacks - out["oracle_fallbacks"],
     )
 
+    # ---- 2b. engine-side wave latency (the p99 <= 2ms half of the metric)
+    # Device-only dispatch+collect timings per wave size, with the
+    # measured link floor subtracted: this is the engine-side budget the
+    # README used to claim in prose (VERDICT r3 #3) — on locally attached
+    # chips the wire adds microseconds, here the tunnel RTT dominates the
+    # raw number, so both raw and net-of-link are reported.
+    rtt_s = out["tunnel_rtt_ms"] / 1000.0
+    for wave in (1, 64, 256, 1024):
+        wq = queries[:wave]
+        eng.batch_check_device_only(wq, retry=False)
+        eng.batch_check_device_only(wq, retry=False)  # adaptive-shape warm
+        lats = []
+        for _ in range(20):
+            t0 = time.perf_counter()
+            eng.batch_check_device_only(wq, retry=False)
+            lats.append(time.perf_counter() - t0)
+        lats.sort()
+        p50 = lats[len(lats) // 2]
+        p99 = lats[min(len(lats) - 1, int(len(lats) * 0.99))]
+        out[f"wave{wave}_p50_ms"] = round(1000 * p50, 2)
+        out[f"engine_p50_ms_w{wave}"] = round(1000 * max(p50 - rtt_s, 0), 2)
+        out[f"engine_p99_ms_w{wave}"] = round(1000 * max(p99 - rtt_s, 0), 2)
+
     # ---- 3. Expand at depth 5 (BASELINE config #3) ------------------------
     from ketotpu.api.types import SubjectSet
 
@@ -199,6 +222,36 @@ def main() -> None:
         checks_per_sec_10m=round(big_cps, 1),
         vs_baseline_10m=round(big_cps / baseline, 3),
         device_fallback_rate_10m=round(float(np.mean(bfb)), 5),
+    )
+
+    # ---- 5b. configs #3/#4 AT SPEC SCALE (VERDICT r3 #4) ------------------
+    # mixed AND/NOT 10k batch against the 10M-tuple graph, not the 31k one
+    bmixed = synth_queries_mixed(big, 10_000, seed=9, general_frac=0.3)
+    beng.batch_check(bmixed)
+    beng.batch_check(bmixed)
+    t0 = time.perf_counter()
+    bgot = beng.batch_check(bmixed)
+    out["mixed_10k_checks_per_sec_10m"] = round(
+        len(bgot) / (time.perf_counter() - t0), 1
+    )
+    # depth-5 Expand over the >=1M-tuple Drive-style hierarchy (config #3
+    # says 1M; this runs it on the full 10.6M graph) — includes the lazy
+    # expand-table upload in the warm pass, not the timed one
+    fb1 = beng.fallbacks
+    rng2 = np.random.default_rng(11)
+    xroots = [
+        SubjectSet("Doc", big.docs[int(rng2.integers(len(big.docs)))], "parents")
+        for _ in range(512)
+    ]
+    beng.batch_expand(xroots[:64], 5)
+    t0 = time.perf_counter()
+    btrees = beng.batch_expand(xroots, 5)
+    dt = time.perf_counter() - t0
+    out.update(
+        expand_trees_per_sec_10m=round(len(btrees) / dt, 1),
+        expand_fallback_rate_10m=round(
+            (beng.fallbacks - fb1) / max(len(xroots) + 64, 1), 4
+        ),
     )
 
     print(json.dumps(out))

@@ -55,6 +55,11 @@ def cmd_serve(args) -> int:
     from ketotpu.driver import Provider, Registry
     from ketotpu.server import serve_all
 
+    if getattr(args, "worker_of", ""):
+        return cmd_serve_worker(args)
+    workers = int(getattr(args, "workers", 0) or 0)
+    if workers > 0:
+        return _serve_multiprocess(args, workers)
     cfg = Provider(config_file=args.config) if args.config else Provider()
     reg = Registry(cfg)
     reg.logger().info("initializing registry (engine warmup)")
@@ -64,6 +69,78 @@ def cmd_serve(args) -> int:
         srv.wait()
     except KeyboardInterrupt:
         reg.logger().info("shutting down gracefully")
+        srv.stop()
+    return 0
+
+
+def _serve_multiprocess(args, workers: int) -> int:
+    """--workers N: one device-owner process (this one) + N SO_REUSEPORT
+    worker daemons sharing the public ports (server/workers.py).
+
+    The owner holds the JAX device and the real engine and serves
+    batched check/expand over a unix socket; workers run the wire stack
+    with engine.kind=remote.  All processes share the durable store DSN
+    — a ``memory`` DSN cannot span processes and is refused."""
+    import subprocess
+    import sys as _sys
+    import tempfile
+
+    from ketotpu.driver import Provider, Registry
+    from ketotpu.server.workers import EngineHostServer
+
+    cfg = Provider(config_file=args.config) if args.config else Provider()
+    if cfg.dsn() == "memory":
+        print(
+            "serve --workers needs a shared durable dsn "
+            "(sqlite://<file> or postgres://...); 'memory' cannot span "
+            "processes",
+            file=_sys.stderr,
+        )
+        return 2
+    reg = Registry(cfg)
+    reg.logger().info("initializing device owner (engine warmup)")
+    reg.init()
+    sock = tempfile.mktemp(prefix="keto-engine-", suffix=".sock")
+    host = EngineHostServer(reg, sock).start()
+    reg.logger().info("engine host on %s; forking %d workers", sock, workers)
+    procs = [
+        subprocess.Popen([
+            _sys.executable, "-m", "ketotpu.cli", "serve",
+            *(["-c", args.config] if args.config else []),
+            "--worker-of", sock,
+        ])
+        for _ in range(workers)
+    ]
+    try:
+        for p in procs:
+            p.wait()
+    except KeyboardInterrupt:
+        reg.logger().info("shutting down workers")
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            p.wait(timeout=10)
+    finally:
+        host.stop()
+    return 0
+
+
+def cmd_serve_worker(args) -> int:
+    """A single SO_REUSEPORT worker: wire stack + remote engine."""
+    from ketotpu.driver import Provider, Registry
+    from ketotpu.server import serve_all
+
+    cfg = Provider(
+        {"engine": {"kind": "remote", "socket": args.worker_of}},
+        config_file=args.config,
+    ) if args.config else Provider(
+        {"engine": {"kind": "remote", "socket": args.worker_of}}
+    )
+    reg = Registry(cfg)
+    srv = serve_all(reg, reuse_port=True)
+    try:
+        srv.wait()
+    except KeyboardInterrupt:
         srv.stop()
     return 0
 
@@ -402,6 +479,16 @@ def build_parser() -> argparse.ArgumentParser:
 
     serve = sub.add_parser("serve", help="run the 4-port server daemon")
     serve.add_argument("-c", "--config", help="config file (yaml/json)")
+    serve.add_argument(
+        "--workers", type=int, default=0, metavar="N",
+        help="N SO_REUSEPORT worker processes around one device owner "
+             "(needs a shared durable dsn)",
+    )
+    serve.add_argument(
+        "--worker-of", metavar="SOCKET", default="",
+        help="internal: run as a worker forwarding to the device owner "
+             "at SOCKET",
+    )
     serve.set_defaults(fn=cmd_serve)
 
     check = sub.add_parser("check", help="check a permission")

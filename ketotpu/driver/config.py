@@ -91,8 +91,11 @@ def _defaults() -> Dict[str, Any]:
         "namespaces": [],
         "engine": {
             # "tpu" = batched device engine with oracle fallback;
-            # "oracle" = sequential host engine only (parity/debug)
+            # "oracle" = sequential host engine only (parity/debug);
+            # "remote" = forward batches to a device-owner process over
+            # engine.socket (SO_REUSEPORT worker mode, server/workers.py)
             "kind": "tpu",
+            "socket": "",
             "frontier": 8192,
             "arena": 16384,
             "max_batch": 8192,
@@ -344,8 +347,11 @@ class Provider:
                 "namespaces", f"expected list, mapping or URI string, got {type(ns).__name__}"
             )
         kind = self.get("engine.kind")
-        if kind not in ("tpu", "oracle"):
-            raise ConfigError("engine.kind", f"must be 'tpu' or 'oracle', got {kind!r}")
+        if kind not in ("tpu", "oracle", "remote"):
+            raise ConfigError(
+                "engine.kind",
+                f"must be 'tpu', 'oracle' or 'remote', got {kind!r}",
+            )
         for key in ("engine.frontier", "engine.arena", "engine.max_batch"):
             val = self.get(key)
             if not isinstance(val, int) or val < 1:

@@ -257,7 +257,20 @@ class Registry:
         with self._lock:
             if self._check_engine is None:
                 kind = self.config.get("engine.kind")
-                if kind == "tpu":
+                if kind == "remote":
+                    # SO_REUSEPORT worker process: forward batches to the
+                    # device-owner process over its unix socket
+                    # (server/workers.py)
+                    from ketotpu.server.workers import RemoteCheckEngine
+
+                    sock = str(self.config.get("engine.socket") or "")
+                    if not sock:
+                        raise ConfigError(
+                            "engine.socket",
+                            "engine.kind=remote needs engine.socket",
+                        )
+                    self._check_engine = RemoteCheckEngine(sock)
+                elif kind == "tpu":
                     common = dict(
                         max_depth=self.config.max_read_depth(),
                         max_width=self.config.max_read_width(),
@@ -317,6 +330,19 @@ class Registry:
     def expand_engine(self):
         with self._lock:
             if self._expand_engine is None:
+                if self.config.get("engine.kind") == "remote":
+                    from ketotpu.server.workers import (
+                        RemoteCheckEngine,
+                        RemoteExpandEngine,
+                    )
+
+                    check = self.check_engine()
+                    self._expand_engine = RemoteExpandEngine(
+                        str(self.config.get("engine.socket")),
+                        check if isinstance(check, RemoteCheckEngine)
+                        else None,
+                    )
+                    return self._expand_engine
                 dev = self._device_engine()
                 if dev is not None:
                     # device-batched expand with host DFS reassembly

@@ -103,10 +103,14 @@ class _Mux(threading.Thread):
 
     def __init__(self, host: str, port: int, grpc_addr: Tuple[str, int],
                  rest_addr: Tuple[str, int], logger,
-                 ssl_ctx: Optional[ssl.SSLContext] = None):
+                 ssl_ctx: Optional[ssl.SSLContext] = None,
+                 reuse_port: bool = False):
         super().__init__(daemon=True)
+        # reuse_port: SO_REUSEPORT worker mode (server/workers.py) — the
+        # kernel load-balances accepted connections across processes
+        # bound to the same public port
         self.listener = socket.create_server(
-            (host, port), reuse_port=False, backlog=128
+            (host, port), reuse_port=reuse_port, backlog=128
         )
         self.addr = self.listener.getsockname()[:2]
         self.grpc_addr = grpc_addr
@@ -167,8 +171,9 @@ class _Mux(threading.Thread):
 class Server:
     """ServeAll analog: boot every port, block until stop()."""
 
-    def __init__(self, registry):
+    def __init__(self, registry, *, reuse_port: bool = False):
         self.registry = registry
+        self.reuse_port = reuse_port
         self.logger = registry.logger()
         self._grpc_servers: List[grpc.Server] = []
         self._http_servers: List = []
@@ -261,7 +266,7 @@ class Server:
             rest_addr = self._rest_backend(router)
             ctx = self._ssl_context(name)
             mux = _Mux(host, port, grpc_addr, rest_addr, self.logger,
-                       ssl_ctx=ctx)
+                       ssl_ctx=ctx, reuse_port=self.reuse_port)
             mux.start()
             self._muxes.append(mux)
             self.addresses[name] = mux.addr
@@ -272,7 +277,9 @@ class Server:
 
         # metrics: plain HTTP, no gRPC, no mux (daemon.go:189-228)
         host, port = r.config.listen_on("metrics")
-        httpd = rest.make_http_server(rest.metrics_router(r), host, port)
+        httpd = rest.make_http_server(
+            rest.metrics_router(r), host, port, reuse_port=self.reuse_port
+        )
         ctx = self._ssl_context("metrics")
         if ctx is not None:
             # deferred handshake: with do_handshake_on_connect the TLS
@@ -308,6 +315,6 @@ class Server:
         self._stopped.set()
 
 
-def serve_all(registry) -> Server:
+def serve_all(registry, *, reuse_port: bool = False) -> Server:
     """Build + start the full 4-port daemon (Registry.ServeAll analog)."""
-    return Server(registry).start()
+    return Server(registry, reuse_port=reuse_port).start()

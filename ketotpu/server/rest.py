@@ -23,6 +23,7 @@ Errors are herodot-shaped JSON: ``{"error": {"code", "status", "message"}}``.
 from __future__ import annotations
 
 import json
+import socket
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional, Tuple
@@ -408,7 +409,8 @@ def metrics_router(registry) -> Router:
 # -- HTTP server ------------------------------------------------------------
 
 
-def make_http_server(router: Router, host: str, port: int) -> ThreadingHTTPServer:
+def make_http_server(router: Router, host: str, port: int,
+                     reuse_port: bool = False) -> ThreadingHTTPServer:
     registry = router.r
     logger = registry.logger()
 
@@ -495,6 +497,18 @@ def make_http_server(router: Router, host: str, port: int) -> ThreadingHTTPServe
         def log_message(self, fmt, *args):  # route through the logger
             pass
 
-    server = ThreadingHTTPServer((host, port), Handler)
+    if reuse_port:
+        # SO_REUSEPORT worker mode: bind the same public port from every
+        # worker process and let the kernel balance accepts
+        class _ReusePortServer(ThreadingHTTPServer):
+            def server_bind(self):
+                self.socket.setsockopt(
+                    socket.SOL_SOCKET, socket.SO_REUSEPORT, 1
+                )
+                super().server_bind()
+
+        server = _ReusePortServer((host, port), Handler)
+    else:
+        server = ThreadingHTTPServer((host, port), Handler)
     server.daemon_threads = True
     return server

@@ -17,7 +17,7 @@ import urllib.request
 import grpc
 import pytest
 
-from ketotpu.api.types import RelationTuple, SubjectID
+from ketotpu.api.types import RelationTuple, SubjectID, SubjectSet
 from ketotpu.driver import Provider, Registry
 from ketotpu.proto import (
     check_service_pb2 as cs,
@@ -579,3 +579,90 @@ def test_check_latest_serves_fresh_state_without_rebuild(server, read_channel):
     )
     assert resp.allowed is True  # the pending write is visible
     assert eng.rebuilds == before  # ...without a full reprojection
+
+
+class TestWorkerMode:
+    def test_remote_engine_parity_through_engine_host(self, tmp_path):
+        """server/workers.py: a worker-side RemoteCheckEngine forwards
+        batches to the owner's unix socket and answers exactly like the
+        owner's engine; expand round-trips the tree JSON."""
+        from ketotpu.server.workers import (
+            EngineHostServer,
+            RemoteCheckEngine,
+            RemoteExpandEngine,
+        )
+
+        owner = Registry(Provider({
+            "dsn": f"sqlite://{tmp_path}/w.db",
+            "namespaces": {
+                "location": str(FIXTURES / "rewrites_namespaces.keto.ts")
+            },
+            "engine": {"kind": "tpu", "frontier": 512, "arena": 1024,
+                       "mesh_devices": 0, "mesh_axis": "shard"},
+        }))
+        owner.store().migrate_up()
+        owner.store().write_relation_tuples(
+            *[RelationTuple.from_string(s) for s in [
+                "Group:dev#members@bob",
+                "Folder:keto#viewers@Group:dev#members",
+                "File:keto/README.md#parents@Folder:keto",
+            ]]
+        )
+        owner.init()
+        sock = str(tmp_path / "engine.sock")
+        host = EngineHostServer(owner, sock).start()
+        try:
+            remote = RemoteCheckEngine(sock)
+            q = RelationTuple.from_string("File:keto/README.md#view@bob")
+            deny = RelationTuple.from_string("File:keto/README.md#view@eve")
+            assert remote.batch_check([q, deny]) == [True, False]
+            assert remote.check_is_member(q) is True
+            xp = RemoteExpandEngine(sock, remote)
+            tree = xp.build_tree(
+                SubjectSet("Folder", "keto", "viewers"), 4
+            )
+            want = owner.expand_engine().build_tree(
+                SubjectSet("Folder", "keto", "viewers"), 4
+            )
+            assert tree.to_json() == want.to_json()
+            # typed errors cross the socket with their status intact
+            import pytest as _pytest
+            from ketotpu.api.types import KetoAPIError
+
+            with _pytest.raises(KetoAPIError) as ei:
+                remote.check(
+                    RelationTuple.from_string("Folder:f#nosuch@alice")
+                )
+            assert ei.value.status_code == 400
+        finally:
+            host.stop()
+
+    def test_worker_registry_builds_remote_engines(self, tmp_path):
+        from ketotpu.server.workers import (
+            EngineHostServer,
+            RemoteCheckEngine,
+            RemoteExpandEngine,
+        )
+
+        owner = Registry(Provider({
+            "dsn": f"sqlite://{tmp_path}/w2.db",
+            "engine": {"kind": "oracle"},
+        }))
+        owner.store().migrate_up()
+        owner.store().write_relation_tuples(
+            RelationTuple.from_string("g:o#m@alice")
+        )
+        sock = str(tmp_path / "w2.sock")
+        host = EngineHostServer(owner, sock).start()
+        try:
+            worker = Registry(Provider({
+                "dsn": f"sqlite://{tmp_path}/w2.db",
+                "engine": {"kind": "remote", "socket": sock},
+            }))
+            assert isinstance(worker.check_engine(), RemoteCheckEngine)
+            assert isinstance(worker.expand_engine(), RemoteExpandEngine)
+            assert worker.check_engine().check(
+                RelationTuple.from_string("g:o#m@alice")
+            ) is True
+        finally:
+            host.stop()
