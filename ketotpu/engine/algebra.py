@@ -609,12 +609,18 @@ def run_general_packed(
         levels.append(child)
         t, count, aux = _classify_level(g, child, q_subj)
     # last level: any task still needing children exhausts the level
-    # budget — UNKNOWN + over (host fallback), like check_step's max_iters
+    # budget — UNKNOWN + over (host fallback), like check_step's max_iters.
+    # K_FAST tasks never take skeleton children (count stays 0), so they
+    # are NOT capped here: they stay unresolved and _collect_fast
+    # delegates them to the BFS sub-run like any other level's leaves
+    # (a resolved-at-R_UNKNOWN fast leaf would feed the up-pass a silent
+    # wrong DENY with no over bit).  K_CHECK/K_PROG with count == 0 were
+    # already resolved by _classify_level's r_empty term.
     depth_capped = (t["qid"] >= 0) & ~t["resolved"] & (count > 0)
     q_over = q_over.at[jnp.clip(t["qid"], 0, Q - 1)].max(depth_capped)
     levels[-1] = dict(
         t,
-        resolved=t["resolved"] | depth_capped | ((t["qid"] >= 0) & (count == 0) & ~t["resolved"]),
+        resolved=t["resolved"] | depth_capped,
         res=jnp.where(depth_capped, R_UNKNOWN, t["res"]),
     )
 

@@ -19,7 +19,7 @@ from ketotpu.storage import InMemoryTupleStore, StaticNamespaceManager
 T = RelationTuple.from_string
 
 
-def make_engines(namespaces, tuples, *, opl=None, **kw):
+def make_engines(namespaces, tuples, *, opl=None, device_kw=None, **kw):
     store = InMemoryTupleStore()
     store.write_relation_tuples(*[T(s) for s in tuples])
     if opl is not None:
@@ -33,6 +33,7 @@ def make_engines(namespaces, tuples, *, opl=None, **kw):
     device = DeviceCheckEngine(
         store, nsm,
         frontier=512, arena=1024, cap=2048, gen_arena=2048, vcap=1024,
+        **(device_kw or {}),
         **kw,
     )
     return oracle, device
@@ -299,6 +300,50 @@ class TestAndNot:
         o, d = make_engines(None, tuples, opl=OPL_ANDNOT)
         for depth in (1, 2, 3):
             assert_parity(o, d, ["Doc:a#edit@alice"], depth)
+
+    @pytest.mark.parametrize("gen_levels", [1, 2, 3, 4])
+    def test_fast_leaf_on_final_level(self, gen_levels):
+        # Regression (ADVICE r4): a non-trivial pure-OR fast leaf (here a
+        # viewers check held via a Group#members subject-set edge) landing
+        # on the LAST skeleton level must still delegate to the BFS
+        # sub-run — or flag over — never resolve silently to a wrong DENY.
+        opl = """
+        class User implements Namespace {}
+        class Group implements Namespace {
+          related: { members: User[] }
+        }
+        class Doc implements Namespace {
+          related: {
+            viewers: (User | SubjectSet<Group, "members">)[]
+            signers: User[]
+          }
+          permits = {
+            finalize: (ctx: Context): boolean =>
+              this.permits.view(ctx) && this.related.signers.includes(ctx.subject),
+            view: (ctx: Context): boolean =>
+              this.related.viewers.includes(ctx.subject),
+          }
+        }
+        """
+        tuples = [
+            "Doc:d#viewers@Group:g#members",
+            "Group:g#members@alice",
+            "Doc:d#signers@alice",
+        ]
+        o, d = make_engines(
+            None, tuples, opl=opl,
+            device_kw=dict(gen_levels=gen_levels, gen_levels_max=gen_levels),
+        )
+        q = [T("Doc:d#finalize@alice"), T("Doc:d#finalize@bob")]
+        want = [o.check_is_member(t, 0) for t in q]
+        ok, needs = d.batch_check_device_only(q, 0)
+        for t, w, got, nh in zip(q, want, ok, needs):
+            # the bug mode: wrong verdict with no fallback flagged
+            assert nh or got == w, f"{t}: device={got} oracle={w} (no fallback)"
+        if gen_levels >= 3:
+            # the skeleton fits: the leaf must be answered on-device
+            assert not any(needs), needs
+            assert list(ok) == want
 
 
 class TestStrictMode:
