@@ -1,6 +1,14 @@
 """Benchmark: end-to-end batched permission checks per second.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...} —
+ALWAYS, exit code 0, even when the device backend is down.  Round 4's
+lesson (VERDICT r4 #1): the TPU tunnel failed to initialize, bench.py
+died at its first device call with rc=1, and a whole round of perf work
+produced zero driver-verified numbers.  Now every section runs under its
+own guard; a backend-init failure is detected up front by a SUBPROCESS
+probe with a timeout (an in-process probe can hang indefinitely inside
+backend setup — observed: >10 min), the host-only sections still run,
+and the error lands in the JSON instead of on a dead stderr.
 
 Baseline: the reference's checked-in BenchmarkComputedUsersets figure —
 81,280 ns per sequential strict-mode check on in-memory SQLite
@@ -13,11 +21,12 @@ Sections (the BASELINE.json configs):
      batch_check surface (string encode, device dispatch, fallbacks all
      inside the clock), chunk-pipelined;
   2. mixed AND/NOT slice (config #4's rewrites) — `edit` =
-     !banned && view routes through the general task-tree interpreter;
+     !banned && view routes through the fused algebra program;
      reported separately as general_checks_per_sec;
   3. Expand at depth 5 (config #3) — batched device expand, trees/s;
   4. serving latency (the metric's p50/p99 half) — concurrent single
-     Checks through the real gRPC daemon with the coalescer on;
+     Checks through the real gRPC daemon with the coalescer on, plus a
+     `serve --workers 2` leg measuring the multi-process topology;
   5. 10M-tuple scale (configs #4/#5 scale) — columnar bulk load,
      projection seconds, device HBM bytes, and checks/s at 10M.
 
@@ -28,13 +37,18 @@ driver; set JAX_PLATFORMS=cpu to try it without one).
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
+import traceback
 
 import numpy as np
 
 BASELINE_NS_PER_OP = 81_280  # reference benchtest.new.txt:5
 BATCH = 16384
 ROUNDS = 4
+PROBE_TIMEOUT_S = float(os.environ.get("KETO_BENCH_PROBE_TIMEOUT", 300.0))
 
 
 def _engine(graph, **kw):
@@ -55,18 +69,106 @@ def _engine(graph, **kw):
     return DeviceCheckEngine(graph.store, graph.manager, **kw)
 
 
-def main() -> None:
-    from ketotpu.utils.synth import (
-        build_synth,
-        build_synth_columnar,
-        synth_queries,
-        synth_queries_mixed,
+def _probe_backend(out: dict) -> bool:
+    """Initialize the JAX backend in a SUBPROCESS first: a dead tunnel can
+    either raise UNAVAILABLE or hang inside backend setup, and neither
+    must take the bench process down with it (VERDICT r4 #1)."""
+    code = (
+        # the engine module applies the JAX_PLATFORMS config seam (the env
+        # var alone does not beat the preinstalled TPU plugin) — import it
+        # first so the probe exercises the SAME backend the sections use
+        "import ketotpu.engine.tpu\n"
+        "import jax, jax.numpy as jnp, numpy as np\n"
+        "np.asarray(jax.jit(lambda a: a + 1)(jnp.ones((8,), jnp.int32)))\n"
+        "print('OK', jax.devices()[0].platform)\n"
     )
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=PROBE_TIMEOUT_S,
+        )
+    except subprocess.TimeoutExpired:
+        out["error"] = (
+            f"backend_init: probe timed out after {PROBE_TIMEOUT_S:.0f}s"
+        )
+        return False
+    if p.returncode != 0 or "OK" not in p.stdout:
+        lines = [
+            ln for ln in (p.stderr or p.stdout).strip().splitlines() if ln
+        ]
+        # prefer the actual exception line over jax's traceback-filtering
+        # footer notice
+        errs = [ln for ln in lines if "Error" in ln or "error" in ln]
+        out["error"] = "backend_init: " + (
+            errs[-1] if errs else (lines[-1] if lines else "unknown")
+        )
+        return False
+    out["platform"] = p.stdout.split()[-1]
+    return True
 
-    out = {}
+
+class _Sections:
+    """Run each bench section under its own guard; a failure records an
+    error entry and the remaining sections still run (device-section
+    failures after a green probe are real code bugs worth localizing)."""
+
+    def __init__(self, out: dict):
+        self.out = out
+
+    def run(self, name, fn, *args, **kw):
+        try:
+            fn(*args, **kw)
+            self.out.setdefault("sections_ok", []).append(name)
+            return True
+        except Exception as e:  # noqa: BLE001 — the bench must finish
+            tb = traceback.format_exc(limit=3).strip().splitlines()
+            self.out.setdefault("errors", {})[name] = (
+                f"{type(e).__name__}: {e} | {tb[-1] if tb else ''}"
+            )
+            return False
+
+
+def main() -> None:
+    out: dict = {}
     baseline = 1e9 / BASELINE_NS_PER_OP
+    state: dict = {}
+    sec = _Sections(out)
 
-    # ---- 0. link calibration ---------------------------------------------
+    # host-only sections run regardless of the device probe so an outage
+    # still produces evidence (graph build timings, tuple counts)
+    device_up = _probe_backend(out)
+
+    sec.run("host_build", _host_build, out, state)
+    if device_up:
+        # serving_workers FIRST: its subprocess owner must init the
+        # backend while THIS process has not touched the device yet — two
+        # live clients on one chip is the only ordering that can fail
+        # (the probe subprocess above has already exited)
+        sec.run("serving_workers", _serving_workers, out, state)
+        sec.run("link_calibration", _link_calibration, out)
+        sec.run("fast_path", _fast_path, out, state, baseline)
+        sec.run("mixed_general", _mixed_general, out, state)
+        sec.run("wave_latency", _wave_latency, out, state)
+        sec.run("expand", _expand, out, state)
+        sec.run("serving", _serving, out, state)
+        sec.run("scale_10m", _scale_10m, out, state, baseline)
+        sec.run("scale_10m_mixed", _scale_10m_mixed, out, state)
+        sec.run("scale_10m_expand", _scale_10m_expand, out, state)
+
+    print(json.dumps(out))
+
+
+def _host_build(out, state) -> None:
+    from ketotpu.utils.synth import build_synth
+
+    graph = build_synth(
+        n_users=2000, n_groups=100, n_folders=2000, n_docs=20000, seed=0
+    )
+    state["graph"] = graph
+    out["tuples"] = len(graph.store)
+
+
+def _link_calibration(out) -> None:
     # Under the driver the chip sits behind a network tunnel; a trivial
     # dispatch+sync round trip measures the latency FLOOR the link imposes
     # on every number below (the BASELINE p99 <= 2 ms target presumes
@@ -83,13 +185,15 @@ def main() -> None:
         rtts.append(time.perf_counter() - t0)
     out["tunnel_rtt_ms"] = round(1000 * sorted(rtts)[len(rtts) // 2], 1)
 
-    # ---- 1. fast path -----------------------------------------------------
-    graph = build_synth(
-        n_users=2000, n_groups=100, n_folders=2000, n_docs=20000, seed=0
-    )
-    eng = _engine(graph)
+
+def _fast_path(out, state, baseline) -> None:
+    from ketotpu.utils.synth import synth_queries
+
+    graph = state["graph"]
+    eng = state["eng"] = _engine(graph)
     eng.snapshot()
     queries = synth_queries(graph, BATCH * ROUNDS, seed=2)
+    state["queries"] = queries
     batches = [queries[i * BATCH : (i + 1) * BATCH] for i in range(ROUNDS)]
     _, fallback = eng.batch_check_device_only(batches[0])
     eng.batch_check(batches[0])
@@ -109,19 +213,22 @@ def main() -> None:
         unit="checks/sec",
         vs_baseline=round(checks_per_sec / baseline, 3),
         batch=BATCH,
-        tuples=len(graph.store),
         device_fallback_rate=round(float(np.mean(fallback)), 5),
         device_retries=eng.retries,
         oracle_fallbacks=eng.fallbacks,
         p50_batch_ms=round(1000 * sorted(times)[len(times) // 2], 1),
     )
 
-    # ---- 2. mixed AND/NOT (BASELINE config #4 rewrites) -------------------
+
+def _mixed_general(out, state) -> None:
+    # mixed AND/NOT (BASELINE config #4 rewrites)
+    from ketotpu.utils.synth import synth_queries_mixed
+
+    graph, eng = state["graph"], state["eng"]
     mixed = synth_queries_mixed(graph, 10_000, seed=6, general_frac=0.3)
     # warm TWICE at the EXACT timed shape: the first call compiles the
     # default-sized programs and feeds the occupancy EMAs; the second
-    # compiles the demand-adapted (quantized-ladder) variant the timed
-    # run will execute
+    # compiles the demand-adapted variant the timed run will execute
     eng.batch_check(mixed)
     eng.batch_check(mixed)
     t0 = time.perf_counter()
@@ -138,16 +245,18 @@ def main() -> None:
         mixed_10k_checks_per_sec=round(mixed_cps, 1),
         mixed_general_frac=round(n_general / len(mixed), 3),
         general_checks_per_sec=round(general_cps, 1),
-        general_fallbacks=eng.fallbacks - out["oracle_fallbacks"],
+        general_fallbacks=eng.fallbacks - out.get("oracle_fallbacks", 0),
     )
 
-    # ---- 2b. engine-side wave latency (the p99 <= 2ms half of the metric)
-    # Device-only dispatch+collect timings per wave size, with the
-    # measured link floor subtracted: this is the engine-side budget the
-    # README used to claim in prose (VERDICT r3 #3) — on locally attached
-    # chips the wire adds microseconds, here the tunnel RTT dominates the
-    # raw number, so both raw and net-of-link are reported.
-    rtt_s = out["tunnel_rtt_ms"] / 1000.0
+
+def _wave_latency(out, state) -> None:
+    # engine-side wave latency (the p99 <= 2ms half of the metric):
+    # device-only dispatch+collect timings per wave size, with the
+    # measured link floor subtracted — on locally attached chips the wire
+    # adds microseconds, here the tunnel RTT dominates the raw number, so
+    # both raw and net-of-link are reported.
+    eng, queries = state["eng"], state["queries"]
+    rtt_s = out.get("tunnel_rtt_ms", 0.0) / 1000.0
     for wave in (1, 64, 256, 1024):
         wq = queries[:wave]
         eng.batch_check_device_only(wq, retry=False)
@@ -164,9 +273,12 @@ def main() -> None:
         out[f"engine_p50_ms_w{wave}"] = round(1000 * max(p50 - rtt_s, 0), 2)
         out[f"engine_p99_ms_w{wave}"] = round(1000 * max(p99 - rtt_s, 0), 2)
 
-    # ---- 3. Expand at depth 5 (BASELINE config #3) ------------------------
+
+def _expand(out, state) -> None:
+    # Expand at depth 5 (BASELINE config #3)
     from ketotpu.api.types import SubjectSet
 
+    graph, eng = state["graph"], state["eng"]
     rng = np.random.default_rng(9)
     roots = [
         SubjectSet("Doc", graph.docs[int(rng.integers(len(graph.docs)))], "parents")
@@ -183,24 +295,38 @@ def main() -> None:
         expand_fallback_rate=round((eng.fallbacks - fb0) / len(roots), 4),
     )
 
-    # ---- 4. serving latency (RPS + p50/p99 through the daemon) ------------
-    # closed-loop clients IN-PROCESS with the server: on this single-core
-    # host the wire path (proto + gRPC + GIL) is the binding constraint,
-    # not the engine — 64 threads measured pure queueing, 32 keeps the
+
+def _serving(out, state) -> None:
+    # serving latency (RPS + p50/p99 through the daemon): closed-loop
+    # clients IN-PROCESS with the server: on a single-core host the wire
+    # path (proto + gRPC + GIL) is the binding constraint, not the
+    # engine — 64 threads measured pure queueing, 32 keeps the
     # percentiles meaningful
     from bench_serve import run_serving_bench
 
-    out.update(
-        run_serving_bench(graph, concurrency=32, duration=10.0)
-    )
+    out.update(run_serving_bench(state["graph"], concurrency=32, duration=10.0))
 
-    # ---- 5. 10M-tuple scale (columnar load + projection + checks) ---------
+
+def _serving_workers(out, state) -> None:
+    # the multi-process topology (`serve --workers 2`): SO_REUSEPORT
+    # workers around one device owner — measures the wire-path scaling
+    # the workers exist for (parity on a 1-core box, scaling on real
+    # multi-core hosts); VERDICT r4 #3
+    from bench_serve import run_workers_bench
+
+    out.update(run_workers_bench(state["graph"], concurrency=32, duration=10.0))
+
+
+def _scale_10m(out, state, baseline) -> None:
+    # 10M-tuple scale (columnar load + projection + checks)
+    from ketotpu.utils.synth import build_synth_columnar, synth_queries
+
     t0 = time.perf_counter()
-    big = build_synth_columnar(seed=0)
+    big = state["big"] = build_synth_columnar(seed=0)
     build_s = time.perf_counter() - t0
-    beng = _engine(big)
+    beng = state["beng"] = _engine(big)
     t0 = time.perf_counter()
-    snap = beng.snapshot()
+    beng.snapshot()
     projection_s = time.perf_counter() - t0
     hbm_bytes = sum(
         int(np.asarray(v).nbytes) for v in beng._device_arrays.values()
@@ -224,9 +350,14 @@ def main() -> None:
         device_fallback_rate_10m=round(float(np.mean(bfb)), 5),
     )
 
-    # ---- 5b. configs #3/#4 AT SPEC SCALE (VERDICT r3 #4) ------------------
-    # mixed AND/NOT 10k batch against the 10M-tuple graph, not the 31k one
-    bmixed = synth_queries_mixed(big, 10_000, seed=9, general_frac=0.3)
+
+def _scale_10m_mixed(out, state) -> None:
+    # config #4 AT SPEC SCALE (VERDICT r3 #4): mixed AND/NOT 10k batch
+    # against the 10M-tuple graph, not the 31k one
+    from ketotpu.utils.synth import synth_queries_mixed
+
+    beng = state["beng"]
+    bmixed = synth_queries_mixed(state["big"], 10_000, seed=9, general_frac=0.3)
     beng.batch_check(bmixed)
     beng.batch_check(bmixed)
     t0 = time.perf_counter()
@@ -234,9 +365,15 @@ def main() -> None:
     out["mixed_10k_checks_per_sec_10m"] = round(
         len(bgot) / (time.perf_counter() - t0), 1
     )
+
+
+def _scale_10m_expand(out, state) -> None:
     # depth-5 Expand over the >=1M-tuple Drive-style hierarchy (config #3
     # says 1M; this runs it on the full 10.6M graph) — includes the lazy
     # expand-table upload in the warm pass, not the timed one
+    from ketotpu.api.types import SubjectSet
+
+    big, beng = state["big"], state["beng"]
     fb1 = beng.fallbacks
     rng2 = np.random.default_rng(11)
     xroots = [
@@ -254,8 +391,12 @@ def main() -> None:
         ),
     )
 
-    print(json.dumps(out))
-
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except BaseException as e:  # noqa: BLE001 — ALWAYS emit the JSON line
+        if isinstance(e, (KeyboardInterrupt, SystemExit)):
+            raise
+        print(json.dumps({"error": f"{type(e).__name__}: {e}"}))
+    sys.exit(0)

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import pathlib
 import sys
 import time
@@ -100,7 +101,12 @@ def _serve_multiprocess(args, workers: int) -> int:
     reg = Registry(cfg)
     reg.logger().info("initializing device owner (engine warmup)")
     reg.init()
-    sock = tempfile.mktemp(prefix="keto-engine-", suffix=".sock")
+    # the socket lives in a fresh 0700 directory: a bare mktemp name in
+    # world-writable /tmp is squattable between name pick and bind, and
+    # the directory mode (not the umask-dependent socket mode) is what
+    # actually gates connect permission
+    sockdir = tempfile.mkdtemp(prefix="keto-engine-")
+    sock = os.path.join(sockdir, "engine.sock")
     host = EngineHostServer(reg, sock).start()
     reg.logger().info("engine host on %s; forking %d workers", sock, workers)
     procs = [
@@ -122,6 +128,10 @@ def _serve_multiprocess(args, workers: int) -> int:
             p.wait(timeout=10)
     finally:
         host.stop()
+        try:
+            os.rmdir(sockdir)
+        except OSError:
+            pass
     return 0
 
 

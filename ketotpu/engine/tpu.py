@@ -49,6 +49,18 @@ from typing import List, Optional, Sequence
 import jax
 import numpy as np
 
+# honor JAX_PLATFORMS in-process: in this jax build the env var alone does
+# NOT beat the preinstalled TPU plugin (a subprocess with JAX_PLATFORMS=cpu
+# still initializes the axon client — and hangs when the tunnel is down);
+# the config.update below is what actually wins.  Every device path imports
+# this module before first backend use, so this is the central seam.
+_plat = os.environ.get("JAX_PLATFORMS")
+if _plat:
+    try:
+        jax.config.update("jax_platforms", _plat)
+    except Exception:  # noqa: BLE001 — never block engine import on this
+        pass
+
 from ketotpu.api.types import RelationTuple
 from ketotpu.engine import algebra as alg
 from ketotpu.engine import delta as dl

@@ -101,6 +101,14 @@ class EngineHostServer:
             tuples = [RelationTuple.from_string(s) for s in req["tuples"]]
             eng = r.check_engine()
             depth = int(req.get("depth", 0))
+            if len(tuples) == 1:
+                # single-check RPCs from the workers MUST go through
+                # check_is_member: that is the coalescer's enqueue point,
+                # so concurrent singles from every worker merge into one
+                # shared device wave.  batch_check passes straight
+                # through the coalescer (it is already batched) — routing
+                # singles there made each RPC its own device dispatch.
+                return {"ok": [bool(eng.check_is_member(tuples[0], depth))]}
             batch = getattr(eng, "batch_check", None)
             if batch is not None:
                 ok = batch(tuples, depth)

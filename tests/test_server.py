@@ -637,6 +637,65 @@ class TestWorkerMode:
         finally:
             host.stop()
 
+    def test_owner_coalesces_single_checks_across_connections(self, tmp_path):
+        """ADVICE r4: 1-tuple check requests from workers must enqueue via
+        check_is_member — the coalescer's entry point — so concurrent
+        singles from every worker merge into shared device waves instead
+        of one dispatch per RPC."""
+        import threading
+
+        from ketotpu.server.workers import EngineHostServer, RemoteCheckEngine
+
+        owner = Registry(Provider({
+            "dsn": f"sqlite://{tmp_path}/wc.db",
+            "namespaces": {
+                "location": str(FIXTURES / "rewrites_namespaces.keto.ts")
+            },
+            "engine": {"kind": "tpu", "frontier": 512, "arena": 1024,
+                       "mesh_devices": 0, "mesh_axis": "shard",
+                       "coalesce_ms": 25.0},
+        }))
+        owner.store().migrate_up()
+        owner.store().write_relation_tuples(
+            *[RelationTuple.from_string(s) for s in [
+                "Group:dev#members@bob",
+                "Folder:keto#viewers@Group:dev#members",
+                "File:keto/README.md#parents@Folder:keto",
+            ]]
+        )
+        owner.init()
+        eng = owner.check_engine()
+        assert hasattr(eng, "waves"), "expected the coalescing wrapper"
+        sock = str(tmp_path / "wc.sock")
+        host = EngineHostServer(owner, sock).start()
+        try:
+            q = RelationTuple.from_string("File:keto/README.md#view@bob")
+            # warm the engine outside the measured window (first dispatch
+            # compiles; a slow compile would serialize the waves)
+            RemoteCheckEngine(sock).check(q)
+            w0, c0 = eng.waves, eng.coalesced
+            n = 12
+            results = [None] * n
+            # one RemoteCheckEngine per thread = one socket connection
+            # each, like N worker serving threads
+            def one(i):
+                results[i] = RemoteCheckEngine(sock).check(q)
+
+            threads = [
+                threading.Thread(target=one, args=(i,)) for i in range(n)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+            assert results == [True] * n
+            assert eng.coalesced - c0 == n, "singles must ride the coalescer"
+            assert eng.waves - w0 < n, (
+                f"expected shared waves, got {eng.waves - w0} waves for {n} checks"
+            )
+        finally:
+            host.stop()
+
     def test_worker_registry_builds_remote_engines(self, tmp_path):
         from ketotpu.server.workers import (
             EngineHostServer,
