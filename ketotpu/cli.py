@@ -37,10 +37,84 @@ READ_REMOTE = "127.0.0.1:4466"
 WRITE_REMOTE = "127.0.0.1:4467"
 
 
-def _channel(remote: str):
+def _cert_host_name(pem: str):
+    """Best-effort DNS name / CN out of a PEM cert (for the target-name
+    override when pinning a fetched certificate) — None when the private
+    stdlib decoder is unavailable."""
+    import ssl as _ssl
+    import tempfile
+
+    try:
+        with tempfile.NamedTemporaryFile("w", suffix=".pem") as f:
+            f.write(pem)
+            f.flush()
+            info = _ssl._ssl._test_decode_cert(f.name)  # noqa: SLF001
+        for typ, val in info.get("subjectAltName", ()):
+            if typ == "DNS":
+                return val
+        for rdn in info.get("subject", ()):
+            for k, v in rdn:
+                if k == "commonName":
+                    return v
+    except Exception:  # noqa: BLE001 — override is an optimization only
+        return None
+    return None
+
+
+def _channel(remote: str, args=None):
+    """Client channel with the reference's transport-security surface
+    (cmd/client/grpc_client.go:28-80): TLS against the host root bundle
+    by DEFAULT, ``--insecure-disable-transport-security`` for plaintext,
+    ``--insecure-skip-hostname-verification`` to trust the certificate
+    the server presents (python-grpc cannot disable verification, so the
+    fetched cert is pinned as the root and the target name overridden —
+    same effect for the self-signed case the flag exists for),
+    ``--authority``/KETO_AUTHORITY, and KETO_BEARER_TOKEN as per-RPC
+    bearer credentials (secure channels only, per the gRPC auth spec)."""
     import grpc
 
-    return grpc.insecure_channel(remote)
+    authority = (
+        getattr(args, "authority", "") or os.environ.get("KETO_AUTHORITY", "")
+    )
+    if getattr(args, "insecure_disable_transport_security", False):
+        opts = [("grpc.default_authority", authority)] if authority else None
+        return grpc.insecure_channel(remote, options=opts)
+    options = []
+    if getattr(args, "insecure_skip_hostname_verification", False):
+        import ssl as _ssl
+
+        host, sep, port = remote.rpartition(":")
+        if not sep:
+            host, port = remote, "443"  # gRPC's default TLS port
+        try:
+            pem = _ssl.get_server_certificate(
+                (host or "127.0.0.1", int(port))
+            )
+        except (OSError, ValueError):
+            # server not up yet (status --block polls through this) or an
+            # unparsable remote: build default TLS creds so the failure
+            # surfaces as grpc.RpcError at RPC time, which every client
+            # retry loop already handles
+            pem = None
+        if pem:
+            creds = grpc.ssl_channel_credentials(
+                root_certificates=pem.encode()
+            )
+            name = _cert_host_name(pem)
+            if name:
+                options.append(("grpc.ssl_target_name_override", name))
+        else:
+            creds = grpc.ssl_channel_credentials()
+    else:
+        creds = grpc.ssl_channel_credentials()  # host root CA bundle
+    token = os.environ.get("KETO_BEARER_TOKEN", "")
+    if token:
+        creds = grpc.composite_channel_credentials(
+            creds, grpc.access_token_call_credentials(token)
+        )
+    if authority:
+        options.append(("grpc.default_authority", authority))
+    return grpc.secure_channel(remote, creds, options=options or None)
 
 
 def _parse_subject(s: str):
@@ -166,7 +240,7 @@ def cmd_check(args) -> int:
     except KetoAPIError as e:
         print(f"Could not parse subject {args.subject!r}: {e}", file=sys.stderr)
         return 1
-    with _channel(args.read_remote) as ch:
+    with _channel(args.read_remote, args) as ch:
         resp = CheckServiceStub(ch).Check(
             cs.CheckRequest(
                 tuple=rts.RelationTuple(
@@ -188,7 +262,7 @@ def cmd_expand(args) -> int:
     from ketotpu.proto import relation_tuples_pb2 as rts
     from ketotpu.proto.services import ExpandServiceStub
 
-    with _channel(args.read_remote) as ch:
+    with _channel(args.read_remote, args) as ch:
         resp = ExpandServiceStub(ch).Expand(
             es.ExpandRequest(
                 subject=rts.Subject(
@@ -228,12 +302,12 @@ def _load_tuples(paths):
     return out
 
 
-def _transact(remote: str, tuples, action) -> None:
+def _transact(remote: str, tuples, action, args=None) -> None:
     from ketotpu.api.proto_codec import tuple_to_proto
     from ketotpu.proto import write_service_pb2 as ws
     from ketotpu.proto.services import WriteServiceStub
 
-    with _channel(remote) as ch:
+    with _channel(remote, args) as ch:
         WriteServiceStub(ch).TransactRelationTuples(
             ws.TransactRelationTuplesRequest(
                 relation_tuple_deltas=[
@@ -263,7 +337,7 @@ def cmd_rt_create(args) -> int:
     from ketotpu.proto import write_service_pb2 as ws
 
     tuples = _load_tuples(args.files)
-    _transact(args.write_remote, tuples, ws.RelationTupleDelta.ACTION_INSERT)
+    _transact(args.write_remote, tuples, ws.RelationTupleDelta.ACTION_INSERT, args)
     print(f"created {len(tuples)} relation tuples")
     return 0
 
@@ -272,7 +346,7 @@ def cmd_rt_delete(args) -> int:
     from ketotpu.proto import write_service_pb2 as ws
 
     tuples = _load_tuples(args.files)
-    _transact(args.write_remote, tuples, ws.RelationTupleDelta.ACTION_DELETE)
+    _transact(args.write_remote, tuples, ws.RelationTupleDelta.ACTION_DELETE, args)
     print(f"deleted {len(tuples)} relation tuples")
     return 0
 
@@ -300,7 +374,7 @@ def cmd_rt_get(args) -> int:
     from ketotpu.proto import read_service_pb2 as rs
     from ketotpu.proto.services import ReadServiceStub
 
-    with _channel(args.read_remote) as ch:
+    with _channel(args.read_remote, args) as ch:
         resp = ReadServiceStub(ch).ListRelationTuples(
             rs.ListRelationTuplesRequest(
                 relation_query=_query_from_flags(args),
@@ -340,7 +414,7 @@ def cmd_rt_delete_all(args) -> int:
             file=sys.stderr,
         )
         return 1
-    with _channel(args.write_remote) as ch:
+    with _channel(args.write_remote, args) as ch:
         WriteServiceStub(ch).DeleteRelationTuples(
             ws.DeleteRelationTuplesRequest(relation_query=_query_from_flags(args))
         )
@@ -372,7 +446,7 @@ def cmd_status(args) -> int:
     from ketotpu.proto.services import _stub_class
 
     deadline = time.monotonic() + args.timeout
-    with _channel(args.read_remote) as ch:
+    with _channel(args.read_remote, args) as ch:
         stub = _stub_class("grpc.health.v1.Health")(ch)
         while True:
             try:
@@ -462,15 +536,32 @@ def cmd_version(args) -> int:
 def _add_client_flags(p, write: bool = False) -> None:
     p.add_argument(
         "--read-remote",
-        default=READ_REMOTE,
-        help="read API gRPC remote (host:port)",
+        default=os.environ.get("KETO_READ_REMOTE", READ_REMOTE),
+        help="read API gRPC remote (host:port; env KETO_READ_REMOTE)",
     )
     if write:
         p.add_argument(
             "--write-remote",
-            default=WRITE_REMOTE,
-            help="write API gRPC remote (host:port)",
+            default=os.environ.get("KETO_WRITE_REMOTE", WRITE_REMOTE),
+            help="write API gRPC remote (host:port; env KETO_WRITE_REMOTE)",
         )
+    # transport security (cmd/client/grpc_client.go:28-41): TLS against
+    # the host roots unless explicitly disabled or downgraded
+    p.add_argument(
+        "--insecure-disable-transport-security",
+        action="store_true",
+        help="use a plaintext connection (no TLS)",
+    )
+    p.add_argument(
+        "--insecure-skip-hostname-verification",
+        action="store_true",
+        help="TLS, but trust whatever certificate the server presents",
+    )
+    p.add_argument(
+        "--authority",
+        default="",
+        help=":authority header override (env KETO_AUTHORITY)",
+    )
 
 
 def _add_query_flags(p) -> None:

@@ -111,6 +111,14 @@ class Registry:
                 endpoint = str(
                     self.config.get("tracing.otlp.server_url", "") or ""
                 )
+                if provider in ("otlp", "otel") and not endpoint:
+                    # the operator asked for export; silently building the
+                    # local-only tracer would drop every span on the floor
+                    raise ConfigError(
+                        "tracing.otlp.server_url",
+                        f"tracing.provider={provider!r} requires a non-empty"
+                        " otlp server_url",
+                    )
                 if provider in ("otlp", "otel") and endpoint:
                     from ketotpu.otlp import OTLPTracer
 
@@ -204,6 +212,17 @@ class Registry:
         """One dsn-dispatch path for the default network and every tenant
         (a tenant must never silently land on a different backend)."""
         dsn = self.config.dsn()
+        # sql-conn-query spans per statement (pop_connection.go:26-31):
+        # a trace of one Check shows engine + storage nested, and
+        # queries-per-check becomes measurable.  Only when tracing is
+        # actually configured — the default Tracer's span still costs a
+        # contextmanager + metrics lock per SQL statement, which the
+        # oracle hot path would pay on every query.
+        traced = bool(
+            self.config.get("tracing.provider", "")
+            or self.options.tracer_wrapper is not None
+        )
+        tracer = self.tracer() if traced else None
         if dsn == "memory":
             return InMemoryTupleStore()  # per-registry: tenants isolated
         if dsn.startswith(("sqlite://", "sqlite:")):
@@ -215,6 +234,7 @@ class Registry:
                 path or ":memory:",
                 network_id=nid,
                 extra_migrations=self.options.extra_migrations,
+                tracer=tracer,
             )
         if dsn.startswith(("postgres://", "postgresql://")):
             from ketotpu.storage.postgres import PostgresTupleStore
@@ -223,6 +243,16 @@ class Registry:
                 dsn,
                 network_id=nid,
                 extra_migrations=self.options.extra_migrations,
+                tracer=tracer,
+            )
+        if dsn.startswith(("mysql://", "mysql:")):
+            from ketotpu.storage.mysql import MySQLTupleStore
+
+            return MySQLTupleStore(
+                dsn,
+                network_id=nid,
+                extra_migrations=self.options.extra_migrations,
+                tracer=tracer,
             )
         raise ConfigError("dsn", f"unsupported dsn {dsn!r}")
 

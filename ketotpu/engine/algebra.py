@@ -91,11 +91,37 @@ from ketotpu.engine.device import (
     R_IS,
     R_NOT,
     R_UNKNOWN,
-    _member,
+)
+
+# the fast path's probe helpers are the OVERLAY-AWARE ones: membership
+# consults the om_ delta tables (base OR added AND NOT deleted), node
+# lookup resolves overlay-created virtual ids through ovt_ — so the
+# algebra program serves exact verdicts against pending writes instead
+# of draining every AND/NOT query to the host oracle (VERDICT r4 #4)
+from ketotpu.engine.fastpath import (
+    _node_dirty,
     _node_lookup,
     _row_deg,
 )
+from ketotpu.engine.fastpath import _member as _member_raw
 from ketotpu.engine.xutil import arena_assign
+
+
+def _member(g, node, subj):
+    return _member_raw(g, node, subj) & (node >= 0) & (subj >= 0)
+
+
+def _deg_guarded(g, node):
+    """Edge-row degree with overlay semantics: a dirty row's base edges
+    are stale and an overlay-created virtual node (>= ov_nbase) has no
+    base CSR row at all — both read as 0 edges, and the caller raises
+    the per-query dirty flag so the host oracle answers instead
+    (mirrors fastpath.expand_phase's exp_deg handling)."""
+    deg = _row_deg(g, node)
+    nd = _node_dirty(g, node)
+    if "ov_nbase" in g:
+        deg = jnp.where(nd | (node >= g["ov_nbase"]), 0, deg)
+    return deg, nd
 
 _I32MAX = jnp.iinfo(jnp.int32).max
 
@@ -167,9 +193,10 @@ def _classify_level(g, t, q_subj):
     # for the parent-side EXISTS / batched-CSS probe)
     is_fast = active & (t["kind"] == K_FAST)
     seed = is_check & member & (t["force"] | (dok & (d >= 2)))
-    deg = jnp.where(
-        (is_check | is_fast) & eok & (d >= 2), _row_deg(g, node), 0
-    )
+    exp_read = (is_check | is_fast) & eok & (d >= 2)
+    deg_row, node_nd = _deg_guarded(g, node)
+    deg = jnp.where(exp_read, deg_row, 0)
+    dirt = exp_read & node_nd
     errable = cfg & g["err_reach"][nsc, relc]
     chk_count = jnp.where(d >= 1, has_rw.astype(i32) + deg, 0)
 
@@ -199,7 +226,8 @@ def _classify_level(g, t, q_subj):
     pk = g["p_kind"][pp]
     p_deg = g["p_child_ptr"][pp + 1] - g["p_child_ptr"][pp]
     node_ttu = _node_lookup(g, ns, obj, g["p_a"][pp])
-    ttu_deg = jnp.where(is_prog, _row_deg(g, node_ttu), 0)
+    ttu_row, ttu_nd = _deg_guarded(g, node_ttu)
+    ttu_deg = jnp.where(is_prog, ttu_row, 0)
     browc = jnp.clip(g["p_a"][pp], 0, g["b_ptr"].shape[0] - 2)
     b_deg = g["b_ptr"][browc + 1] - g["b_ptr"][browc]
     p_oan = is_prog & ((pk == P_OR) | (pk == P_AND))
@@ -207,6 +235,9 @@ def _classify_level(g, t, q_subj):
     p_css = is_prog & (pk == P_CSS)
     p_ttu = is_prog & (pk == P_TTU)
     p_bat = is_prog & (pk == P_BATCHCSS)
+    # a TTU node whose via-row changed since the base snapshot cannot
+    # trust even a 0 degree — the row may have gained tuples
+    dirt = dirt | (p_ttu & ttu_nd)
 
     # depth guards: <=0 for check/or/and (engine.go:215, rewrites.go:39),
     # <0 for NOT/CSS/TTU (rewrites.go:141,214,247); BATCHCSS has none
@@ -266,6 +297,7 @@ def _classify_level(g, t, q_subj):
         node=node, prog_root=prog_root,
         r0=(has_rw & (d >= 1)).astype(i32),
         deg=deg, pk=pk, pp=pp, node_ttu=node_ttu,
+        dirt=dirt,
     )
     return t, count, aux
 
@@ -558,10 +590,10 @@ def _fast_subrun(g, fb, *, sched, max_width: int):
             nxt, q_found=q_found, q_over=q_over, q_dirty=q_dirty,
             q_subj=s["q_subj"],
         )
-    # general queries never dispatch under a write overlay (tpu.py routes
-    # them to the oracle then), so dirty should be impossible — fold it
-    # into over defensively rather than silently mis-serve
-    return s["q_found"], s["q_over"] | s["q_dirty"], occ
+    # found is monotone and overlay-exact (probes consult om_), so a
+    # found leaf is trustworthy even when exploration brushed a dirty
+    # row; an UNFOUND dirty leaf must be answered by the host oracle
+    return s["q_found"], s["q_over"], s["q_dirty"], occ
 
 
 @functools.partial(
@@ -582,7 +614,9 @@ def run_general_packed(
 
     ``qpack``: int32[6, Q] (ns, obj, rel, subj, depth, active).
     ``sizes``: per-level task capacities for levels 1..D (level 0 = Q).
-    Returns (codes uint8[Q]: bits 0-1 = R_* result, bit 2 = over;
+    Returns (codes uint8[Q]: bits 0-1 = R_* result, bit 2 = over, bit 3 =
+    dirty (a pending-write overlay touched stale state — host oracle must
+    answer; a device retry would see the same stale base);
     occ int32[D+2+len(fast_sched)]: skeleton per-level live-task counts
     (D+1), total fast-leaf count, then the BFS sub-run's per-level live
     counts — the layout tpu._update_gen_occ unpacks).
@@ -590,15 +624,20 @@ def run_general_packed(
     Q = qpack.shape[1]
     q_subj = qpack[3]
     q_over = jnp.zeros((Q,), bool)
+    q_dirty = jnp.zeros((Q,), bool)
     vset = tuple(
         jnp.full((hashtab._bucket_pow2(2 * vcap, 16),), _I32MAX, jnp.int32)
         for _ in range(4)
     )
 
+    def _fold_dirty(q_dirty, t, aux):
+        return q_dirty.at[jnp.clip(t["qid"], 0, Q - 1)].max(aux["dirt"])
+
     # -- down pass: build the algebra skeleton ------------------------------
     levels: List[Dict[str, jax.Array]] = [_init_roots(qpack, Q)]
     level_base = 0
     t, count, aux = _classify_level(g, levels[0], q_subj)
+    q_dirty = _fold_dirty(q_dirty, t, aux)
     for A in sizes:
         t, child, vset, q_over = _construct_level(
             g, t, count, aux, vset, q_over,
@@ -608,6 +647,7 @@ def run_general_packed(
         level_base += t["kind"].shape[0]
         levels.append(child)
         t, count, aux = _classify_level(g, child, q_subj)
+        q_dirty = _fold_dirty(q_dirty, t, aux)
     # last level: any task still needing children exhausts the level
     # budget — UNKNOWN + over (host fallback), like check_step's max_iters.
     # K_FAST tasks never take skeleton children (count stays 0), so they
@@ -626,13 +666,15 @@ def run_general_packed(
 
     # -- delegate pure-OR leaves to the fused BFS ---------------------------
     levels, fb, q_over, fast_n = _collect_fast(levels, q_subj, q_over, fast_b, Q)
-    found, fover, fast_occ = _fast_subrun(
+    found, fover, fdirty, fast_occ = _fast_subrun(
         g, fb, sched=fast_sched, max_width=max_width
     )
 
     # map leaf verdicts back: pure-OR checks with depth >= 1 are exactly
     # IS/NOT (OR swallows UNKNOWN at every level); depth <= 0 is the
-    # root guard UNKNOWN unless a forced probe hit
+    # root guard UNKNOWN unless a forced probe hit.  A found leaf stands
+    # even under an overlay (monotone, overlay-exact probes); an unfound
+    # leaf that brushed a dirty row marks its root for the host oracle.
     for i, t in enumerate(levels):
         fid = t["fast_id"]
         has = fid >= 0
@@ -640,7 +682,9 @@ def run_general_packed(
         f_res = jnp.where(
             found[fc], R_IS, jnp.where(t["d"] >= 1, R_NOT, R_UNKNOWN)
         )
-        q_over = q_over.at[jnp.clip(t["qid"], 0, Q - 1)].max(has & fover[fc])
+        qc = jnp.clip(t["qid"], 0, Q - 1)
+        q_over = q_over.at[qc].max(has & fover[fc])
+        q_dirty = q_dirty.at[qc].max(has & fdirty[fc] & ~found[fc])
         levels[i] = dict(
             t,
             resolved=t["resolved"] | has,
@@ -687,6 +731,7 @@ def run_general_packed(
     codes = (
         levels[0]["res"].astype(jnp.uint8)
         | (q_over.astype(jnp.uint8) << 2)
+        | (q_dirty.astype(jnp.uint8) << 3)
     )
     # occupancy feed for the engine's adaptive scheduler: skeleton level
     # counts (D+1), total fast leaves, then the BFS sub-run's per-level

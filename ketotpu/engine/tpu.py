@@ -601,13 +601,11 @@ class DeviceCheckEngine:
             max_width=self.max_width,
             mults=self._adaptive_mults(),
         )
+        # the algebra program is overlay-aware (probes consult the om_
+        # delta tables, stale edge rows raise the per-query dirty bit that
+        # routes just those queries to the oracle), so general queries
+        # dispatch on-device even with pending writes
         gres = gi = None
-        if general.any() and overlay_active:
-            # the general-path interpreter reads the stale base arrays; with
-            # an overlay pending its verdicts could miss writes, so those
-            # (rare: AND/NOT-reachable) queries go to the oracle directly
-            err = err | general
-            general = np.zeros_like(general)
         if general.any():
             gi = np.flatnonzero(general)
             gres = self._run_general(dev_arrays, enc, gi)
@@ -770,11 +768,16 @@ class DeviceCheckEngine:
             self._update_gen_occ(np.asarray(gres[1]), gres[3])
             codes = (packed & 3).astype(np.int8)
             gover = ((packed >> 2) & 1).astype(bool)
+            # dirty: the skeleton touched overlay-stale state (a changed
+            # edge row) — under AND/NOT even an IS verdict can be wrong
+            # (a missed child IS inverts through NOT), so the oracle
+            # answers; a device retry would read the same stale base
+            gdirty = ((packed >> 3) & 1).astype(bool)
             allowed[gi] = codes == dev.R_IS
             # overflow retry tier for the general path, mirroring the fast
             # path: re-run just the overflowed roots at boosted caps (small
             # batch => ample per-root slots) before any oracle fallback
-            gunres = gover & (codes != dev.R_ERR)
+            gunres = gover & ~gdirty & (codes != dev.R_ERR)
             if retry and gunres.any() and self.retry_scale > 1:
                 ri = gi[np.flatnonzero(gunres)]
                 self.retries += len(ri)
@@ -784,11 +787,12 @@ class DeviceCheckEngine:
                 rpacked = np.asarray(rh[0])[: rh[2]]
                 rcodes = (rpacked & 3).astype(np.int8)
                 rover = ((rpacked >> 2) & 1).astype(bool)
+                rdirty = ((rpacked >> 3) & 1).astype(bool)
                 allowed[ri] = rcodes == dev.R_IS
-                gover[gunres] = rover | (rcodes == dev.R_ERR)
+                gover[gunres] = rover | rdirty | (rcodes == dev.R_ERR)
                 codes = codes.copy()
                 codes[np.flatnonzero(gunres)] = rcodes
-            fallback[gi] |= gover | (codes == dev.R_ERR)
+            fallback[gi] |= gover | gdirty | (codes == dev.R_ERR)
 
         codes = np.asarray(res)[:n]  # one D2H fetch for all three masks
         self._update_occ(np.asarray(occ))

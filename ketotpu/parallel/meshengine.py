@@ -234,10 +234,15 @@ class MeshCheckEngine(DeviceCheckEngine):
         from ketotpu.parallel.mesh import shard_general_check
 
         n = len(gi)
-        # _bucket15 values at floor 256 divide by any power-of-two mesh
+        # shard_general_check requires qpad % mesh == 0, and neither
+        # _bucket15's 3*2^k rungs (384 is not divisible by a 256-device
+        # mesh) nor a configured max_batch clamp guarantee that: round up
+        # to the next mesh multiple AFTER clamping (the overshoot is
+        # < n_shards rows, preferable to a serve-time ValueError)
         qpad = min(
             _bucket15(max(n, self.n_shards), 256), self.max_batch
         )
+        qpad = -(-max(qpad, n) // self.n_shards) * self.n_shards
         genc = self._pad(tuple(a[gi] for a in enc), n, qpad)
         active = np.arange(qpad) < n
         qpack = np.stack([*genc, active.astype(np.int32)]).astype(np.int32)

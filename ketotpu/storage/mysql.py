@@ -1,0 +1,243 @@
+"""Durable relation-tuple store on MySQL.
+
+The third dialect of the SQL persister matrix (the reference runs ONE
+persister over sqlite / postgres / mysql / cockroach selected by DSN,
+`internal/persistence/sql/full_test.go:32`,
+`internal/x/dbx/dsn_testutils.go:106-160`, with per-dialect migration
+variants under `internal/persistence/sql/migrations/`).  Like
+`storage/postgres.py`, this subclasses `SQLiteTupleStore` and inherits
+every query, pagination rule, change-log and nid-isolation behavior
+verbatim — only the connection adapter (`_open`) and the dialect DDL
+(`BASE_MIGRATIONS`) differ.
+
+MySQL-specific translation, all at execute time in `_MyConn`:
+
+* ``?`` placeholders → ``%s``;
+* ``BEGIN DEFERRED/IMMEDIATE`` → ``BEGIN`` (server-side transactions on
+  an autocommit connection, as the store body already issues);
+* ``INSERT OR IGNORE`` → ``INSERT IGNORE``;
+* sqlite/postgres upsert (``ON CONFLICT (..) DO UPDATE SET value =
+  excluded.value``) → ``ON DUPLICATE KEY UPDATE value = VALUES(value)``;
+* the lowercase ``key`` column of ``keto_meta`` is a reserved word in
+  MySQL → backtick-quoted (case-sensitive token replace; the uppercase
+  ``KEY`` in PRIMARY KEY / DUPLICATE KEY is untouched);
+* ``PRAGMA`` → no-op.
+
+DDL differences: AUTO_INCREMENT keys, VARCHAR(255) for indexed columns
+(MySQL cannot index unbounded TEXT), no partial indexes (plain indexes
+instead — correctness is unaffected, they just include the NULL rows).
+
+Drivers: `pymysql`, `MySQLdb` (mysqlclient) or `mysql.connector`,
+imported lazily — none ships in this image, so construction raises a
+clear error without one and the conformance leg in tests/test_storage.py
+is DSN-gated via ``KETO_TEST_MYSQL_DSN`` (the CI workflow provides a
+mysql service container), exactly like the Postgres leg.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Iterable, List, Tuple
+
+from ketotpu.storage.sqlite import DEFAULT_NID, SQLiteTupleStore
+
+MY_MIGRATIONS: List[Tuple[str, List[str], List[str]]] = [
+    # MySQL DDL implicitly commits (no transactional migrations), so every
+    # statement must be IDEMPOTENT: a crash between a CREATE and the
+    # keto_migrations bookkeeping row must not brick the next migrate_up.
+    # Indexes are declared inline (CREATE INDEX has no IF NOT EXISTS in
+    # MySQL; inline declarations ride the table's IF NOT EXISTS).
+    (
+        "20240101000001_relation_tuples",
+        [
+            """CREATE TABLE IF NOT EXISTS keto_relation_tuples (
+                seq BIGINT PRIMARY KEY AUTO_INCREMENT,
+                nid VARCHAR(255) NOT NULL,
+                namespace VARCHAR(255) NOT NULL,
+                object VARCHAR(255) NOT NULL,
+                relation VARCHAR(255) NOT NULL,
+                subject_id VARCHAR(255),
+                subject_set_namespace VARCHAR(255),
+                subject_set_object VARCHAR(255),
+                subject_set_relation VARCHAR(255),
+                commit_time DOUBLE NOT NULL,
+                INDEX keto_rt_userset (nid, namespace, object, relation),
+                INDEX keto_rt_subject_id (nid, subject_id),
+                INDEX keto_rt_subject_set (nid, subject_set_namespace,
+                    subject_set_object, subject_set_relation)
+            )""",
+        ],
+        ["DROP TABLE IF EXISTS keto_relation_tuples"],
+    ),
+    (
+        "20240101000002_change_log",
+        [
+            """CREATE TABLE IF NOT EXISTS keto_change_log (
+                id BIGINT PRIMARY KEY AUTO_INCREMENT,
+                nid VARCHAR(255) NOT NULL,
+                op INTEGER NOT NULL,
+                namespace VARCHAR(255) NOT NULL,
+                object VARCHAR(255) NOT NULL,
+                relation VARCHAR(255) NOT NULL,
+                subject_id VARCHAR(255),
+                subject_set_namespace VARCHAR(255),
+                subject_set_object VARCHAR(255),
+                subject_set_relation VARCHAR(255),
+                INDEX keto_cl_nid (nid, id)
+            )""",
+        ],
+        ["DROP TABLE IF EXISTS keto_change_log"],
+    ),
+    (
+        "20240101000003_meta",
+        [
+            """CREATE TABLE IF NOT EXISTS keto_meta (
+                nid VARCHAR(255) NOT NULL,
+                `key` VARCHAR(255) NOT NULL,
+                value TEXT NOT NULL,
+                PRIMARY KEY (nid, `key`)
+            )""",
+        ],
+        ["DROP TABLE IF EXISTS keto_meta"],
+    ),
+    (
+        "20240101000004_uuid_mappings",
+        [
+            """CREATE TABLE IF NOT EXISTS keto_uuid_mappings (
+                id VARCHAR(255) PRIMARY KEY,
+                string_representation TEXT NOT NULL
+            )""",
+        ],
+        ["DROP TABLE IF EXISTS keto_uuid_mappings"],
+    ),
+]
+
+# sqlite/postgres upsert tail the shared body emits (sqlite.py
+# _bump_locked / log-floor trim) → MySQL's form.  The conflict target is
+# always the PK, so ON DUPLICATE KEY is the exact equivalent.
+_UPSERT = re.compile(
+    r"ON CONFLICT \([^)]*\)\s*DO UPDATE SET value = excluded\.value"
+)
+# the keto_meta `key` column: lowercase token only (PRIMARY KEY /
+# DUPLICATE KEY are uppercase in every emitted statement)
+_KEY = re.compile(r"(?<![A-Za-z_`])key(?![A-Za-z_`])")
+
+
+class _EmptyCursor:
+    def fetchall(self):
+        return []
+
+    def fetchone(self):
+        return None
+
+
+class _MyConn:
+    """DBAPI adapter exposing sqlite3's ``conn.execute(sql, params)``
+    shape over a MySQL driver connection (see module docstring)."""
+
+    def __init__(self, conn):
+        self._c = conn
+        # pymysql: autocommit(bool) method; mysql.connector / MySQLdb:
+        # autocommit attribute or method — normalize to ON
+        try:
+            conn.autocommit(True)
+        except TypeError:
+            conn.autocommit = True
+
+    def execute(self, sql: str, params=()):
+        s = sql.lstrip()
+        if s.startswith("PRAGMA"):
+            return _EmptyCursor()
+        if s.startswith("BEGIN"):
+            s = "BEGIN"
+        elif s.startswith("INSERT OR IGNORE"):
+            s = s.replace("INSERT OR IGNORE", "INSERT IGNORE", 1)
+        elif "version TEXT PRIMARY KEY" in s:
+            # the store's shared keto_migrations DDL: MySQL cannot key an
+            # unbounded TEXT column
+            s = s.replace(
+                "version TEXT PRIMARY KEY", "version VARCHAR(255) PRIMARY KEY"
+            )
+        s = _UPSERT.sub("ON DUPLICATE KEY UPDATE value = VALUES(value)", s)
+        s = _KEY.sub("`key`", s)
+        cur = self._c.cursor()
+        cur.execute(s.replace("?", "%s"), tuple(params))
+        return cur
+
+    def close(self):
+        self._c.close()
+
+
+def _connect_my(dsn: str):
+    from urllib.parse import unquote, urlparse
+
+    u = urlparse(dsn)
+    kw = dict(
+        user=unquote(u.username or "root"),
+        password=unquote(u.password or ""),
+        host=u.hostname or "localhost",
+        port=u.port or 3306,
+        database=(u.path or "/mysql").lstrip("/"),
+    )
+    try:
+        import pymysql
+
+        return pymysql.connect(**kw)
+    except ImportError:
+        pass
+    try:
+        import MySQLdb
+
+        kw["passwd"] = kw.pop("password")
+        kw["db"] = kw.pop("database")
+        return MySQLdb.connect(**kw)
+    except ImportError:
+        pass
+    try:
+        import mysql.connector
+
+        return mysql.connector.connect(**kw)
+    except ImportError:
+        raise RuntimeError(
+            "MySQLTupleStore needs pymysql, mysqlclient or mysql-connector;"
+            " none is installed (set a sqlite:// or memory dsn, or install"
+            " a driver)"
+        )
+
+
+class MySQLTupleStore(SQLiteTupleStore):
+    """Manager-contract store on MySQL; one network id per handle.
+
+    Same conformance surface as the in-memory / SQLite / Postgres /
+    columnar backends (tests/test_storage.py); schema migrations are the
+    MySQL dialect of the same versioned set.
+    """
+
+    BASE_MIGRATIONS = MY_MIGRATIONS
+
+    def __init__(
+        self,
+        dsn: str,
+        *,
+        network_id: str = DEFAULT_NID,
+        auto_migrate: bool = None,
+        log_cap: int = 65536,
+        extra_migrations: Iterable[Tuple[str, List[str], List[str]]] = (),
+        tracer=None,
+    ):
+        super().__init__(
+            dsn,
+            network_id=network_id,
+            auto_migrate=auto_migrate,
+            log_cap=log_cap,
+            extra_migrations=extra_migrations,
+            tracer=tracer,
+        )
+
+    def _open(self, path: str):
+        return _MyConn(_connect_my(path))
+
+    @staticmethod
+    def _default_auto_migrate(path: str) -> bool:
+        # a real server is never ephemeral: migrate explicitly
+        return False

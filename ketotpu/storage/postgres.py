@@ -193,6 +193,7 @@ class PostgresTupleStore(SQLiteTupleStore):
         auto_migrate: bool = None,
         log_cap: int = 65536,
         extra_migrations: Iterable[Tuple[str, List[str], List[str]]] = (),
+        tracer=None,
     ):
         super().__init__(
             dsn,
@@ -200,6 +201,7 @@ class PostgresTupleStore(SQLiteTupleStore):
             auto_migrate=auto_migrate,
             log_cap=log_cap,
             extra_migrations=extra_migrations,
+            tracer=tracer,
         )
 
     def _open(self, path: str):

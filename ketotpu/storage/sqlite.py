@@ -163,6 +163,26 @@ class SQLiteReverseStore:
         return row[0] if row else None
 
 
+class _TracedConn:
+    """Connection proxy opening a ``sql-conn-query`` span per statement —
+    the reference instruments at the same seam (instrumentedsql wired
+    into the pop connection, `internal/driver/pop_connection.go:26-31`),
+    and its queries-per-check KPI counts exactly these spans
+    (`internal/check/bench_test.go:171-183`).  Dialect-independent: it
+    wraps whatever `_open` returned (sqlite3 or the Postgres adapter)."""
+
+    def __init__(self, conn, tracer):
+        self._conn = conn
+        self._tracer = tracer
+
+    def execute(self, sql: str, params=()):
+        with self._tracer.span("sql-conn-query", query=sql, args=params):
+            return self._conn.execute(sql, params)
+
+    def __getattr__(self, name):
+        return getattr(self._conn, name)
+
+
 class SQLiteTupleStore:
     """Durable Manager-contract store; one network id per handle."""
 
@@ -177,6 +197,7 @@ class SQLiteTupleStore:
         auto_migrate: Optional[bool] = None,
         log_cap: int = 65536,
         extra_migrations: Iterable[Tuple[str, List[str], List[str]]] = (),
+        tracer=None,
     ):
         self._lock = threading.RLock()
         self.path = path
@@ -198,6 +219,8 @@ class SQLiteTupleStore:
         # the reference runs one persister over a DSN-selected dialect
         # matrix the same way (internal/persistence/sql/full_test.go:32).
         self._db = self._open(path)
+        if tracer is not None:
+            self._db = _TracedConn(self._db, tracer)
         self._db.execute(
             """CREATE TABLE IF NOT EXISTS keto_migrations (
                 version TEXT PRIMARY KEY, applied_at REAL NOT NULL)"""
@@ -217,6 +240,12 @@ class SQLiteTupleStore:
             db.execute("PRAGMA journal_mode=WAL")
             db.execute("PRAGMA synchronous=NORMAL")
         return db
+
+    def set_tracer(self, tracer) -> None:
+        """(Re)bind statement tracing after construction — the registry
+        builds the store before the tracer in some assembly orders."""
+        base = getattr(self._db, "_conn", self._db)
+        self._db = base if tracer is None else _TracedConn(base, tracer)
 
     @staticmethod
     def _default_auto_migrate(path: str) -> bool:

