@@ -446,10 +446,15 @@ def cmd_status(args) -> int:
     from ketotpu.proto.services import _stub_class
 
     deadline = time.monotonic() + args.timeout
-    with _channel(args.read_remote, args) as ch:
-        stub = _stub_class("grpc.health.v1.Health")(ch)
-        while True:
-            try:
+    while True:
+        # a FRESH channel per attempt: with skip-hostname-verification the
+        # channel pins the certificate fetched at creation time — a
+        # channel built while the server was still down carries default
+        # host-CA creds and could never verify the self-signed cert once
+        # it comes up, so --block would time out against a healthy server
+        try:
+            with _channel(args.read_remote, args) as ch:
+                stub = _stub_class("grpc.health.v1.Health")(ch)
                 resp = stub.Check(health_pb2.HealthCheckRequest())
                 if resp.status == health_pb2.HealthCheckResponse.SERVING:
                     print("status: SERVING")
@@ -457,14 +462,14 @@ def cmd_status(args) -> int:
                 print(f"status: {resp.status}")
                 if not args.block:
                     return 1
-            except grpc.RpcError as e:
-                if not args.block:
-                    print(f"status: unreachable ({e.code()})", file=sys.stderr)
-                    return 1
-            if time.monotonic() > deadline:
-                print("status: timeout", file=sys.stderr)
+        except grpc.RpcError as e:
+            if not args.block:
+                print(f"status: unreachable ({e.code()})", file=sys.stderr)
                 return 1
-            time.sleep(1.0)
+        if time.monotonic() > deadline:
+            print("status: timeout", file=sys.stderr)
+            return 1
+        time.sleep(1.0)
 
 
 def cmd_ns_generate_opl(args) -> int:

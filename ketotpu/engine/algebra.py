@@ -1,9 +1,10 @@
 """Fused leveled algebra path: batched AND/NOT checks as ONE device program.
 
-The round-3 general path (`engine/device.py`) interprets the check algebra
-with a host-stepped state machine over ONE bump-allocated task buffer:
-every step re-scans all `cap` slots, runs multiple result-propagation
-passes, and the host syncs a flags word per 6-level window to decide
+The round-3 general path (a host-stepped task-tree interpreter, retired
+in round 5) interpreted the check algebra
+over ONE bump-allocated task buffer:
+every step re-scanned all `cap` slots, ran multiple result-propagation
+passes, and the host synced a flags word per 6-level window to decide
 whether to keep stepping.  Measured cost: ~134 checks/s — two orders of
 magnitude under the pure-OR fast path — dominated by (a) cap-sized work
 per step regardless of live tasks, (b) blocking flag syncs on a
@@ -49,7 +50,7 @@ Semantics notes (differential-tested against `engine/oracle.py`):
   skips recursion, so duplicates still probe, they just do not expand.
 * The visited set (engine.go:119,157-162) covers expansion children
   only, keyed by (scope, ns, obj, rel) in the same open-addressed hash
-  set the round-3 interpreter used; scopes open at the first expanding
+  set the round-3 interpreter introduced; scopes open at the first expanding
   ancestor and are globally unique via static level bases.
 * A direct/forced membership hit short-circuits its whole subtree ONLY
   when the relation's closure cannot raise a client error (`err_reach`
@@ -76,7 +77,7 @@ import numpy as np
 
 from ketotpu.engine import fastpath as fp
 from ketotpu.engine import hashtab
-from ketotpu.engine.device import (
+from ketotpu.engine.optable import (
     OP_AND,
     OP_NOT,
     OP_OR,
@@ -111,6 +112,18 @@ def _member(g, node, subj):
     return _member_raw(g, node, subj) & (node >= 0) & (subj >= 0)
 
 
+def _shard_owner(ns, obj, n: int):
+    """Owner shard of (namespace, object): the sharded general tier must
+    activate each task on the shard that holds its rows, so this is
+    graphshard's own partitioning function (a diverged copy would
+    classify every task against a slice that does not contain it —
+    silent all-deny).  Lazy import: engine->parallel is upside-down
+    layering for a module import, and only the shard branch needs it."""
+    from ketotpu.parallel.graphshard import shard_of_device
+
+    return shard_of_device(ns, obj, n)
+
+
 def _deg_guarded(g, node):
     """Edge-row degree with overlay semantics: a dirty row's base edges
     are stale and an overlay-created virtual node (>= ov_nbase) has no
@@ -129,7 +142,7 @@ _I32MAX = jnp.iinfo(jnp.int32).max
 # pure-OR leaf (resolved by the fused BFS sub-run)
 K_CHECK, K_PROG, K_FAST = 0, 1, 2
 
-# linear-probe window of the visited hash set (device.py phase F)
+# linear-probe window of the visited hash set
 _VPROBE = 8
 
 
@@ -157,9 +170,9 @@ def _init_roots(qpack, Q: int) -> Dict[str, jax.Array]:
 def _classify_level(g, t, q_subj):
     """Resolve in-place leaves; compute child counts and combiner ops.
 
-    Mirrors device.check_step phase A exactly, with KC_DIRECT / KC_EXPAND
-    flattened into the CHECK task itself (direct membership is a probe
-    seed, expansion edges are immediate children at depth-1) — the same
+    Mirrors the retired interpreter's classification phase, with direct/expand
+    subchecks flattened into the CHECK task itself (direct membership is a
+    probe seed, expansion edges are immediate children at depth-1) — the same
     flattening the fast path uses, engine.go:242-245 depth math intact.
     """
     NS, R = g["f_direct_ok"].shape
@@ -248,7 +261,7 @@ def _classify_level(g, t, q_subj):
         0,
     )
 
-    # resolution (order mirrors check_step resolve_a: guard first, then
+    # resolution (order mirrors the oracle: guard first, then
     # err, then probes, then empty-group NOT — binop.go:25-27)
     guard_is = is_check & (d <= 0) & t["force"] & member
     r_guard = guard & ~guard_is
@@ -304,8 +317,8 @@ def _classify_level(g, t, q_subj):
 
 def _visited(vset, k1, k2, k3, k4, evc, A: int):
     """Probe-and-insert into the open-addressed visited hash set
-    (device.py phase F design: membership test, in-batch first-occurrence
-    dedup by min arena index, insertion — one linear-probe loop)."""
+    (membership test, in-batch first-occurrence dedup by min arena index,
+    insertion — one linear-probe loop)."""
     v1, v2, v3, v4 = vset
     VS = v1.shape[0]
     k1 = jnp.where(evc, k1, _I32MAX)
@@ -355,9 +368,11 @@ def _visited(vset, k1, k2, k3, k4, evc, A: int):
 def _construct_level(
     g, t, count, aux, vset, q_over, *,
     A: int, level_base: int, max_width: int, Q: int,
+    pmine=None,
 ):
-    """Allocate and build the next level's tasks (check_step phases B/C/E/F
-    with the per-level arena BEING the next level — dense, no pack)."""
+    """Allocate and build the next level's tasks — child allocation,
+    edge/program gathers, visited-set insertion — with the per-level
+    arena BEING the next level (dense, no pack)."""
     NS, R = g["f_direct_ok"].shape
     F = t["kind"].shape[0]
     i32 = jnp.int32
@@ -478,8 +493,16 @@ def _construct_level(
 
     # visited set covers expansion children only; duplicates keep their
     # EXISTS probe (row iteration probes before the visited check skips
-    # recursion, engine.go:131-139,157-162) as probe-only leaves
+    # recursion, engine.go:131-139,157-162) as probe-only leaves.
+    # Sharded: only the parent's OWNER shard has real edge gathers — the
+    # other shards' rows are garbage that must not enter the (shard-
+    # local) visited set or raise spurious overflow.  Cross-shard
+    # duplicate children are tolerated: the visited set exists for
+    # capacity/cycle economy, not semantics (OR is idempotent and the
+    # depth budget bounds recursion), so per-shard dedup is sound.
     evc = c_edge & ~trunc
+    if pmine is not None:
+        evc = evc & pmine[aps]
     vset, seen, vpend = _visited(
         vset, ch_vscope, ch_ns, ch_obj, ch_rel, evc, A
     )
@@ -552,18 +575,33 @@ def _collect_fast(levels, q_subj, q_over, B: int, Q: int):
     return out_levels, fb, q_over, base
 
 
-def _fast_subrun(g, fb, *, sched, max_width: int):
+def _fast_subrun(g, fb, *, sched, max_width: int, shard=None):
     """The fast path's fused BFS over the collected pure-OR leaves.
 
     Leaf depths, skip and force flags carry the mid-tree context
     (skip_direct from expansion / batched-CSS parents, forced EXISTS /
     probe-shortcut probes).  Returns (found, over) per leaf.
+
+    ``shard=(axis_name, n)``: the graph is SHARDED by (ns, obj) — each
+    leaf activates on its owner shard, children are routed to their
+    owners with all_to_all between levels, and found/over/dirty bits are
+    psum-merged (the graphshard.sharded_check loop over a shared global
+    leaf index space).
     """
     NS, R = g["f_direct_ok"].shape
     B = fb["ns"].shape[0]
     iota = jnp.arange(B, dtype=jnp.int32)
+    active = fb["valid"]
+    if shard is not None:
+        axis_name, n_sh = shard
+        # engine->parallel is upside-down layering for a module import;
+        # the routing primitive is only needed on this branch
+        from ketotpu.parallel.graphshard import _route
+
+        me = jax.lax.axis_index(axis_name)
+        active = active & (_shard_owner(fb["ns"], fb["obj"], n_sh) == me)
     s = dict(
-        f_qid=jnp.where(fb["valid"], iota, -1),
+        f_qid=jnp.where(active, iota, -1),
         f_ns=fb["ns"],
         f_obj=fb["obj"],
         f_rel=fb["rel"],
@@ -583,6 +621,13 @@ def _fast_subrun(g, fb, *, sched, max_width: int):
             g, s, arena=a, max_width=max_width,
             probe_only=(i == len(sched) - 1),
         )
+        if shard is not None:
+            children, q_over = _route(
+                children, n_sh, max(a // n_sh, 8), q_over, axis_name
+            )
+            # merge found bits across shards before packing so arrived
+            # children of already-found leaves die immediately
+            q_found = jax.lax.psum(q_found.astype(jnp.int32), axis_name) > 0
         nxt, q_over = fp.pack_phase(
             children, q_found, q_over, frontier=nxt_f, ns_dim=NS, rel_dim=R
         )
@@ -590,15 +635,22 @@ def _fast_subrun(g, fb, *, sched, max_width: int):
             nxt, q_found=q_found, q_over=q_over, q_dirty=q_dirty,
             q_subj=s["q_subj"],
         )
+    q_found, q_over, q_dirty = s["q_found"], s["q_over"], s["q_dirty"]
+    if shard is not None:
+        q_found = jax.lax.psum(q_found.astype(jnp.int32), axis_name) > 0
+        q_over = jax.lax.psum(q_over.astype(jnp.int32), axis_name) > 0
+        q_dirty = jax.lax.psum(q_dirty.astype(jnp.int32), axis_name) > 0
     # found is monotone and overlay-exact (probes consult om_), so a
     # found leaf is trustworthy even when exploration brushed a dirty
     # row; an UNFOUND dirty leaf must be answered by the host oracle
-    return s["q_found"], s["q_over"], s["q_dirty"], occ
+    return q_found, q_over, q_dirty, occ
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("sizes", "fast_b", "fast_sched", "max_width", "vcap"),
+    static_argnames=(
+        "sizes", "fast_b", "fast_sched", "max_width", "vcap", "shard",
+    ),
 )
 def run_general_packed(
     g: Dict[str, jax.Array],
@@ -609,6 +661,7 @@ def run_general_packed(
     fast_sched: Tuple[Tuple[int, int], ...],
     max_width: int = 100,
     vcap: int = 4096,
+    shard: Tuple[str, int] = None,
 ):
     """One fused dispatch answering a whole general (AND/NOT) batch.
 
@@ -620,6 +673,21 @@ def run_general_packed(
     occ int32[D+2+len(fast_sched)]: skeleton per-level live-task counts
     (D+1), total fast-leaf count, then the BFS sub-run's per-level live
     counts — the layout tpu._update_gen_occ unpacks).
+
+    ``shard=(axis_name, n)`` runs the SAME program against a
+    (ns, obj)-hash-sharded graph slice inside a shard_map (the mesh
+    engine's general tier, no replica): the (ns, obj) partitioning keeps
+    every per-task read — node lookup, membership and batched-CSS
+    probes, expansion edge rows, TTU via-rows — on the task's owner
+    shard, and the program/config tables are identical on every shard by
+    construction.  The skeleton stays GLOBALLY CONSISTENT: every shard
+    holds the full level arenas; classification/construction is masked
+    to each task's owner and psum-merged (exactly one owner per task, so
+    the owner's values survive), which keeps `arena_assign` and the
+    whole up pass deterministic and collective-free.  Fast leaves run
+    the graphshard BFS (owner-activated, all_to_all-routed children).
+    Per-level collective cost: ~a dozen psums of level-sized int32
+    arrays riding ICI.
     """
     Q = qpack.shape[1]
     q_subj = qpack[3]
@@ -630,6 +698,62 @@ def run_general_packed(
         for _ in range(4)
     )
 
+    if shard is not None:
+        axis_name, n_sh = shard
+        me = jax.lax.axis_index(axis_name)
+
+        def _mi(x, mine):  # owner-masked int merge (exactly one owner)
+            return jax.lax.psum(jnp.where(mine, x, 0), axis_name)
+
+        def _mb(x, mine):
+            return jax.lax.psum(
+                jnp.where(mine, x.astype(jnp.int32), 0), axis_name
+            ) > 0
+
+        def _merge_classified(t, count, aux):
+            """Keep the owner shard's data-dependent classification for
+            every task; recompute the config-derived program fields from
+            the merged adoption state."""
+            mine = _shard_owner(t["ns"], t["obj"], n_sh) == me
+            t = dict(
+                t,
+                kind=_mi(t["kind"], mine),
+                prog=_mi(t["prog"], mine),
+                resolved=_mb(t["resolved"], mine),
+                res=_mi(t["res"], mine),
+                cop=_mi(t["cop"], mine),
+                seed=_mb(t["seed"], mine),
+            )
+            pp = jnp.clip(t["prog"], 0, g["p_kind"].shape[0] - 1)
+            aux = dict(
+                aux,
+                deg=_mi(aux["deg"], mine),
+                dirt=_mb(aux["dirt"], mine),
+                pp=pp,
+                pk=g["p_kind"][pp],
+            )
+            return t, _mi(count, mine), aux, mine
+
+        def _merge_child(child, pmine):
+            """Children carry the values their PARENT's owner computed
+            (edge gathers live there); empty rows have exactly one owner
+            too (slot 0's), which contributes the shared fill values."""
+            F = pmine.shape[0]
+            ap = child["parent"]
+            mine_p = pmine[jnp.clip(ap, 0, F - 1)]
+            out = {}
+            for k, v in child.items():
+                if v.dtype == jnp.bool_:
+                    out[k] = _mb(v, mine_p)
+                else:
+                    out[k] = _mi(v, mine_p)
+            return out
+
+        def _pmax_bool(x):
+            return jax.lax.psum(x.astype(jnp.int32), axis_name) > 0
+    else:
+        _merge_classified = None
+
     def _fold_dirty(q_dirty, t, aux):
         return q_dirty.at[jnp.clip(t["qid"], 0, Q - 1)].max(aux["dirt"])
 
@@ -637,19 +761,27 @@ def run_general_packed(
     levels: List[Dict[str, jax.Array]] = [_init_roots(qpack, Q)]
     level_base = 0
     t, count, aux = _classify_level(g, levels[0], q_subj)
+    pmine = None
+    if shard is not None:
+        t, count, aux, pmine = _merge_classified(t, count, aux)
     q_dirty = _fold_dirty(q_dirty, t, aux)
     for A in sizes:
         t, child, vset, q_over = _construct_level(
             g, t, count, aux, vset, q_over,
             A=A, level_base=level_base, max_width=max_width, Q=Q,
+            pmine=pmine,
         )
+        if shard is not None:
+            child = _merge_child(child, pmine)
         levels[-1] = t
         level_base += t["kind"].shape[0]
         levels.append(child)
         t, count, aux = _classify_level(g, child, q_subj)
+        if shard is not None:
+            t, count, aux, pmine = _merge_classified(t, count, aux)
         q_dirty = _fold_dirty(q_dirty, t, aux)
     # last level: any task still needing children exhausts the level
-    # budget — UNKNOWN + over (host fallback), like check_step's max_iters.
+    # budget — UNKNOWN + over (host fallback).
     # K_FAST tasks never take skeleton children (count stays 0), so they
     # are NOT capped here: they stay unresolved and _collect_fast
     # delegates them to the BFS sub-run like any other level's leaves
@@ -665,9 +797,12 @@ def run_general_packed(
     )
 
     # -- delegate pure-OR leaves to the fused BFS ---------------------------
+    # (the merged levels are identical on every shard, so the leaf
+    # compaction and fast_id assignment form a SHARED global index space
+    # — exactly what the sharded sub-run's psum-merged bits need)
     levels, fb, q_over, fast_n = _collect_fast(levels, q_subj, q_over, fast_b, Q)
     found, fover, fdirty, fast_occ = _fast_subrun(
-        g, fb, sched=fast_sched, max_width=max_width
+        g, fb, sched=fast_sched, max_width=max_width, shard=shard
     )
 
     # map leaf verdicts back: pure-OR checks with depth >= 1 are exactly
@@ -728,6 +863,13 @@ def run_general_packed(
             resolved=par["resolved"] | unres,
         )
 
+    if shard is not None:
+        # visited-set overflow (per-shard) and any other owner-local
+        # over/dirty contributions become global; everything else in
+        # q_over/q_dirty is already replicated, and OR-merging is
+        # idempotent either way
+        q_over = _pmax_bool(q_over)
+        q_dirty = _pmax_bool(q_dirty)
     codes = (
         levels[0]["res"].astype(jnp.uint8)
         | (q_over.astype(jnp.uint8) << 2)

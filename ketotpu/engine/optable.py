@@ -48,6 +48,13 @@ P_CSS = 3
 P_TTU = 4
 P_BATCHCSS = 5
 
+# three-valued check results + error standing in for Go error returns
+# (checkgroup/definitions.go:68-72) — the vocabulary of the algebra
+# program's verdict codes and the engine's device<->host contract
+R_UNKNOWN, R_IS, R_NOT, R_ERR = 0, 1, 2, 3
+# combiner ops resolving a parent from its children (binop.go:18-73)
+OP_OR, OP_AND, OP_NOT, OP_PASS = 0, 1, 2, 3
+
 
 @dataclass
 class OpTable:

@@ -64,8 +64,8 @@ if _plat:
 from ketotpu.api.types import RelationTuple
 from ketotpu.engine import algebra as alg
 from ketotpu.engine import delta as dl
-from ketotpu.engine import device as dev
 from ketotpu.engine import fastpath as fp
+from ketotpu.engine.optable import R_ERR, R_IS
 from ketotpu.engine.oracle import (
     DEFAULT_MAX_DEPTH,
     DEFAULT_MAX_WIDTH,
@@ -773,11 +773,11 @@ class DeviceCheckEngine:
             # (a missed child IS inverts through NOT), so the oracle
             # answers; a device retry would read the same stale base
             gdirty = ((packed >> 3) & 1).astype(bool)
-            allowed[gi] = codes == dev.R_IS
+            allowed[gi] = codes == R_IS
             # overflow retry tier for the general path, mirroring the fast
             # path: re-run just the overflowed roots at boosted caps (small
             # batch => ample per-root slots) before any oracle fallback
-            gunres = gover & ~gdirty & (codes != dev.R_ERR)
+            gunres = gover & ~gdirty & (codes != R_ERR)
             if retry and gunres.any() and self.retry_scale > 1:
                 ri = gi[np.flatnonzero(gunres)]
                 self.retries += len(ri)
@@ -788,11 +788,11 @@ class DeviceCheckEngine:
                 rcodes = (rpacked & 3).astype(np.int8)
                 rover = ((rpacked >> 2) & 1).astype(bool)
                 rdirty = ((rpacked >> 3) & 1).astype(bool)
-                allowed[ri] = rcodes == dev.R_IS
-                gover[gunres] = rover | rdirty | (rcodes == dev.R_ERR)
+                allowed[ri] = rcodes == R_IS
+                gover[gunres] = rover | rdirty | (rcodes == R_ERR)
                 codes = codes.copy()
                 codes[np.flatnonzero(gunres)] = rcodes
-            fallback[gi] |= gover | gdirty | (codes == dev.R_ERR)
+            fallback[gi] |= gover | gdirty | (codes == R_ERR)
 
         codes = np.asarray(res)[:n]  # one D2H fetch for all three masks
         self._update_occ(np.asarray(occ))

@@ -4,7 +4,7 @@ The reference scales out as stateless replicas over a shared SQL database
 (SURVEY §2 checklist: no collectives, no multi-process runtime exist there).
 Here scale-out is a first-class device-mesh design:
 
-* **query data-parallelism** (`shard_fast_check`, `shard_batch_check`): the
+* **query data-parallelism** (`shard_fast_check`, `shard_general_check`): the
   batch axis of checks is sharded over the mesh, the tuple graph is
   replicated — every device runs its query shard with zero cross-device
   traffic.  This is the throughput axis (BatchCheck, BASELINE config #4).
@@ -15,15 +15,20 @@ Here scale-out is a first-class device-mesh design:
   one chip's HBM (BASELINE config #5).
 """
 
-from ketotpu.parallel.graphshard import build_sharded_snapshot, sharded_check
-from ketotpu.parallel.mesh import make_mesh, shard_batch_check, shard_fast_check
+from ketotpu.parallel.graphshard import (
+    build_sharded_snapshot,
+    sharded_check,
+    sharded_general_check,
+)
+from ketotpu.parallel.mesh import make_mesh, shard_fast_check, shard_general_check
 from ketotpu.parallel.meshengine import MeshCheckEngine
 
 __all__ = [
     "MeshCheckEngine",
     "build_sharded_snapshot",
     "make_mesh",
-    "shard_batch_check",
+    "shard_general_check",
     "shard_fast_check",
     "sharded_check",
+    "sharded_general_check",
 ]

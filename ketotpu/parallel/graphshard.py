@@ -191,6 +191,64 @@ def _route(children: Dict, n: int, cap: int, q_over, axis: str):
     return out, q_over
 
 
+def sharded_general_check(
+    stacked_g: Dict[str, np.ndarray],
+    qpack: np.ndarray,
+    mesh: Mesh,
+    *,
+    axis: str = "shard",
+    sizes,
+    fast_b: int,
+    fast_sched,
+    max_width: int = 100,
+    vcap: int = 4096,
+):
+    """General (AND/NOT) checks against the SHARDED graph — no replica.
+
+    The fused algebra program runs on every shard over the full
+    (replicated) query block with per-task work owner-masked and merged
+    (algebra.run_general_packed's ``shard`` mode): the (ns, obj)
+    partitioning keeps all of a task's reads shard-local, children land
+    on their owners via the program's merge collectives, and pure-OR
+    fast leaves ride the same all_to_all-routed BFS as `sharded_check`.
+    Per-device GRAPH memory scales down with mesh size (VERDICT r4 #5);
+    only the per-batch skeleton working set is replicated.
+
+    ``sizes``/``fast_sched`` are GLOBAL shapes (the whole batch's
+    skeleton lives on every shard).  Returns (codes uint8[Q], occ
+    int32[n, L]) with codes replicated-identical across shards.
+    """
+    from ketotpu.engine import algebra as alg
+
+    @functools.partial(
+        jax.jit,
+        static_argnames=("sizes", "fast_b", "fast_sched", "max_width", "vcap"),
+    )
+    def run(g, qp, *, sizes, fast_b, fast_sched, max_width, vcap):
+        def local(g, qp):
+            g = jax.tree_util.tree_map(lambda a: a[0], g)
+            codes, occ = alg.run_general_packed(
+                g, qp, sizes=sizes, fast_b=fast_b, fast_sched=fast_sched,
+                max_width=max_width, vcap=vcap,
+                shard=(axis, mesh.devices.size),
+            )
+            return codes, occ[None, :]
+
+        return jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(jax.tree_util.tree_map(lambda _: P(axis), g), P()),
+            out_specs=(P(), P(axis)),
+            check_vma=False,
+        )(g, qp)
+
+    return run(
+        stacked_g, jnp.asarray(qpack, jnp.int32),
+        sizes=tuple(sizes), fast_b=int(fast_b),
+        fast_sched=tuple(fast_sched), max_width=max_width, vcap=vcap,
+    )
+
+
 def sharded_check(
     stacked_g: Dict[str, np.ndarray],
     queries: Sequence[np.ndarray],

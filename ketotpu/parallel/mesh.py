@@ -1,12 +1,13 @@
 """Mesh construction and query-data-parallel batch checks.
 
-`shard_batch_check` runs the device interpreter under `jax.shard_map`: the
-graph pytree is replicated to every device, the query batch is split on the
-``data`` mesh axis, and each device steps the wavefront interpreter on its
-own shard (the host loop advances all devices together; a device whose shard
-resolved early no-ops until the slowest shard finishes).  No collectives are
-needed on this axis — permission checks are independent — so throughput
-scales linearly over ICI-connected chips and across DCN hosts alike.
+The graph pytree is replicated to every device, the query batch is split
+on the ``data`` mesh axis, and each device runs the fused program on its
+own slice (`shard_fast_check` the pure-OR BFS, `shard_general_check` the
+AND/NOT algebra program).  No collectives are needed on this axis —
+permission checks are independent — so throughput scales linearly over
+ICI-connected chips and across DCN hosts alike.  (Graph-sharded
+execution, where per-device MEMORY also scales down, lives in
+parallel/graphshard.py.)
 """
 
 from __future__ import annotations
@@ -19,7 +20,6 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ketotpu.engine import device as dev
 from ketotpu.engine import fastpath as fp
 
 
@@ -148,145 +148,4 @@ def shard_general_check(
         g, jnp.asarray(qpack, jnp.int32),
         sizes=tuple(sizes), fast_b=int(fast_b),
         fast_sched=tuple(fast_sched), max_width=max_width, vcap=vcap,
-    )
-
-
-def _lift(s: Dict) -> Dict:
-    """Scalars -> [1] arrays so per-device values concatenate on 'data'."""
-    s = dict(s)
-    for k in ("cursor", "flags"):
-        s[k] = s[k][None]
-    return s
-
-
-def _unlift(s: Dict) -> Dict:
-    s = dict(s)
-    for k in ("cursor", "flags"):
-        s[k] = s[k][0]
-    return s
-
-
-def _specs(q: int):
-    """PartitionSpecs for a lifted state pytree."""
-    return dict(
-        T={
-            k: P("data")
-            for k in (
-                "state result qid kind ns obj rel depth skip vscope parent "
-                "prog cop nchild ndone nis nnot nerr delivered neg"
-            ).split()
-        },
-        vset=(P("data"),) * 4,
-        cursor=P("data"),
-        q_over=P("data"),
-        q_subj=P("data"),
-        flags=P("data"),
-    )
-
-
-@functools.partial(
-    jax.jit, static_argnames=("mesh", "cap", "vcap")
-)
-def _sharded_init(queries, *, mesh: Mesh, cap: int, vcap: int):
-    def local_init(q_ns, q_obj, q_rel, q_subj, q_depth):
-        return _lift(
-            dev.init_state(q_ns, q_obj, q_rel, q_subj, q_depth, cap=cap, vcap=vcap)
-        )
-
-    return jax.shard_map(
-        local_init,
-        mesh=mesh,
-        in_specs=(P("data"),) * 5,
-        out_specs=_specs(queries[0].shape[0]),
-        check_vma=False,
-    )(*queries)
-
-
-@functools.partial(
-    jax.jit,
-    static_argnames=("mesh", "cap", "arena", "vcap", "max_width", "strict"),
-)
-def _sharded_step(
-    g, s, *, mesh: Mesh, cap: int, arena: int, vcap: int,
-    max_width: int, strict: bool,
-):
-    def local_step(g, s):
-        return _lift(
-            dev.check_step(
-                g, _unlift(s),
-                cap=cap, arena=arena, vcap=vcap,
-                max_width=max_width, strict=strict,
-            )
-        )
-
-    specs = _specs(0)
-    return jax.shard_map(
-        local_step,
-        mesh=mesh,
-        in_specs=(jax.tree_util.tree_map(lambda _: P(), g), specs),
-        out_specs=specs,
-        check_vma=False,
-    )(g, s)
-
-
-def shard_batch_check(
-    g: Dict[str, jax.Array],
-    queries: Sequence[np.ndarray],
-    mesh: Mesh,
-    *,
-    cap: int = 8192,
-    arena: int = 8192,
-    vcap: int = 4096,
-    max_iters: int = 64,
-    max_width: int = 100,
-    strict: bool = False,
-) -> dev.RunResult:
-    """Run a query batch data-parallel over the mesh.
-
-    ``queries`` is the encoded tuple ``(q_ns, q_obj, q_rel, q_subj, q_depth)``;
-    the batch length must divide evenly by the mesh size (pad with -1 ids).
-    """
-    n = mesh.devices.size
-    q = queries[0].shape[0]
-    if q % n:
-        raise ValueError(f"batch {q} not divisible by mesh size {n}")
-    queries = tuple(jnp.asarray(a, jnp.int32) for a in queries)
-    s = _sharded_init(queries, mesh=mesh, cap=cap, vcap=vcap)
-    it = 0
-    for it in range(1, max_iters + 1):
-        s = _sharded_step(
-            g, s, mesh=mesh, cap=cap, arena=arena, vcap=vcap,
-            max_width=max_width, strict=strict,
-        )
-        flags = np.asarray(s["flags"])
-        done = (flags & dev.F_ALL_ROOTS_DONE) != 0
-        stuck = (flags & (dev.F_PENDING | dev.F_CHANGED)) == 0
-        if bool(np.all(done | stuck)):
-            break
-    # collect per-query verdicts from the sharded root slots
-    q_local = q // n
-
-    def local_collect(s):
-        T = _unlift(s)["T"]
-        root_state = T["state"][:q_local]
-        return (
-            jnp.where(root_state != dev.S_DONE, dev.R_UNKNOWN, T["result"][:q_local]),
-            s["q_over"] | (root_state != dev.S_DONE),
-            s["cursor"],
-        )
-
-    result, overflow, tasks = jax.jit(
-        jax.shard_map(
-            local_collect,
-            mesh=mesh,
-            in_specs=(_specs(0),),
-            out_specs=(P("data"), P("data"), P("data")),
-            check_vma=False,
-        )
-    )(s)
-    return dev.RunResult(
-        result=result,
-        overflow=overflow,
-        iters=jnp.int32(it),
-        tasks=jnp.sum(tasks),
     )

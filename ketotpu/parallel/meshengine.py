@@ -25,11 +25,15 @@ the fast-path dispatch.  Sharded differences:
 * **overflow retries on-device** at ``retry_scale``x frontier/arena
   before falling back — same two-tier story as the single-chip engine.
 * AND/NOT-reachable ("general") queries run the fused algebra program
-  (engine/algebra.py) **data-parallel** over a lazily-replicated,
-  budget-bounded copy of the graph (checks are independent — no
-  collectives on this axis); the host oracle is only the final fallback
-  (overflow, errors, pending-write overlays, or a graph too large for
-  the replica budget).
+  (engine/algebra.py) **against the sharded graph itself**
+  (graphshard.sharded_general_check): every per-task read is owner-local
+  under the (ns, obj) partitioning, classification merges ride psums,
+  and pure-OR fast leaves take the same all_to_all-routed BFS as the
+  fast path — per-device graph memory keeps scaling down with mesh
+  size, and the tier is overlay-aware (per-shard dirty bits psum-merge).
+  The host oracle is only the final fallback (overflow, errors, dirty
+  rows).  A budget-bounded replicated copy remains ONLY for
+  batch_expand, whose host-side tree reassembly reads global node ids.
 """
 
 from __future__ import annotations
@@ -39,7 +43,7 @@ from typing import List, Optional
 import numpy as np
 
 from ketotpu.engine import delta as dl
-from ketotpu.engine import device as dev
+from ketotpu.engine.optable import R_ERR, R_IS
 from ketotpu.engine.tpu import DeviceCheckEngine, _bucket, _bucket15
 from ketotpu.parallel import graphshard
 from ketotpu.parallel.mesh import make_mesh
@@ -74,11 +78,12 @@ class MeshCheckEngine(DeviceCheckEngine):
         self._stacked_base = None
         self._shard_snaps: Optional[List] = None
         self._shard_overlays: Optional[List[dl.OverlayState]] = None
-        # ceiling on the lazily-replicated full-graph copy the general
-        # (AND/NOT) tier and batch_expand use: replication forfeits the
-        # per-device-memory-scales-down property, so past this budget
-        # those paths fall back to the host oracle instead of silently
-        # materializing the whole graph per device (VERDICT r3 #5/#6)
+        # ceiling on the lazily-replicated full-graph copy that ONLY
+        # batch_expand still uses (its host-side tree reassembly reads
+        # global node ids): past this budget expand falls back to the
+        # host oracle instead of silently materializing the whole graph
+        # on one device.  The general (AND/NOT) tier runs against the
+        # sharded stacks and never touches this.
         self.replica_budget_bytes = replica_budget_mb << 20
         # per-shard overlay table capacity; totals still bound by
         # max_overlay_pairs/max_overlay_dirty like the single-chip engine
@@ -183,9 +188,9 @@ class MeshCheckEngine(DeviceCheckEngine):
         return True
 
     def _replica_arrays(self):
-        """Bounded lazily-replicated Check arrays (+ overlay tables), or
-        None when the full graph would exceed ``replica_budget_bytes``
-        per device — callers fall back to the host oracle then."""
+        """Bounded lazily-replicated Check arrays (+ overlay tables) for
+        batch_expand only, or None when the full graph would exceed
+        ``replica_budget_bytes`` — expand falls back to the oracle then."""
         if self._device_arrays is None:
             import jax
 
@@ -226,31 +231,24 @@ class MeshCheckEngine(DeviceCheckEngine):
             active=active,
         )
 
-    def _run_general_mesh(self, replica, enc, gi, boost: int = 1):
-        """One data-parallel fused algebra dispatch over the mesh for the
-        general (AND/NOT) roots — the single-chip program per device with
-        the query block sharded on the mesh axis (parallel/mesh.py
-        shard_general_check).  Returns (codes, occ_rows, n, fast_b)."""
-        from ketotpu.parallel.mesh import shard_general_check
-
+    def _run_general_mesh(self, stacked, enc, gi, boost: int = 1):
+        """One fused algebra dispatch over the SHARDED graph stacks for
+        the general (AND/NOT) roots (graphshard.sharded_general_check,
+        VERDICT r4 #5): no replicated graph copy — per-device graph
+        memory keeps scaling down with mesh size; only the per-batch
+        skeleton working set is replicated.  Overlay-aware like the
+        single-chip program: each shard's slice carries its own overlay
+        tables, probes run owner-side, and dirty bits psum-merge.
+        Returns (codes, occ_rows, n, fast_b)."""
         n = len(gi)
-        # shard_general_check requires qpad % mesh == 0, and neither
-        # _bucket15's 3*2^k rungs (384 is not divisible by a 256-device
-        # mesh) nor a configured max_batch clamp guarantee that: round up
-        # to the next mesh multiple AFTER clamping (the overshoot is
-        # < n_shards rows, preferable to a serve-time ValueError)
-        qpad = min(
-            _bucket15(max(n, self.n_shards), 256), self.max_batch
-        )
-        qpad = -(-max(qpad, n) // self.n_shards) * self.n_shards
+        qpad = min(_bucket15(max(n, 256), 256), self.max_batch)
         genc = self._pad(tuple(a[gi] for a in enc), n, qpad)
         active = np.arange(qpad) < n
         qpack = np.stack([*genc, active.astype(np.int32)]).astype(np.int32)
-        sizes, fast_b, fast_sched, vcap = self._gen_schedule(
-            qpad // self.n_shards, boost
-        )
-        codes, occ = shard_general_check(
-            replica, qpack, self.mesh, axis=self.mesh_axis,
+        # GLOBAL shapes: the whole batch's skeleton lives on every shard
+        sizes, fast_b, fast_sched, vcap = self._gen_schedule(qpad, boost)
+        codes, occ = graphshard.sharded_general_check(
+            stacked, qpack, self.mesh, axis=self.mesh_axis,
             sizes=sizes, fast_b=fast_b, fast_sched=fast_sched,
             max_width=self.max_width, vcap=vcap,
         )
@@ -263,7 +261,6 @@ class MeshCheckEngine(DeviceCheckEngine):
         with self._sync_lock:
             snap = self._snapshot_locked()
             stacked = self._stacked
-            overlay_active = self._overlay_active
         enc = self._encode(snap, queries, rest_depth)
         err, general = self._classify(snap, enc[0], enc[2])
         qpad = min(_bucket(n), self.frontier)
@@ -271,18 +268,10 @@ class MeshCheckEngine(DeviceCheckEngine):
         active = np.pad(~(err | general), (0, qpad - n))
         res = self._sharded_run(stacked, padded, active)
         gres = gi = None
-        replica = None
-        if general.any() and not overlay_active:
-            # general tier: the algebra program data-parallel over the
-            # bounded replica; the oracle is only the final fallback
-            replica = self._replica_arrays()
-        if replica is not None and general.any() and not overlay_active:
+        if general.any():
             gi = np.flatnonzero(general)
-            gres = self._run_general_mesh(replica, enc, gi)
-        elif general.any():
-            err = err | general  # over budget / overlay: oracle answers
-            general = np.zeros_like(general)
-        return (enc, err, general, res, gi, gres, stacked, replica)
+            gres = self._run_general_mesh(stacked, enc, gi)
+        return (enc, err, general, res, gi, gres, stacked, None)
 
     def _collect(self, handle, retry: bool = True):
         enc, fallback_mask, general, res, gi, gres, stacked, replica = handle
@@ -292,27 +281,42 @@ class MeshCheckEngine(DeviceCheckEngine):
 
         if gres is not None:
             packed = np.asarray(gres[0])[: gres[2]]
+            # occ rows: the skeleton level counts and fast_n ([0..D+1])
+            # come from the psum-merged levels — replicated GLOBAL values
+            # on every shard (take one row, not the n-fold sum) — while
+            # the BFS sub-run counts ([D+2:]) are owner-masked per-shard
+            # partials whose sum is the true global
+            rows = np.asarray(gres[1])
+            split = self.gen_levels + 2
             self._update_gen_occ(
-                np.asarray(gres[1]).sum(axis=0), gres[3]
+                np.concatenate(
+                    [rows[0, :split], rows[:, split:].sum(axis=0)]
+                ),
+                gres[3],
             )
             codes = (packed & 3).astype(np.int8)
             gover = ((packed >> 2) & 1).astype(bool)
-            allowed[gi] = codes == dev.R_IS
-            gunres = gover & (codes != dev.R_ERR)
+            # dirty: some shard's overlay marked a row the skeleton or a
+            # fast leaf touched — oracle answers, no device retry (the
+            # retry would read the same stale base)
+            gdirty = ((packed >> 3) & 1).astype(bool)
+            allowed[gi] = codes == R_IS
+            gunres = gover & ~gdirty & (codes != R_ERR)
             if retry and gunres.any() and self.retry_scale > 1:
                 ri = gi[np.flatnonzero(gunres)]
                 self.retries += len(ri)
                 rh = self._run_general_mesh(
-                    replica, enc, ri, boost=self.retry_scale
+                    stacked, enc, ri, boost=self.retry_scale
                 )
                 rpacked = np.asarray(rh[0])[: rh[2]]
                 rcodes = (rpacked & 3).astype(np.int8)
                 rover = ((rpacked >> 2) & 1).astype(bool)
-                allowed[ri] = rcodes == dev.R_IS
-                gover[gunres] = rover | (rcodes == dev.R_ERR)
+                rdirty = ((rpacked >> 3) & 1).astype(bool)
+                allowed[ri] = rcodes == R_IS
+                gover[gunres] = rover | rdirty | (rcodes == R_ERR)
                 codes = codes.copy()
                 codes[np.flatnonzero(gunres)] = rcodes
-            fallback[gi] |= gover | (codes == dev.R_ERR)
+            fallback[gi] |= gover | gdirty | (codes == R_ERR)
         found = np.asarray(res.found)[:n]
         over = np.asarray(res.over)[:n]
         dirty = (

@@ -241,12 +241,6 @@ class SQLiteTupleStore:
             db.execute("PRAGMA synchronous=NORMAL")
         return db
 
-    def set_tracer(self, tracer) -> None:
-        """(Re)bind statement tracing after construction — the registry
-        builds the store before the tracer in some assembly orders."""
-        base = getattr(self._db, "_conn", self._db)
-        self._db = base if tracer is None else _TracedConn(base, tracer)
-
     @staticmethod
     def _default_auto_migrate(path: str) -> bool:
         return path == ":memory:"

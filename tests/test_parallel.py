@@ -7,7 +7,7 @@ a real `jax.sharding.Mesh` and runs the multi-device paths the driver's
 * `shard_fast_check` — query-data-parallel fast path (graph replicated),
 * `graphshard.sharded_check` — graph partitioned by (namespace, object)
   hash with `lax.all_to_all` child routing and psum-merged found bits,
-* `shard_batch_check` — the general task-tree interpreter, data-parallel.
+* `shard_general_check` — the fused AND/NOT algebra program, data-parallel.
 """
 
 import numpy as np
@@ -18,8 +18,8 @@ from ketotpu.engine.tpu import DeviceCheckEngine
 from ketotpu.parallel import (
     build_sharded_snapshot,
     make_mesh,
-    shard_batch_check,
     shard_fast_check,
+    shard_general_check,
     sharded_check,
 )
 from ketotpu.parallel.graphshard import shard_of_np
@@ -120,8 +120,10 @@ def test_graph_sharded_overflow_is_monotone():
             assert got[i] == w, f"query {i}: clean NOT diverges"
 
 
-def test_shard_batch_check_general_path():
-    """The round-1 task-tree interpreter still runs data-parallel (AND/NOT)."""
+def test_shard_general_check_and_not_path():
+    """The fused AND/NOT algebra program runs data-parallel over the mesh
+    (graph replicated, packed query block sharded) and matches the
+    oracle — this is the mesh engine's general tier."""
     store = InMemoryTupleStore()
     store.write_relation_tuples(
         *[T(f"d:o{i}#editors@u{i % 4}") for i in range(16)],
@@ -150,18 +152,21 @@ class d implements Namespace {
     eng.snapshot()
     queries = [T(f"d:o{i}#finalize@u{i % 5}") for i in range(16)]
     enc = tuple(np.asarray(a) for a in eng._encode(eng.snapshot(), queries, 0))
-    mesh = make_mesh(8)
-    # the interpreter needs edge_node, which the single-chip Check
-    # dict no longer ships (snapshot.MESH_ONLY_KEYS) - use the full set
-    import jax as _jax
-
-    res = shard_batch_check(
-        _jax.device_put(eng.snapshot().arrays()), enc, mesh,
-        cap=2048, arena=2048, vcap=1024,
+    n = 8
+    mesh = make_mesh(n)
+    qpack = np.stack(
+        [*enc, np.ones(len(queries), np.int32)]
+    ).astype(np.int32)
+    sizes, fast_b, fast_sched, vcap = eng._gen_schedule(len(queries) // n, 1)
+    codes, occ = shard_general_check(
+        eng._device_arrays, qpack, mesh, axis="data",
+        sizes=sizes, fast_b=fast_b, fast_sched=fast_sched, vcap=vcap,
     )
-    got = (np.asarray(res.result) == 1).tolist()
-    over = np.asarray(res.overflow)
+    packed = np.asarray(codes)
+    got = ((packed & 3) == 1).tolist()
+    over = ((packed >> 2) & 1).astype(bool)
     want = [eng.oracle.check_is_member(r) for r in queries]
+    assert np.asarray(occ).shape[0] == n  # one occupancy row per device
     for i, w in enumerate(want):
         if not over[i]:
             assert got[i] == w
@@ -356,20 +361,29 @@ def test_mesh_engine_expand_sees_overlay_writes():
 
 
 def test_mesh_engine_general_tier_on_device():
-    """VERDICT r3 #5: AND/NOT queries run the fused algebra program
-    data-parallel over the bounded replica — WITHOUT the host oracle."""
+    """VERDICT r4 #5: AND/NOT queries run the fused algebra program
+    against the SHARDED graph stacks — no replicated copy (the replica
+    budget is zeroed to prove nothing falls back to it), no host oracle,
+    cross-shard subject-set children routed to their owners."""
     from ketotpu.opl.parser import parse
     from ketotpu.parallel import MeshCheckEngine
     from ketotpu.storage import StaticNamespaceManager
 
     opl = """
-import { Namespace, Context } from "@ory/keto-namespace-types"
+import { Namespace, SubjectSet, Context } from "@ory/keto-namespace-types"
 class User implements Namespace {}
+class Group implements Namespace { related: { members: User[] } }
 class d implements Namespace {
-  related: { editors: User[], signers: User[] }
+  related: {
+    editors: User[], signers: User[],
+    viewers: (User | SubjectSet<Group, "members">)[]
+  }
   permits = {
+    view: (ctx: Context): boolean =>
+      this.related.viewers.includes(ctx.subject) ||
+      this.related.editors.includes(ctx.subject),
     finalize: (ctx: Context): boolean =>
-      this.related.editors.includes(ctx.subject) &&
+      this.permits.view(ctx) &&
       this.related.signers.includes(ctx.subject),
   }
 }
@@ -380,23 +394,28 @@ class d implements Namespace {
     store.write_relation_tuples(
         *[T(f"d:o{i}#editors@u{i % 4}") for i in range(16)],
         *[T(f"d:o{i}#signers@u{i % 3}") for i in range(16)],
+        *[T(f"d:o{i}#viewers@Group:g{i % 3}#members") for i in range(16)],
+        *[T(f"Group:g{j}#members@u{j + 2}") for j in range(3)],
     )
     eng = MeshCheckEngine(
         store, StaticNamespaceManager(namespaces),
         mesh_devices=8, frontier=512, arena=1024, gen_arena=2048, vcap=1024,
+        replica_budget_mb=0,  # the general tier must not want a replica
     )
-    queries = [T(f"d:o{i}#finalize@u{i % 5}") for i in range(24)]
+    queries = [T(f"d:o{i}#finalize@u{i % 6}") for i in range(24)]
     want = [eng.oracle.check_is_member(q) for q in queries]
     fb0 = eng.fallbacks
     allowed, fallback = eng.batch_check_device_only(queries)
     assert not any(fallback), "general tier must answer on-device"
     assert allowed == want
     assert eng.fallbacks == fb0
+    assert eng._device_arrays is None  # no replica was materialized
 
 
 def test_mesh_engine_replica_budget_falls_back_to_oracle():
-    """Over-budget replicas must NOT materialize: general checks and
-    expand both answer via the oracle (exact), bounded memory."""
+    """Over-budget replicas must NOT materialize: expand answers via the
+    oracle (exact, bounded memory); general checks are unaffected — they
+    run against the sharded stacks and never touch the replica."""
     from ketotpu.opl.parser import parse
     from ketotpu.parallel import MeshCheckEngine
     from ketotpu.storage import StaticNamespaceManager
@@ -428,7 +447,8 @@ class d implements Namespace {
     q = T("d:o1#finalize@u1")
     want = eng.oracle.check_is_member(q)
     allowed, fallback = eng.batch_check_device_only([q])
-    assert fallback == [True]  # routed to the oracle tier
+    assert fallback == [False]  # sharded general tier: no replica needed
+    assert allowed == [want]
     assert eng.check(q) is want  # full path answers exactly
     out = eng.batch_expand([SubjectSet("d", "o1", "editors")])
     assert out[0] is not None  # oracle expand, no replica materialized
