@@ -1,0 +1,14 @@
+#!/bin/sh
+# Boot the demo daemon and load the example relation tuples (the
+# reference contrib/cat-videos-example/up.sh flow).
+set -e
+here="$(cd "$(dirname "$0")" && pwd)"
+keto-tpu serve -c "$here/keto.yml" &
+srv=$!
+trap 'kill $srv' EXIT
+keto-tpu status --block --timeout 120 --insecure-disable-transport-security
+keto-tpu relation-tuple create "$here/relation-tuples" \
+  --insecure-disable-transport-security
+echo "loaded; try:"
+echo "  keto-tpu check '*' view videos cats/1.mp4 --insecure-disable-transport-security"
+wait $srv

@@ -118,6 +118,13 @@ def _defaults() -> Dict[str, Any]:
             "provider": "",
             "otlp": {"server_url": "", "flush_interval_ms": 2000},
         },
+        # anonymized usage telemetry (metricsx seam, daemon.go:64-98):
+        # inert until server_url is configured; opt_out honored on top
+        "sqa": {
+            "opt_out": False,
+            "server_url": "",
+            "interval_ms": 21_600_000,
+        },
     }
 
 

@@ -218,35 +218,44 @@ def sharded_general_check(
     skeleton lives on every shard).  Returns (codes uint8[Q], occ
     int32[n, L]) with codes replicated-identical across shards.
     """
-    from ketotpu.engine import algebra as alg
-
-    @functools.partial(
-        jax.jit,
-        static_argnames=("sizes", "fast_b", "fast_sched", "max_width", "vcap"),
-    )
-    def run(g, qp, *, sizes, fast_b, fast_sched, max_width, vcap):
-        def local(g, qp):
-            g = jax.tree_util.tree_map(lambda a: a[0], g)
-            codes, occ = alg.run_general_packed(
-                g, qp, sizes=sizes, fast_b=fast_b, fast_sched=fast_sched,
-                max_width=max_width, vcap=vcap,
-                shard=(axis, mesh.devices.size),
-            )
-            return codes, occ[None, :]
-
-        return jax.shard_map(
-            local,
-            mesh=mesh,
-            in_specs=(jax.tree_util.tree_map(lambda _: P(axis), g), P()),
-            out_specs=(P(), P(axis)),
-            check_vma=False,
-        )(g, qp)
-
-    return run(
+    return _sharded_general_run(
         stacked_g, jnp.asarray(qpack, jnp.int32),
+        mesh=mesh, axis=axis,
         sizes=tuple(sizes), fast_b=int(fast_b),
         fast_sched=tuple(fast_sched), max_width=max_width, vcap=vcap,
     )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "mesh", "axis", "sizes", "fast_b", "fast_sched", "max_width", "vcap",
+    ),
+)
+def _sharded_general_run(
+    g, qp, *, mesh: Mesh, axis, sizes, fast_b, fast_sched, max_width, vcap
+):
+    # module-level jit: the cache must hit across serving dispatches (a
+    # per-call closure would retrace + recompile the fused sharded
+    # program for every general batch)
+    from ketotpu.engine import algebra as alg
+
+    def local(g, qp):
+        g = jax.tree_util.tree_map(lambda a: a[0], g)
+        codes, occ = alg.run_general_packed(
+            g, qp, sizes=sizes, fast_b=fast_b, fast_sched=fast_sched,
+            max_width=max_width, vcap=vcap,
+            shard=(axis, mesh.devices.size),
+        )
+        return codes, occ[None, :]
+
+    return jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(jax.tree_util.tree_map(lambda _: P(axis), g), P()),
+        out_specs=(P(), P(axis)),
+        check_vma=False,
+    )(g, qp)
 
 
 def sharded_check(

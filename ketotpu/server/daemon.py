@@ -181,6 +181,21 @@ class Server:
         self._threads: List[threading.Thread] = []
         self.addresses: Dict[str, Tuple[str, int]] = {}
         self._stopped = threading.Event()
+        # anonymized usage telemetry (daemon.go:64-98 seam): inert unless
+        # sqa.server_url is configured AND the operator did not opt out.
+        # Exactly ONE reporter per deployment like the reference: an
+        # SO_REUSEPORT worker (reuse_port=True) must not add an N-fold
+        # duplicate stream under the same deployment id
+        self.sqa = None
+        if not reuse_port:
+            from ketotpu.sqa import maybe_start
+
+            self.sqa = maybe_start(
+                registry.config,
+                network_id=str(registry.network_id),
+                metrics=registry.metrics(),
+                logger=self.logger,
+            )
 
     # -- construction -------------------------------------------------------
 
@@ -305,6 +320,8 @@ class Server:
         self._stopped.wait(timeout)
 
     def stop(self, grace: float = 5.0) -> None:
+        if self.sqa is not None:
+            self.sqa.close()
         for mux in self._muxes:
             mux.close()
         for s in self._grpc_servers:

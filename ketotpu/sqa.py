@@ -1,0 +1,144 @@
+"""Anonymized usage telemetry (SQA) — the reference's metricsx seam.
+
+The reference wires ory/x metricsx into the daemon
+(`internal/driver/daemon.go:64-98`): an opt-out background reporter that
+ships anonymized usage snapshots — service name, a HASH of the network
+id as the deployment id, build version, and request counts restricted
+to a whitelisted path set — to a vendor endpoint on a 6-hour interval.
+
+This is the TPU-native analog with one deliberate parity delta: the
+reference hard-codes its vendor's endpoint and write key; an
+independent deployment has no vendor to report to, so ``sqa.server_url``
+must be CONFIGURED for the reporter to start at all (``sqa.opt_out``
+is still honored on top, preserving the reference's opt-out semantics
+for distributions that do configure an endpoint).
+
+Anonymization contract (metricsx parity):
+
+* the deployment id is ``sha256(network_id)`` — never the raw id;
+* only WHITELISTED metric names ship (request/check counters), never
+  label values that could carry tenant data (namespace names, objects);
+* payloads are fire-and-forget JSON POSTs; failures are dropped and
+  never surface into serving.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import time
+import urllib.request
+from typing import Optional
+
+from ketotpu import __version__
+
+#: metric names whose TOTALS (labels stripped) may ship — mirrors the
+#: reference's WhitelistedPaths idea: aggregate usage, no tenant data
+WHITELISTED_COUNTERS = (
+    "keto_checks_total",
+    "keto_expands_total",
+    "keto_relation_tuple_writes_total",
+    "keto_requests_total",
+)
+
+DEFAULT_INTERVAL_S = 6 * 3600.0  # daemon.go:95 (6h batches)
+
+
+class SqaReporter:
+    """Background usage reporter; ``close()`` stops it."""
+
+    def __init__(
+        self,
+        endpoint: str,
+        *,
+        network_id: str,
+        metrics=None,
+        logger=None,
+        dsn: str = "",
+        interval: float = DEFAULT_INTERVAL_S,
+    ):
+        self.endpoint = endpoint.rstrip("/")
+        self.metrics = metrics
+        self.logger = logger
+        self.interval = interval
+        self.deployment_id = hashlib.sha256(
+            network_id.encode()
+        ).hexdigest()
+        # the reference flags sqlite-backed deployments as development
+        # installs (daemon.go:74)
+        self.is_development = dsn.startswith(("sqlite", "memory"))
+        self.sent = 0
+        self.errors = 0
+        self._t0 = time.monotonic()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="keto-sqa", daemon=True
+        )
+        self._thread.start()
+
+    def _snapshot(self) -> dict:
+        counts = {}
+        if self.metrics is not None:
+            with self.metrics._lock:
+                for (name, _labels), v in self.metrics._counters.items():
+                    if name in WHITELISTED_COUNTERS:
+                        counts[name] = counts.get(name, 0.0) + v
+        return {
+            "service": "keto-tpu",
+            "deployment_id": self.deployment_id,
+            "version": __version__,
+            "is_development": self.is_development,
+            "uptime_s": round(time.monotonic() - self._t0, 1),
+            "counters": counts,
+        }
+
+    def _post(self) -> None:
+        body = json.dumps(self._snapshot()).encode()
+        req = urllib.request.Request(
+            self.endpoint + "/v1/usage",
+            data=body,
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=10.0) as resp:
+                resp.read()
+            self.sent += 1
+        except Exception as e:  # noqa: BLE001 — telemetry never breaks serving
+            self.errors += 1
+            if self.logger is not None:
+                self.logger.debug("sqa report dropped: %s", e)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            self._post()
+
+    def flush(self) -> None:
+        """One immediate report (tests; shutdown best-effort)."""
+        self._post()
+
+    def close(self) -> None:
+        self._stop.set()
+
+
+def maybe_start(config, *, network_id: str, metrics=None, logger=None) -> Optional[SqaReporter]:
+    """Build the reporter iff an endpoint is configured and the operator
+    did not opt out (daemon.go:64 gate)."""
+    endpoint = str(config.get("sqa.server_url", "") or "")
+    if not endpoint or bool(config.get("sqa.opt_out", False)):
+        return None
+    interval = (
+        float(config.get("sqa.interval_ms", DEFAULT_INTERVAL_S * 1000))
+        / 1000.0
+    )
+    return SqaReporter(
+        endpoint,
+        network_id=network_id,
+        metrics=metrics,
+        logger=logger,
+        dsn=str(config.get("dsn", "")),
+        # floor: interval_ms: 0 is schema-valid but would busy-loop POSTs
+        # at the endpoint for the daemon's lifetime
+        interval=max(interval, 60.0),
+    )

@@ -350,3 +350,81 @@ class TestOTLPExport:
         reg = Registry(Provider({"tracing": {"provider": "otlp"}}))
         with pytest.raises(ConfigError):
             reg.tracer()
+
+
+class TestSqaTelemetry:
+    """sqa.py — the metricsx seam (daemon.go:64-98): anonymized usage
+    snapshots to a configured endpoint, opt-out honored, failures never
+    surface into serving."""
+
+    def _sink(self):
+        import http.server
+        import json as _json
+        import threading
+
+        got = []
+
+        class Sink(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                body = self.rfile.read(int(self.headers["Content-Length"]))
+                got.append((self.path, _json.loads(body)))
+                self.send_response(200)
+                self.end_headers()
+                self.wfile.write(b"{}")
+
+            def log_message(self, *a):
+                pass
+
+        srv = http.server.HTTPServer(("127.0.0.1", 0), Sink)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        return srv, got
+
+    def test_reporter_ships_anonymized_snapshot(self):
+        from ketotpu.driver import Provider
+        from ketotpu.observability import Metrics
+        from ketotpu.sqa import maybe_start
+
+        srv, got = self._sink()
+        try:
+            m = Metrics()
+            m.counter("keto_checks_total", 3, allowed="true")
+            m.counter("keto_checks_total", 1, allowed="false")
+            m.counter("keto_secret_tenant_metric", 9, namespace="acme")
+            cfg = Provider({"sqa": {
+                "server_url": f"http://127.0.0.1:{srv.server_port}",
+                "interval_ms": 3_600_000,
+            }})
+            rep = maybe_start(cfg, network_id="net-1", metrics=m)
+            assert rep is not None
+            rep.flush()
+            rep.close()
+            path, payload = got[0]
+            assert path == "/v1/usage"
+            assert payload["service"] == "keto-tpu"
+            # deployment id is a HASH, never the raw network id
+            assert "net-1" not in payload["deployment_id"]
+            assert len(payload["deployment_id"]) == 64
+            assert payload["counters"] == {"keto_checks_total": 4.0}
+            assert "keto_secret_tenant_metric" not in str(payload)
+        finally:
+            srv.shutdown()
+
+    def test_opt_out_and_no_endpoint_disable(self):
+        from ketotpu.driver import Provider
+        from ketotpu.sqa import maybe_start
+
+        assert maybe_start(Provider(), network_id="x") is None
+        cfg = Provider({"sqa": {
+            "server_url": "http://127.0.0.1:9", "opt_out": True,
+        }})
+        assert maybe_start(cfg, network_id="x") is None
+
+    def test_export_errors_never_raise(self):
+        from ketotpu.driver import Provider
+        from ketotpu.sqa import maybe_start
+
+        cfg = Provider({"sqa": {"server_url": "http://127.0.0.1:9"}})
+        rep = maybe_start(cfg, network_id="x")
+        rep.flush()  # dead endpoint: dropped, no raise
+        rep.close()
+        assert rep.errors >= 1 and rep.sent == 0
