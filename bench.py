@@ -107,6 +107,17 @@ def _probe_backend(out: dict) -> bool:
     return True
 
 
+def _cpu_codegen_guard() -> None:
+    """This jaxlib's XLA:CPU parallel codegen segfaults once a process
+    compiles a few hundred distinct programs (tests/conftest.py); a
+    SIGSEGV is not catchable, so the guard must be preventive."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_cpu_parallel_codegen_split_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_cpu_parallel_codegen_split_count=1"
+        ).strip()
+
+
 class _Sections:
     """Run each bench section under its own guard; a failure records an
     error entry and the remaining sections still run (device-section
@@ -144,6 +155,15 @@ def main() -> None:
             f"terminated by signal {signum} mid-run"
         )
         print(json.dumps(out), flush=True)
+        # os._exit skips finally blocks: reap any live serve --workers
+        # process group first (its own session survives the driver's
+        # kill and would keep holding the device + ports)
+        try:
+            from bench_serve import kill_children
+
+            kill_children()
+        except Exception:  # noqa: BLE001
+            pass
         os._exit(0)
 
     signal.signal(signal.SIGTERM, _emit_and_exit)
@@ -160,18 +180,16 @@ def main() -> None:
         # serving_workers subprocesses inherit it.
         out["error_ambient_backend"] = out.pop("error")
         os.environ["JAX_PLATFORMS"] = "cpu"
-        # this jaxlib's XLA:CPU parallel codegen segfaults once a process
-        # compiles a few hundred distinct programs (tests/conftest.py);
-        # a SIGSEGV is not catchable, so the guard must be preventive —
-        # main process, probe, and worker subprocesses all inherit it
-        flags = os.environ.get("XLA_FLAGS", "")
-        if "xla_cpu_parallel_codegen_split_count" not in flags:
-            os.environ["XLA_FLAGS"] = (
-                flags + " --xla_cpu_parallel_codegen_split_count=1"
-            ).strip()
+        _cpu_codegen_guard()
         device_up = _probe_backend(out)
         if device_up:
             out["platform_fallback"] = "cpu"
+    if device_up and out.get("platform") == "cpu":
+        # ambient CPU runs need the guard just as much as the fallback
+        # (same program set, same segfault threshold); the env reaches
+        # the main process before its first backend init and every
+        # section subprocess by inheritance
+        _cpu_codegen_guard()
 
     # KETO_BENCH_SKIP: comma-separated section names to skip (smoke runs
     # on CPU skip the 10M sections; the driver runs everything)

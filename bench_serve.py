@@ -175,6 +175,24 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+#: process-group ids of live `serve --workers` children: bench.py's
+#: SIGTERM handler reaps these before os._exit (the handler skips the
+#: finally-block cleanup below, and the group's own session would
+#: otherwise survive the driver's kill holding the device)
+_CHILD_PGIDS: List[int] = []
+
+
+def kill_children() -> None:
+    import os
+    import signal
+
+    for pgid in list(_CHILD_PGIDS):
+        try:
+            os.killpg(pgid, signal.SIGKILL)
+        except OSError:
+            pass
+
+
 def run_workers_bench(
     graph=None,
     *,
@@ -211,6 +229,7 @@ def run_workers_bench(
         )
     tmp = tempfile.mkdtemp(prefix="keto-workers-bench-")
     proc = None
+    pgid = None
     try:
         ns_path = os.path.join(tmp, "namespaces.keto.ts")
         with open(ns_path, "w") as f:
@@ -223,56 +242,81 @@ def run_workers_bench(
             store.write_relation_tuples(*tuples[i : i + 10_000])
         store.close()
 
-        ports = {n: _free_port() for n in ("read", "write", "metrics", "opl")}
-        cfg_path = os.path.join(tmp, "keto.yml")
-        with open(cfg_path, "w") as f:
-            yaml.safe_dump(
-                {
-                    "dsn": f"sqlite://{db_path}",
-                    "namespaces": {"location": f"file://{ns_path}"},
-                    "serve": {
-                        n: {"host": "127.0.0.1", "port": p}
-                        for n, p in ports.items()
-                    },
-                    "engine": {
-                        "kind": "tpu",
-                        "frontier": frontier,
-                        "arena": arena,
-                        "max_batch": frontier,
-                        "coalesce_ms": coalesce_ms,
-                    },
-                },
-                f,
-            )
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "ketotpu.cli", "serve",
-             "-c", cfg_path, "--workers", str(workers)],
-            start_new_session=True,  # one killpg reaps owner + workers
-        )
-        target = f"127.0.0.1:{ports['read']}"
         requests = _build_requests(graph)
-
-        # readiness + warmup: the owner compiles the engine snapshot
-        # before forking workers, so the first successful Check means the
-        # whole topology is up
-        deadline = time.monotonic() + boot_timeout
-        ready = False
-        while time.monotonic() < deadline:
-            if proc.poll() is not None:
-                raise RuntimeError(
-                    f"serve --workers exited rc={proc.returncode} during boot"
+        cfg_path = os.path.join(tmp, "keto.yml")
+        target = None
+        # two boot attempts: _free_port picks then closes its sockets, so
+        # another process can (transiently) grab a port before the
+        # workers bind it — a fresh attempt re-picks fresh ports
+        for attempt in (1, 2):
+            ports = {
+                n: _free_port() for n in ("read", "write", "metrics", "opl")
+            }
+            with open(cfg_path, "w") as f:
+                yaml.safe_dump(
+                    {
+                        "dsn": f"sqlite://{db_path}",
+                        "namespaces": {"location": f"file://{ns_path}"},
+                        "serve": {
+                            n: {"host": "127.0.0.1", "port": p}
+                            for n, p in ports.items()
+                        },
+                        "engine": {
+                            "kind": "tpu",
+                            "frontier": frontier,
+                            "arena": arena,
+                            "max_batch": frontier,
+                            "coalesce_ms": coalesce_ms,
+                        },
+                    },
+                    f,
                 )
-            try:
-                with grpc.insecure_channel(target) as ch:
-                    stub = CheckServiceStub(ch)
-                    for r in requests[:4]:
-                        stub.Check(r, timeout=120.0)
-                ready = True
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "ketotpu.cli", "serve",
+                 "-c", cfg_path, "--workers", str(workers)],
+                start_new_session=True,  # one killpg reaps owner + workers
+            )
+            # capture the pgid NOW: with start_new_session the workers
+            # share it and can outlive the owner, whose death makes
+            # os.getpgid(proc.pid) unanswerable later
+            pgid = os.getpgid(proc.pid)
+            _CHILD_PGIDS.append(pgid)
+            target = f"127.0.0.1:{ports['read']}"
+
+            # readiness + warmup: the owner compiles the engine snapshot
+            # before forking workers, so the first successful Check means
+            # the whole topology is up.  The boot budget is SPLIT across
+            # the two attempts so a persistent failure cannot double the
+            # worst-case hang past the caller's expectation.
+            deadline = time.monotonic() + boot_timeout / 2
+            ready = False
+            boot_err = None
+            while time.monotonic() < deadline:
+                if proc.poll() is not None:
+                    boot_err = (
+                        f"serve --workers exited rc={proc.returncode}"
+                        " during boot"
+                    )
+                    break
+                try:
+                    with grpc.insecure_channel(target) as ch:
+                        stub = CheckServiceStub(ch)
+                        for r in requests[:4]:
+                            stub.Check(r, timeout=120.0)
+                    ready = True
+                    break
+                except grpc.RpcError:
+                    time.sleep(2.0)
+            if ready:
                 break
-            except grpc.RpcError:
-                time.sleep(2.0)
-        if not ready:
-            raise RuntimeError(f"workers not ready after {boot_timeout:.0f}s")
+            if boot_err is None:
+                boot_err = (
+                    f"workers not ready after {boot_timeout / 2:.0f}s"
+                )
+            _reap(proc, pgid)
+            proc = None
+            if attempt == 2:
+                raise RuntimeError(boot_err)
         time.sleep(2.0)  # let every SO_REUSEPORT worker finish binding
 
         h = _hammer(target, requests, concurrency=concurrency, duration=duration)
@@ -286,16 +330,35 @@ def run_workers_bench(
             "workers_errors": h["errors"],
         }
     finally:
-        if proc is not None and proc.poll() is None:
-            try:
-                os.killpg(os.getpgid(proc.pid), signal.SIGINT)
-                proc.wait(timeout=20)
-            except (OSError, subprocess.TimeoutExpired):
-                try:
-                    os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
-                except OSError:
-                    pass
+        if proc is not None and pgid is not None:
+            _reap(proc, pgid)
         shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _reap(proc, pgid) -> None:
+    """SIGINT (graceful) then SIGKILL a serve --workers process GROUP and
+    drop it from the SIGTERM handler's registry.  The group is signaled
+    even when the owner itself already exited: with start_new_session
+    the workers share the pgid and can outlive the owner (ESRCH for a
+    fully-gone group is swallowed)."""
+    import os
+    import signal
+    import subprocess
+
+    try:
+        os.killpg(pgid, signal.SIGINT)
+    except OSError:
+        pass
+    try:
+        proc.wait(timeout=20)
+    except subprocess.TimeoutExpired:
+        pass
+    try:
+        os.killpg(pgid, signal.SIGKILL)
+    except OSError:
+        pass
+    if pgid in _CHILD_PGIDS:
+        _CHILD_PGIDS.remove(pgid)
 
 
 if __name__ == "__main__":

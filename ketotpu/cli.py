@@ -191,9 +191,31 @@ def _serve_multiprocess(args, workers: int) -> int:
         ])
         for _ in range(workers)
     ]
+    rc = 0
     try:
-        for p in procs:
-            p.wait()
+        # poll, don't wait sequentially: ANY worker dying (port bind
+        # race, crash) must surface immediately — a sequential wait on
+        # worker 0 would mask worker 1's death while the topology
+        # silently serves at reduced width
+        live = list(procs)
+        while live:
+            for p in list(live):
+                code = p.poll()
+                if code is None:
+                    continue
+                live.remove(p)
+                if code:
+                    reg.logger().error(
+                        "worker pid %d exited rc=%d", p.pid, code
+                    )
+                    rc = 1
+            if rc:
+                for p in live:
+                    p.terminate()
+                for p in live:
+                    p.wait(timeout=10)
+                break
+            time.sleep(0.5)
     except KeyboardInterrupt:
         reg.logger().info("shutting down workers")
         for p in procs:
@@ -206,7 +228,7 @@ def _serve_multiprocess(args, workers: int) -> int:
             os.rmdir(sockdir)
         except OSError:
             pass
-    return 0
+    return rc
 
 
 def cmd_serve_worker(args) -> int:

@@ -56,6 +56,9 @@ def test_load_tuples_via_cli_and_check_via_rest(server):
             "relation-tuple", "create",
             str(CAT_VIDEOS / "relation-tuples"),
             "--write-remote", write,
+            # the client defaults to TLS like the reference; the test
+            # daemon is plaintext
+            "--insecure-disable-transport-security",
         ]
     )
     assert rc == 0
