@@ -236,9 +236,14 @@ class Registry:
                 extra_migrations=self.options.extra_migrations,
                 tracer=tracer,
             )
-        if dsn.startswith(("postgres://", "postgresql://")):
+        if dsn.startswith(("postgres://", "postgresql://", "cockroach://")):
             from ketotpu.storage.postgres import PostgresTupleStore
 
+            # CockroachDB speaks the Postgres wire protocol and accepts
+            # the same DDL this persister emits — the reference selects
+            # it by DSN scheme the same way (dsn_testutils.go:106-160)
+            if dsn.startswith("cockroach://"):
+                dsn = "postgres://" + dsn[len("cockroach://"):]
             return PostgresTupleStore(
                 dsn,
                 network_id=nid,

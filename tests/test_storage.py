@@ -26,27 +26,33 @@ T = RelationTuple.from_string
 
 # the reference exports its persister suite to run over every configured
 # backend (manager_requirements.go:25, full_test.go); same pattern here.
-# Postgres / MySQL are DSN-gated exactly like the reference's dialect
-# matrix (dsn_testutils.go:106-160): set KETO_TEST_PG_DSN /
-# KETO_TEST_MYSQL_DSN to a live server (CI provides service containers)
-# or the param skips cleanly.
-@pytest.fixture(params=["memory", "sqlite", "postgres", "mysql"])
+# Postgres / MySQL / CockroachDB are DSN-gated exactly like the
+# reference's dialect matrix (dsn_testutils.go:106-160): set
+# KETO_TEST_PG_DSN / KETO_TEST_MYSQL_DSN / KETO_TEST_COCKROACH_DSN to a
+# live server (CI provides service containers) or the param skips
+# cleanly.  Cockroach runs the Postgres persister over its pg-wire
+# endpoint, like the reference.
+@pytest.fixture(params=["memory", "sqlite", "postgres", "mysql", "cockroach"])
 def store(request):
     if request.param == "memory":
         return InMemoryTupleStore()
-    if request.param in ("postgres", "mysql"):
+    if request.param in ("postgres", "mysql", "cockroach"):
         import os
         import uuid
 
         env = {"postgres": "KETO_TEST_PG_DSN",
-               "mysql": "KETO_TEST_MYSQL_DSN"}[request.param]
+               "mysql": "KETO_TEST_MYSQL_DSN",
+               "cockroach": "KETO_TEST_COCKROACH_DSN"}[request.param]
         dsn = os.environ.get(env)
         if not dsn:
             pytest.skip(f"{env} not set")
-        if request.param == "postgres":
-            from ketotpu.storage.postgres import PostgresTupleStore as Store
-        else:
+        if dsn.startswith("cockroach://"):
+            # same scheme rewrite the registry applies (pg wire protocol)
+            dsn = "postgres://" + dsn[len("cockroach://"):]
+        if request.param == "mysql":
             from ketotpu.storage.mysql import MySQLTupleStore as Store
+        else:
+            from ketotpu.storage.postgres import PostgresTupleStore as Store
 
         # fresh network id per test: rows are nid-isolated, so the suite
         # never needs to truncate shared tables
@@ -510,3 +516,23 @@ class TestMySQLAdapter:
             assert "ON CONFLICT" not in sql
             assert not re.search(r"(?<![A-Za-z_`])key(?![A-Za-z_`])", sql)
             assert "AUTOINCREMENT" not in sql  # sqlite-only spelling
+
+
+def test_registry_dispatches_cockroach_scheme(monkeypatch):
+    """cockroach:// routes to the Postgres persister with the scheme
+    rewritten to postgres:// (pg wire protocol), query string intact."""
+    from ketotpu.driver import Provider, Registry
+    import ketotpu.storage.postgres as pgmod
+
+    seen = {}
+
+    class FakeStore:
+        def __init__(self, dsn, **kw):
+            seen["dsn"] = dsn
+
+    monkeypatch.setattr(pgmod, "PostgresTupleStore", FakeStore)
+    Registry(Provider({
+        "dsn": "cockroach://root@db:26257/defaultdb?sslmode=disable",
+    })).store()
+    assert seen["dsn"] == \
+        "postgres://root@db:26257/defaultdb?sslmode=disable"
