@@ -24,7 +24,7 @@ from __future__ import annotations
 import os
 import threading
 import uuid
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, Optional
 
 from ketotpu import __version__
 from ketotpu.api.mapper import Mapper

@@ -26,7 +26,7 @@ from typing import Dict, Optional
 
 import numpy as np
 
-from ketotpu.api.types import RelationTuple, SubjectSet
+from ketotpu.api.types import SubjectSet
 from ketotpu.engine import hashtab
 from ketotpu.engine.hashtab import build_table
 from ketotpu.engine.optable import (

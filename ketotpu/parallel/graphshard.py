@@ -43,11 +43,10 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ketotpu.engine import fastpath as fp
 from ketotpu.engine import hashtab
-from ketotpu.engine.snapshot import Snapshot, build_snapshot
+from ketotpu.engine.snapshot import Snapshot
 from ketotpu.storage.memory import InMemoryTupleStore
 from ketotpu.storage.namespaces import NamespaceManager
 from ketotpu.engine.vocab import Vocab
-from ketotpu.api.types import RelationTuple
 
 
 def shard_of_np(ns_ids: np.ndarray, obj_ids: np.ndarray, n_shards: int) -> np.ndarray:

@@ -25,7 +25,7 @@ import json
 import urllib.error
 import urllib.parse
 import urllib.request
-from typing import Iterable, List, Optional, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 from ketotpu.api.types import (
     BadRequestError,

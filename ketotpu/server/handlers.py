@@ -37,14 +37,12 @@ from ketotpu.api.types import (
     NotFoundError,
     RelationQuery,
     RelationTuple,
-    SubjectID,
     SubjectSet,
 )
 from ketotpu.observability import (
     PERMISSIONS_CHECKED,
     PERMISSIONS_EXPANDED,
     RELATIONTUPLES_CHANGED,
-    RELATIONTUPLES_CREATED,
     RELATIONTUPLES_DELETED,
 )
 from ketotpu.opl.parser import parse as opl_parse

@@ -32,10 +32,8 @@ from urllib.parse import parse_qs, urlencode, urlparse
 from ketotpu.api.types import (
     BadRequestError,
     KetoAPIError,
-    NotFoundError,
     RelationQuery,
     RelationTuple,
-    SubjectID,
     SubjectSet,
 )
 from ketotpu.observability import RELATIONTUPLES_CREATED

@@ -21,8 +21,6 @@ from ketotpu.api.types import (
     BadRequestError,
     RelationQuery,
     RelationTuple,
-    Subject,
-    SubjectSet,
 )
 
 DEFAULT_PAGE_SIZE = 100
