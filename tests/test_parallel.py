@@ -453,3 +453,38 @@ class d implements Namespace {
     out = eng.batch_expand([SubjectSet("d", "o1", "editors")])
     assert out[0] is not None  # oracle expand, no replica materialized
     assert eng._device_arrays is None
+
+
+def test_mesh_engine_general_synth_differential():
+    """Differential check of the SHARDED general tier over the rich synth
+    graph (folder-tree TTU chains, group subject-sets, the `edit` =
+    !banned && view rewrite): every non-fallback verdict must match the
+    oracle, and the Drive-style workload must overwhelmingly stay
+    on-device.  The toy-OPL tests pin single shapes; this sweeps the
+    real benchmark shape across an 8-shard mesh with no replica."""
+    from ketotpu.parallel import MeshCheckEngine
+    from ketotpu.utils.synth import synth_queries_mixed
+
+    graph = build_synth(n_users=64, n_groups=8, n_folders=32, n_docs=128,
+                        seed=3)
+    eng = MeshCheckEngine(
+        graph.store, graph.manager, mesh_devices=8,
+        frontier=1024, arena=4096, gen_arena=4096, vcap=1024,
+        max_batch=512, replica_budget_mb=0,
+    )
+    eng.snapshot()
+    queries = synth_queries_mixed(graph, 64, seed=21, general_frac=1.0)
+    want = [eng.oracle.check_is_member(q) for q in queries]
+    allowed, fallback = eng.batch_check_device_only(queries)
+    mismatches = [
+        (str(q), got, w)
+        for q, got, w, fb in zip(queries, allowed, want, fallback)
+        if not fb and got != w
+    ]
+    assert not mismatches, mismatches[:5]
+    # the general tier must answer the overwhelming majority on-device
+    assert sum(fallback) <= len(queries) // 8, (
+        f"{sum(fallback)}/{len(queries)} fell back"
+    )
+    # full path stays exact for the fallback slice too
+    assert eng.batch_check(queries) == want
