@@ -132,6 +132,8 @@ def run_serving_bench(
                 "max_batch": frontier,
                 "coalesce_ms": coalesce_ms,
             },
+            # one INFO access line per hammered request would swamp stderr
+            "log": {"request_log": False},
         }
     )
     reg = Registry(
@@ -162,9 +164,29 @@ def run_serving_bench(
             "serve_coalesced_waves": getattr(
                 reg.check_engine(), "waves", 0
             ),
+            "serve_stage_ms": _scrape_means(
+                reg.metrics(), "keto_rpc_stage_seconds", ("op", "stage")
+            ),
+            "serve_engine_phase_ms": _scrape_means(
+                reg.metrics(), "keto_engine_phase_seconds", ("phase",)
+            ),
         }
     finally:
         srv.stop(grace=2.0)
+
+
+def _scrape_means(metrics, name: str, label_keys) -> Dict[str, float]:
+    """Mean milliseconds per histogram series, keyed by the joined label
+    values ("check.coalesce_wait") — the per-stage RPC breakdown the bench
+    JSON publishes after the hammer run."""
+    out: Dict[str, float] = {}
+    for labels, (total, count) in metrics.histogram_values(name).items():
+        if not count:
+            continue
+        ld = dict(labels)
+        key = ".".join(ld.get(k, "?") for k in label_keys)
+        out[key] = round(1000.0 * total / count, 3)
+    return out
 
 
 def _free_port() -> int:
@@ -268,6 +290,7 @@ def run_workers_bench(
                             "max_batch": frontier,
                             "coalesce_ms": coalesce_ms,
                         },
+                        "log": {"request_log": False},
                     },
                     f,
                 )

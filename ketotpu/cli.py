@@ -13,7 +13,9 @@ Verbs:
   JSON files or dirs, get with query flags + pagination + table output,
   delete-all guarded by --force)
 * ``namespace validate <file.ts>`` — OPL diagnostics (cmd/namespace/)
-* ``status [--block]`` — gRPC health watch (cmd/status/root.go:24-95)
+* ``status [--block] [--debug]`` — gRPC health watch (cmd/status/root.go:
+  24-95); ``--debug`` dumps the flight recorder (slowest recent requests
+  with per-stage latencies) from the metrics port
 * ``version``
 
 Client commands talk gRPC to a running daemon, selected by ``--read-remote``
@@ -461,11 +463,46 @@ def cmd_ns_validate(args) -> int:
     return 0
 
 
+def _dump_flight_recorder(metrics_remote: str) -> int:
+    """Fetch + pretty-print the flight recorder's slowest-request ring from
+    the metrics port's debug endpoint (server/rest.py metrics_router)."""
+    import urllib.request
+
+    url = f"http://{metrics_remote}/debug/flight-recorder"
+    try:
+        with urllib.request.urlopen(url, timeout=5.0) as resp:
+            payload = json.loads(resp.read().decode("utf-8"))
+    except (OSError, ValueError) as e:
+        print(f"flight recorder: unreachable ({url}: {e})", file=sys.stderr)
+        return 1
+    slowest = payload.get("slowest", [])
+    print(f"flight recorder: {len(slowest)} slowest recent request(s)")
+    for ent in slowest:
+        stages = " ".join(
+            f"{k}={v:.2f}ms"
+            for k, v in sorted((ent.get("stages_ms") or {}).items())
+        )
+        extra = {
+            k: v for k, v in ent.items()
+            if k not in ("op", "detail", "total_ms", "ts", "stages_ms")
+        }
+        kv = " ".join(f"{k}={v}" for k, v in sorted(extra.items()))
+        print(
+            f"  {ent.get('total_ms', 0.0):9.2f}ms {ent.get('op', '?'):7s}"
+            f" {ent.get('detail', '')} {stages}"
+            + (f" {kv}" if kv else "")
+        )
+    return 0
+
+
 def cmd_status(args) -> int:
     import grpc
 
     from ketotpu.proto import health_pb2
     from ketotpu.proto.services import _stub_class
+
+    if getattr(args, "debug", False):
+        return _dump_flight_recorder(args.metrics_remote)
 
     deadline = time.monotonic() + args.timeout
     while True:
@@ -690,6 +727,17 @@ def build_parser() -> argparse.ArgumentParser:
     status = sub.add_parser("status", help="server health status")
     status.add_argument("--block", action="store_true", help="wait until SERVING")
     status.add_argument("--timeout", type=float, default=30.0)
+    status.add_argument(
+        "--debug", action="store_true",
+        help="dump the flight recorder (slowest recent requests with"
+        " per-stage latencies) from the metrics port",
+    )
+    status.add_argument(
+        "--metrics-remote",
+        default=os.environ.get("KETO_METRICS_REMOTE", "127.0.0.1:4468"),
+        help="metrics HTTP remote for --debug"
+        " (host:port; env KETO_METRICS_REMOTE)",
+    )
     _add_client_flags(status)
     status.set_defaults(fn=cmd_status)
 

@@ -111,7 +111,9 @@ def _defaults() -> Dict[str, Any]:
             # rebuild refreshes it (engine/checkpoint.py)
             "checkpoint": "",
         },
-        "log": {"level": "info", "format": "text"},
+        # request_log: per-request access lines (REST middleware + gRPC
+        # interceptor) at INFO; benches disable it to keep stderr quiet
+        "log": {"level": "info", "format": "text", "request_log": True},
         # OTLP trace export (the otelx seam, registry_default.go:151-168):
         # provider "otlp" ships spans/events to server_url + /v1/traces
         "tracing": {

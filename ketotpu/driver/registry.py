@@ -26,6 +26,8 @@ import threading
 import uuid
 from typing import Any, Callable, Dict, Optional
 
+import numpy as np
+
 from ketotpu import __version__
 from ketotpu.api.mapper import Mapper
 from ketotpu.api.uuid_map import UUIDMapper
@@ -76,6 +78,7 @@ class Registry:
         self._check_engine = None
         self._expand_engine = None
         self._oracle_engine = None
+        self._flight_recorder = None
         self._mapper = None
         self._ro_mapper = None
         self._uuid_mapper = None
@@ -138,6 +141,21 @@ class Registry:
                     t = self.options.tracer_wrapper(t)
                 self._tracer = t
             return self._tracer
+
+    def flight_recorder(self):
+        """Lazy ring buffer of the slowest recent requests with their
+        per-stage latency vectors (ketotpu/flightrec.py); served by the
+        metrics port's /debug/flight-recorder endpoint."""
+        with self._lock:
+            if self._flight_recorder is None:
+                from ketotpu.flightrec import FlightRecorder
+
+                self._flight_recorder = FlightRecorder(
+                    capacity=int(
+                        self.config.get("log.flight_recorder_size", 32) or 32
+                    ),
+                )
+            return self._flight_recorder
 
     # -- multi-tenancy (ketoctx Contextualizer seam) ------------------------
 
@@ -314,6 +332,7 @@ class Registry:
                         arena=int(self.config.get("engine.arena")),
                         max_batch=int(self.config.get("engine.max_batch")),
                         retry_scale=int(self.config.get("engine.retry_scale")),
+                        metrics=self.metrics(),
                     )
                     n_mesh = int(self.config.get("engine.mesh_devices") or 0)
                     if n_mesh > 0:
@@ -478,6 +497,68 @@ class Registry:
                 help="O(delta) overlay write applications")
         m.gauge("keto_engine_checkpoint_errors", eng.checkpoint_errors,
                 help="projection checkpoint save failures")
+        m.gauge("keto_engine_dispatches", eng.dispatches,
+                help="device batch dispatches")
+        m.gauge("keto_engine_projection_build_seconds",
+                eng.projection_build_s,
+                help="host-side snapshot projection build wall time")
+        m.gauge("keto_engine_projection_upload_seconds",
+                eng.projection_upload_s,
+                help="device snapshot upload wall time")
+        # demand-adaptive scheduling state: EMA frontier occupancy per BFS
+        # level (units of active roots), for the fast path and the general
+        # (AND/NOT) tier's skeleton + fast-leaf sub-runs
+        for path, ema in (
+            ("fast", eng._occ_ema),
+            ("general", eng._gen_occ_ema),
+            ("gen_fast_bfs", eng._gen_fast_occ_ema),
+        ):
+            if ema is None:
+                continue
+            for lvl, val in enumerate(np.asarray(ema).ravel()):
+                m.gauge("keto_engine_occupancy", float(val),
+                        help="EMA per-level frontier occupancy",
+                        path=path, level=str(lvl))
+        if eng._gen_fast_ema is not None:
+            m.gauge("keto_engine_occupancy", float(eng._gen_fast_ema),
+                    help="EMA per-level frontier occupancy",
+                    path="gen_fast_leaves", level="0")
+        # per-shard serving gauges: the mesh engine attributes batches /
+        # fallbacks / overlay pressure / occupancy per shard; the
+        # single-device engine reports the same vocabulary as shard "0"
+        # so dashboards need one query either way
+        stats_fn = getattr(eng, "shard_stats", None)
+        if stats_fn is not None:
+            rows = stats_fn()
+        else:
+            ov = eng._overlay.size() if eng._overlay is not None else (0, 0)
+            rows = [{
+                "shard": 0,
+                "batches": eng.dispatches,
+                "fallbacks": eng.fallbacks,
+                "overlay_pairs": ov[0],
+                "overlay_dirty": ov[1],
+                "nodes": int(getattr(eng._snap, "n_nodes", 0) or 0)
+                if eng._snap is not None else 0,
+                "gen_occupancy": 0.0,
+            }]
+        for row in rows:
+            s = str(row["shard"])
+            m.gauge("keto_mesh_shard_batches", row["batches"],
+                    help="device batch dispatches seen by this shard",
+                    shard=s)
+            m.gauge("keto_mesh_shard_fallbacks", row["fallbacks"],
+                    help="oracle fallbacks attributed to this shard",
+                    shard=s)
+            m.gauge("keto_mesh_shard_overlay_pairs", row["overlay_pairs"],
+                    help="overlay pairs resident on this shard", shard=s)
+            m.gauge("keto_mesh_shard_overlay_dirty", row["overlay_dirty"],
+                    help="overlay-dirtied CSR rows on this shard", shard=s)
+            m.gauge("keto_mesh_shard_nodes", row["nodes"],
+                    help="projected graph nodes on this shard", shard=s)
+            m.gauge("keto_mesh_shard_gen_occupancy", row["gen_occupancy"],
+                    help="last general dispatch's BFS occupancy partial",
+                    shard=s)
 
     def health(self) -> Dict[str, str]:
         """Readiness probe results; "ok" or the error string per check."""

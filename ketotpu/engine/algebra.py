@@ -69,6 +69,7 @@ falls back to the sequential oracle.
 from __future__ import annotations
 
 import functools
+import time
 from typing import Dict, List, Tuple
 
 import jax
@@ -644,6 +645,18 @@ def _fast_subrun(g, fb, *, sched, max_width: int, shard=None):
     # found leaf is trustworthy even when exploration brushed a dirty
     # row; an UNFOUND dirty leaf must be answered by the host oracle
     return q_found, q_over, q_dirty, occ
+
+
+def run_general_packed_timed(g, qpack, *, timer=None, **kw):
+    """run_general_packed plus a host wall-clock ``timer(seconds)`` callback
+    for the dispatch (trace/compile on the first shape, async enqueue
+    after).  run_general_packed itself is jitted with static argnames and
+    cannot carry host-side instrumentation."""
+    t0 = time.perf_counter()
+    out = run_general_packed(g, qpack, **kw)
+    if timer is not None:
+        timer(time.perf_counter() - t0)
+    return out
 
 
 @functools.partial(

@@ -23,11 +23,13 @@ import threading
 import time
 from typing import List, Optional, Sequence
 
+from ketotpu import flightrec
 from ketotpu.api.types import KetoAPIError, RelationTuple
 
 
 class _Slot:
-    __slots__ = ("tuple", "depth", "event", "result", "error")
+    __slots__ = ("tuple", "depth", "event", "result", "error",
+                 "t_enq", "t_dispatch", "wave")
 
     def __init__(self, t: RelationTuple, depth: int):
         self.tuple = t
@@ -35,6 +37,9 @@ class _Slot:
         self.event = threading.Event()
         self.result: Optional[bool] = None
         self.error: Optional[BaseException] = None
+        self.t_enq = time.perf_counter()
+        self.t_dispatch: Optional[float] = None  # set by the wave worker
+        self.wave: Optional[int] = None
 
 
 class CoalescingEngine:
@@ -71,6 +76,14 @@ class CoalescingEngine:
             self._pending.append(slot)
             self._wake.notify()
         slot.event.wait()
+        # stage decomposition for the RPC that enqueued us: queue wait is
+        # enqueue -> wave cut, device compute is wave cut -> wakeup (both
+        # no-ops when this thread isn't serving an instrumented RPC)
+        done = time.perf_counter()
+        if slot.t_dispatch is not None:
+            flightrec.note_stage("coalesce_wait", slot.t_dispatch - slot.t_enq)
+            flightrec.note_stage("device_compute", done - slot.t_dispatch)
+            flightrec.note(wave=slot.wave)
         if slot.error is not None:
             raise slot.error
         return bool(slot.result)
@@ -114,11 +127,16 @@ class CoalescingEngine:
 
     def _serve(self, wave: List[_Slot]) -> None:
         self.waves += 1
+        wave_id = self.waves
         self.coalesced += len(wave)
         by_depth = {}
         for s in wave:
             by_depth.setdefault(s.depth, []).append(s)
         for depth, slots in by_depth.items():
+            t_dispatch = time.perf_counter()
+            for s in slots:
+                s.t_dispatch = t_dispatch
+                s.wave = wave_id
             try:
                 # one bounded whole-batch retry: a transient device /
                 # runtime hiccup should not error up to max_pending

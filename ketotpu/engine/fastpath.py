@@ -86,6 +86,7 @@ oracle run (tests/test_fastpath.py).
 from __future__ import annotations
 
 import functools
+import time
 from typing import Dict, NamedTuple, Optional, Tuple
 
 import jax
@@ -733,15 +734,22 @@ def run_fast_packed(
     max_width: int = 100,
     boost: int = 1,
     mults: Optional[Tuple[int, ...]] = None,
+    timer=None,
 ):
     """run_fast over a pre-packed int32[6, Q] query block; returns the
     (device) uint8 verdict array and the int32[levels] occupancy vector —
-    the caller fetches them with np.asarray when it syncs."""
+    the caller fetches them with np.asarray when it syncs.  ``timer`` (if
+    given) receives the dispatch's host wall seconds — trace/compile on a
+    fresh shape, async enqueue after."""
     Q = qpack.shape[1]
     if Q > frontier:
         raise ValueError(f"batch {Q} exceeds frontier capacity {frontier}")
     sched = level_schedule(Q, frontier, arena, max_depth, boost, mults)
-    return _run_fused_packed(g, qpack, schedule=sched, max_width=max_width)
+    t0 = time.perf_counter()
+    out = _run_fused_packed(g, qpack, schedule=sched, max_width=max_width)
+    if timer is not None:
+        timer(time.perf_counter() - t0)
+    return out
 
 
 def run_fast(

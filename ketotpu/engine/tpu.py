@@ -61,6 +61,7 @@ if _plat:
     except Exception:  # noqa: BLE001 — never block engine import on this
         pass
 
+from ketotpu import flightrec
 from ketotpu.api.types import RelationTuple
 from ketotpu.engine import algebra as alg
 from ketotpu.engine import delta as dl
@@ -151,6 +152,7 @@ class DeviceCheckEngine:
         retry_scale: int = 4,
         gen_levels: int = 12,
         gen_levels_max: int = 24,
+        metrics=None,
     ):
         self.store = store
         self.namespace_manager = namespace_manager
@@ -223,6 +225,41 @@ class DeviceCheckEngine:
         # (engine/checkpoint.py); save failures count, never raise
         self.checkpoint_path: Optional[str] = None
         self.checkpoint_errors = 0
+        self.metrics = metrics  # optional Metrics registry for phase hists
+        self.dispatches = 0  # observability: device dispatch count
+        # host-side phase accumulators (seconds / samples): bench sections
+        # read these directly; the same samples land in
+        # keto_engine_phase_seconds when a Metrics registry is attached
+        self.phase_seconds: dict = {}
+        self.phase_counts: dict = {}
+
+    def _phase(self, name: str, dt: float) -> None:
+        self.phase_seconds[name] = self.phase_seconds.get(name, 0.0) + dt
+        self.phase_counts[name] = self.phase_counts.get(name, 0) + 1
+        if self.metrics is not None:
+            self.metrics.observe(
+                "keto_engine_phase_seconds", dt,
+                help="engine phase wall time", phase=name,
+            )
+
+    def _fast_timer(self, dt: float) -> None:
+        self._phase("check_fast_dispatch", dt)
+
+    def _gen_timer(self, dt: float) -> None:
+        self._phase("check_gen_dispatch", dt)
+
+    def _rpc_fallback_stage(self, op: str, dt: float) -> None:
+        """File oracle-fallback time as the RPC-level ``fallback`` stage.
+        Coalesced waves run on the worker thread (no request context), so
+        the sample goes straight to the stage histogram there."""
+        if flightrec.current() is not None:
+            flightrec.note_stage("fallback", dt)
+        elif self.metrics is not None:
+            self.metrics.observe(
+                flightrec.STAGE_METRIC, dt,
+                help="per-RPC stage wall time decomposition",
+                op=op, stage="fallback",
+            )
 
     # -- snapshot lifecycle -------------------------------------------------
     #
@@ -551,6 +588,7 @@ class DeviceCheckEngine:
     def batch_check(
         self, queries: Sequence[RelationTuple], rest_depth: int = 0
     ) -> List[bool]:
+        t0 = time.perf_counter()
         queries = list(queries)
         chunks = [
             queries[lo : lo + self.max_batch]
@@ -562,6 +600,9 @@ class DeviceCheckEngine:
         out: List[bool] = []
         for c, h in zip(chunks, handles):
             out.extend(self._finish_chunk(c, h, rest_depth))
+        # RPCs that reach the engine without the coalescer (batch routes)
+        # still get a device_compute stage; no-op outside a request context
+        flightrec.note_stage("device_compute", time.perf_counter() - t0)
         return out
 
     def _pad(self, arrays, n: int, qpad: int):
@@ -578,6 +619,8 @@ class DeviceCheckEngine:
         n = len(queries)
         if n == 0:
             return None
+        self.dispatches += 1
+        t_enc = time.perf_counter()
         snap, dev_arrays, overlay_active = self._sync_view()
         enc = self._encode(snap, queries, rest_depth)
         err, general = self._classify(snap, enc[0], enc[2])
@@ -592,6 +635,7 @@ class DeviceCheckEngine:
         qpack = np.stack([*padded, fast_active.astype(np.int32)]).astype(
             np.int32
         )
+        self._phase("check_encode", time.perf_counter() - t_enc)
         res, occ = fp.run_fast_packed(
             dev_arrays,
             qpack,
@@ -600,6 +644,7 @@ class DeviceCheckEngine:
             max_depth=self.max_depth,
             max_width=self.max_width,
             mults=self._adaptive_mults(),
+            timer=self._fast_timer,
         )
         # the algebra program is overlay-aware (probes consult the om_
         # delta tables, stale edge rows raise the per-query dirty bit that
@@ -741,7 +786,7 @@ class DeviceCheckEngine:
         active = np.arange(qpad) < n
         qpack = np.stack([*genc, active.astype(np.int32)]).astype(np.int32)
         sizes, fast_b, fast_sched, vcap = self._gen_schedule(qpad, boost)
-        codes, occ = alg.run_general_packed(
+        codes, occ = alg.run_general_packed_timed(
             dev_arrays,
             qpack,
             sizes=sizes,
@@ -749,6 +794,7 @@ class DeviceCheckEngine:
             fast_sched=fast_sched,
             max_width=self.max_width,
             vcap=vcap,
+            timer=self._gen_timer,
         )
         return codes, occ, n, fast_b
 
@@ -764,8 +810,10 @@ class DeviceCheckEngine:
         fallback = err.copy()
 
         if gres is not None:
+            t_sync = time.perf_counter()
             packed = np.asarray(gres[0])[: gres[2]]  # one D2H fetch
             self._update_gen_occ(np.asarray(gres[1]), gres[3])
+            self._phase("check_collect_sync", time.perf_counter() - t_sync)
             codes = (packed & 3).astype(np.int8)
             gover = ((packed >> 2) & 1).astype(bool)
             # dirty: the skeleton touched overlay-stale state (a changed
@@ -779,6 +827,7 @@ class DeviceCheckEngine:
             # batch => ample per-root slots) before any oracle fallback
             gunres = gover & ~gdirty & (codes != R_ERR)
             if retry and gunres.any() and self.retry_scale > 1:
+                t_retry = time.perf_counter()
                 ri = gi[np.flatnonzero(gunres)]
                 self.retries += len(ri)
                 rh = self._run_general(
@@ -792,10 +841,13 @@ class DeviceCheckEngine:
                 gover[gunres] = rover | rdirty | (rcodes == R_ERR)
                 codes = codes.copy()
                 codes[np.flatnonzero(gunres)] = rcodes
+                self._phase("check_retry", time.perf_counter() - t_retry)
             fallback[gi] |= gover | gdirty | (codes == R_ERR)
 
+        t_sync = time.perf_counter()
         codes = np.asarray(res)[:n]  # one D2H fetch for all three masks
         self._update_occ(np.asarray(occ))
+        self._phase("check_collect_sync", time.perf_counter() - t_sync)
         found = (codes & 1).astype(bool)
         over = ((codes >> 1) & 1).astype(bool)
         dirty = ((codes >> 2) & 1).astype(bool)
@@ -811,6 +863,7 @@ class DeviceCheckEngine:
         # found is monotone: an overflow only voids not-yet-found queries
         unres = fmask & over & ~found & ~dirty
         if retry and unres.any() and self.retry_scale > 1:
+            t_retry = time.perf_counter()
             ri = np.flatnonzero(unres)
             rpad = min(_bucket(len(ri), 256), self.retry_scale * self.frontier)
             renc = self._pad(tuple(a[ri] for a in enc), len(ri), rpad)
@@ -838,6 +891,7 @@ class DeviceCheckEngine:
             rdirty = ((rcodes >> 2) & 1).astype(bool)
             allowed[ri] = rfound
             unres[ri] = (rover | rdirty) & ~rfound
+            self._phase("check_retry", time.perf_counter() - t_retry)
         fallback |= unres
         return allowed, fallback
 
@@ -848,10 +902,14 @@ class DeviceCheckEngine:
             return []
         allowed, fallback = self._collect(handle)
         if fallback.any():
+            t_fb = time.perf_counter()
             for i in np.flatnonzero(fallback):
                 # oracle reproduces the exact verdict or typed error
                 self.fallbacks += 1
                 allowed[i] = self.oracle.check_is_member(queries[i], rest_depth)
+            dt = time.perf_counter() - t_fb
+            self._phase("check_oracle_fallback", dt)
+            self._rpc_fallback_stage("check", dt)
         return allowed.tolist()
 
     def batch_expand(
@@ -887,6 +945,7 @@ class DeviceCheckEngine:
             # mesh engine's lazy replicated-graph device transfer (and don't
             # stall concurrent checks on the lock) for leaves
             return out
+        t_snap = time.perf_counter()
         with self._sync_lock:
             snap = self._snapshot_locked()
             overlay_active = self._overlay_active
@@ -895,6 +954,7 @@ class DeviceCheckEngine:
                 xd.OverlayMembers(self._overlay, snap, self._vocab)
                 if overlay_active else None
             )
+        self._phase("expand_snapshot", time.perf_counter() - t_snap)
         roots = [subjects[i] for i in set_idx]
         if xarrays is None:
             # mesh replica over budget: the oracle expands from the live
@@ -904,18 +964,29 @@ class DeviceCheckEngine:
                 self.fallbacks += 1
                 out[i] = oracle.build_tree(subjects[i], rest_depth)
             return out
+        timings: dict = {}
         trees, over = xd.run_expand(
             xarrays, snap, roots, rest_depth,
             max_depth=self.max_depth, fanout=fanout, cap=cap,
             ov=ov,
             sub_expand=oracle._build,
+            timings=timings,
         )
+        for name, dt in timings.items():
+            self._phase("expand_" + name, dt)
+        t_fb = time.perf_counter()
+        fell = False
         for k, i in enumerate(set_idx):
             if over[k]:
+                fell = True
                 self.fallbacks += 1
                 out[i] = oracle.build_tree(subjects[i], rest_depth)
             else:
                 out[i] = trees[k]
+        if fell:
+            dt = time.perf_counter() - t_fb
+            self._phase("expand_oracle_fallback", dt)
+            self._rpc_fallback_stage("expand", dt)
         return out
 
     def batch_check_device_only(

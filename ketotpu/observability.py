@@ -40,6 +40,35 @@ _BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
             1.0, 2.5, 5.0, 10.0)
 
 
+# -- W3C trace context (traceparent) -----------------------------------------
+
+def parse_traceparent(value: Optional[str]) -> Optional[Tuple[str, str]]:
+    """``00-<32hex traceid>-<16hex spanid>-<flags>`` -> (trace_id, span_id).
+
+    Returns None for anything malformed — a bad header must never fail the
+    request, it just starts a fresh trace.
+    """
+    if not value:
+        return None
+    parts = value.strip().lower().split("-")
+    if len(parts) < 4:
+        return None
+    _, trace_id, span_id = parts[0], parts[1], parts[2]
+    if len(trace_id) != 32 or len(span_id) != 16:
+        return None
+    try:
+        int(trace_id, 16), int(span_id, 16)
+    except ValueError:
+        return None
+    if trace_id == "0" * 32 or span_id == "0" * 16:
+        return None
+    return trace_id, span_id
+
+
+def format_traceparent(trace_id: str, span_id: str) -> str:
+    return f"00-{trace_id}-{span_id}-01"
+
+
 class Metrics:
     """Prometheus-style registry: counters + histograms, text exposition."""
 
@@ -89,9 +118,34 @@ class Metrics:
         with self._lock:
             return self._counters.get(key, 0.0)
 
+    def get_gauge(self, name: str, **labels) -> float:
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            return self._gauges.get(key, 0.0)
+
+    def histogram_values(self, name: str) -> Dict[Tuple[Tuple[str, str], ...], Tuple[float, int]]:
+        """{label-tuple: (sum, count)} for every series of ``name`` — the
+        scrape surface bench.py uses to publish stage/phase breakdowns."""
+        with self._lock:
+            return {
+                labels: (h[1], h[2])
+                for (n, labels), h in self._hists.items()
+                if n == name
+            }
+
     @staticmethod
-    def _fmt_labels(labels: Iterable[Tuple[str, str]], extra: str = "") -> str:
-        parts = [f'{k}="{v}"' for k, v in labels]
+    def _escape_label(value: str) -> str:
+        # text format 0.0.4: label values escape backslash, quote, newline
+        return (
+            str(value)
+            .replace("\\", "\\\\")
+            .replace('"', '\\"')
+            .replace("\n", "\\n")
+        )
+
+    @classmethod
+    def _fmt_labels(cls, labels: Iterable[Tuple[str, str]], extra: str = "") -> str:
+        parts = [f'{k}="{cls._escape_label(v)}"' for k, v in labels]
         if extra:
             parts.append(extra)
         return "{" + ",".join(parts) + "}" if parts else ""
@@ -150,7 +204,9 @@ class Tracer:
         self.logger = logger
 
     @contextmanager
-    def span(self, name: str, **attrs):
+    def span(self, name: str, _parent: Optional[str] = None, **attrs):
+        """``_parent`` is an incoming W3C ``traceparent`` header; the base
+        tracer has no trace ids so it only times — exporters adopt it."""
         t0 = time.perf_counter()
         try:
             yield self
@@ -161,6 +217,12 @@ class Tracer:
                     "keto_span_duration_seconds", dt,
                     help="span wall time", span=name,
                 )
+
+    def current_traceparent(self) -> Optional[str]:
+        """traceparent for the innermost open span on this thread (None when
+        the tracer keeps no ids) — injected into the worker wire protocol so
+        OTLP traces stitch across the process boundary."""
+        return None
 
     def event(self, name: str, **attrs):
         """Span-event emission (x/events/events.go AddEvent sites)."""

@@ -29,7 +29,12 @@ import urllib.request
 from contextlib import contextmanager
 from typing import Dict, List, Optional
 
-from ketotpu.observability import Metrics, Tracer
+from ketotpu.observability import (
+    Metrics,
+    Tracer,
+    format_traceparent,
+    parse_traceparent,
+)
 
 
 def _attr(key: str, value) -> Dict:
@@ -85,13 +90,22 @@ class OTLPTracer(Tracer):
     # -- tracer surface (call sites unchanged) ------------------------------
 
     @contextmanager
-    def span(self, name: str, **attrs):
+    def span(self, name: str, _parent: Optional[str] = None, **attrs):
         stack = getattr(self._local, "stack", None)
         if stack is None:
             stack = self._local.stack = []
         parent = stack[-1] if stack else None
+        # remote parent (W3C traceparent) only seeds a root span — an open
+        # local span already owns the trace on this thread
+        remote = parse_traceparent(_parent) if parent is None else None
+        if parent is not None:
+            trace_id = parent["traceId"]
+        elif remote is not None:
+            trace_id = remote[0]
+        else:
+            trace_id = secrets.token_hex(16)
         rec = {
-            "traceId": parent["traceId"] if parent else secrets.token_hex(16),
+            "traceId": trace_id,
             "spanId": secrets.token_hex(8),
             "name": name,
             "kind": 1,  # SPAN_KIND_INTERNAL
@@ -101,6 +115,8 @@ class OTLPTracer(Tracer):
         }
         if parent is not None:
             rec["parentSpanId"] = parent["spanId"]
+        elif remote is not None:
+            rec["parentSpanId"] = remote[1]
         stack.append(rec)
         t0 = time.perf_counter()
         try:
@@ -116,6 +132,13 @@ class OTLPTracer(Tracer):
                     time.perf_counter() - t0,
                     help="span wall time", span=name,
                 )
+
+    def current_traceparent(self) -> Optional[str]:
+        stack = getattr(self._local, "stack", None)
+        if not stack:
+            return None
+        top = stack[-1]
+        return format_traceparent(top["traceId"], top["spanId"])
 
     def event(self, name: str, **attrs):
         super().event(name, **attrs)

@@ -38,6 +38,7 @@ the fast-path dispatch.  Sharded differences:
 
 from __future__ import annotations
 
+import time
 from typing import List, Optional
 
 import numpy as np
@@ -88,6 +89,11 @@ class MeshCheckEngine(DeviceCheckEngine):
         # per-shard overlay table capacity; totals still bound by
         # max_overlay_pairs/max_overlay_dirty like the single-chip engine
         self.shard_pair_cap = max(self.max_overlay_pairs // mesh_devices, 256)
+        # per-shard serving telemetry (shard_stats / registry gauges):
+        # oracle fallbacks attributed to the query's owner shard, and the
+        # last general dispatch's per-shard BFS occupancy partials
+        self._shard_fallbacks = np.zeros(mesh_devices, np.int64)
+        self._shard_gen_occ = np.zeros(mesh_devices)
 
     def _install_device_arrays(self) -> None:
         """Ship the SHARDED stacks (base + EMPTY overlays); the replicated
@@ -258,6 +264,8 @@ class MeshCheckEngine(DeviceCheckEngine):
         n = len(queries)
         if n == 0:
             return None
+        self.dispatches += 1
+        t0 = time.perf_counter()
         with self._sync_lock:
             snap = self._snapshot_locked()
             stacked = self._stacked
@@ -266,11 +274,14 @@ class MeshCheckEngine(DeviceCheckEngine):
         qpad = min(_bucket(n), self.frontier)
         padded = self._pad(enc, n, qpad)
         active = np.pad(~(err | general), (0, qpad - n))
+        self._phase("check_encode", time.perf_counter() - t0)
+        t0 = time.perf_counter()
         res = self._sharded_run(stacked, padded, active)
         gres = gi = None
         if general.any():
             gi = np.flatnonzero(general)
             gres = self._run_general_mesh(stacked, enc, gi)
+        self._phase("check_mesh_dispatch", time.perf_counter() - t0)
         return (enc, err, general, res, gi, gres, stacked, None)
 
     def _collect(self, handle, retry: bool = True):
@@ -288,6 +299,7 @@ class MeshCheckEngine(DeviceCheckEngine):
             # partials whose sum is the true global
             rows = np.asarray(gres[1])
             split = self.gen_levels + 2
+            self._shard_gen_occ = rows[:, split:].sum(axis=1).astype(float)
             self._update_gen_occ(
                 np.concatenate(
                     [rows[0, :split], rows[:, split:].sum(axis=0)]
@@ -347,4 +359,40 @@ class MeshCheckEngine(DeviceCheckEngine):
             allowed[ri] = rfound
             unres[ri] = (rover | rdirty) & ~rfound
         fallback |= unres
+        fb = np.flatnonzero(fallback)
+        if len(fb):
+            # attribute each oracle fallback to the query's owner shard
+            # (the same (ns, obj) hash that partitioned the graph); err
+            # queries may carry -1 ids — clip, the attribution is
+            # advisory telemetry, not a routing decision
+            shards = graphshard.shard_of_np(
+                np.clip(enc[0][fb], 0, None),
+                np.clip(enc[1][fb], 0, None),
+                self.n_shards,
+            )
+            np.add.at(self._shard_fallbacks, shards, 1)
         return allowed, fallback
+
+    def shard_stats(self) -> List[dict]:
+        """Per-shard serving counters for the registry's mesh gauges and
+        `cli.py status`: overlay pressure, graph size, last general
+        dispatch's BFS occupancy partial, and cumulative oracle
+        fallbacks attributed by owner shard."""
+        ovs = self._shard_overlays or []
+        snaps = self._shard_snaps or []
+        out = []
+        for i in range(self.n_shards):
+            pairs, dirty = ovs[i].size() if i < len(ovs) else (0, 0)
+            nodes = (
+                int(getattr(snaps[i], "n_nodes", 0)) if i < len(snaps) else 0
+            )
+            out.append({
+                "shard": i,
+                "batches": self.dispatches,
+                "fallbacks": int(self._shard_fallbacks[i]),
+                "overlay_pairs": int(pairs),
+                "overlay_dirty": int(dirty),
+                "nodes": nodes,
+                "gen_occupancy": float(self._shard_gen_occ[i]),
+            })
+        return out

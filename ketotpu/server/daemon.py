@@ -200,12 +200,18 @@ class Server:
     # -- construction -------------------------------------------------------
 
     def _grpc_backend(self, services: Dict[str, object]) -> Tuple[str, int]:
+        from ketotpu.server.interceptors import AccessLogInterceptor
+
         server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=16),
             options=[("grpc.so_reuseport", 0)],
-            # embedder-supplied interceptors (ketoctx
-            # WithGRPCUnaryInterceptors, daemon.go:450-486 chain)
-            interceptors=tuple(self.registry.options.grpc_interceptors),
+            # access-log/metrics interceptor first so its duration covers
+            # the embedder-supplied chain (ketoctx
+            # WithGRPCUnaryInterceptors, daemon.go:450-486)
+            interceptors=(
+                AccessLogInterceptor(self.registry),
+                *self.registry.options.grpc_interceptors,
+            ),
         )
         for name, servicer in services.items():
             add_servicer_to_server(name, servicer, server)
@@ -329,6 +335,16 @@ class Server:
         for httpd in self._http_servers:
             httpd.shutdown()
             httpd.server_close()
+        # flush + stop the OTLP exporter AFTER the backends drain so the
+        # final requests' spans ship; only if a tracer was ever built —
+        # constructing one here just to close it would be pure waste
+        tracer = self.registry._tracer
+        close = getattr(tracer, "close", None)
+        if close is not None:
+            try:
+                close()
+            except Exception:  # noqa: BLE001 - shutdown must not raise
+                self.logger.debug("tracer close failed", exc_info=True)
         self._stopped.set()
 
 

@@ -23,8 +23,11 @@ Behavioral parity notes (each encoded below, with the reference site):
 
 from __future__ import annotations
 
+import time
+
 import grpc
 
+from ketotpu import flightrec
 from ketotpu.api.proto_codec import (
     query_from_proto,
     tree_to_proto,
@@ -161,23 +164,36 @@ class CheckHandler:
     # gRPC CheckService.Check
     def Check(self, request, context):
         try:
-            r = self.r.resolve(_md(context))
-            src = request.tuple if request.HasField("tuple") else request
-            tuple_ = tuple_from_proto(src)
-            if getattr(request, "latest", False):
-                # CheckRequest.latest (check_service.proto:60-66): evaluate
-                # against the freshest possible state.  snapshot() drains
-                # the change log into the write-exact overlay; a full
-                # refresh() rebuild is stronger than needed and would let
-                # any latest=true client stall all traffic for a
-                # reprojection at 10M-tuple scale.
-                sync = getattr(r.check_engine(), "snapshot", None)
-                if sync is not None:
-                    sync()
-            allowed = self.check_core(tuple_, int(request.max_depth), r)
-            return check_service_pb2.CheckResponse(
-                allowed=allowed, snaptoken=self.snaptoken(r)
-            )
+            md = _md(context)
+            r = self.r.resolve(md)
+            with flightrec.rpc_recording(
+                r, "check", traceparent=md.get("traceparent"),
+                detail="grpc Check",
+            ):
+                t0 = time.perf_counter()
+                src = request.tuple if request.HasField("tuple") else request
+                tuple_ = tuple_from_proto(src)
+                flightrec.note_stage("parse", time.perf_counter() - t0)
+                if getattr(request, "latest", False):
+                    # CheckRequest.latest (check_service.proto:60-66):
+                    # evaluate against the freshest possible state.
+                    # snapshot() drains the change log into the write-exact
+                    # overlay; a full refresh() rebuild is stronger than
+                    # needed and would let any latest=true client stall all
+                    # traffic for a reprojection at 10M-tuple scale.
+                    sync = getattr(r.check_engine(), "snapshot", None)
+                    if sync is not None:
+                        sync()
+                t1 = time.perf_counter()
+                allowed = self.check_core(tuple_, int(request.max_depth), r)
+                flightrec.note_stage("compute", time.perf_counter() - t1)
+                flightrec.note(verdict=allowed)
+                t2 = time.perf_counter()
+                resp = check_service_pb2.CheckResponse(
+                    allowed=allowed, snaptoken=self.snaptoken(r)
+                )
+                flightrec.note_stage("encode", time.perf_counter() - t2)
+                return resp
         except Exception as e:  # noqa: BLE001 - mapped to status codes
             _abort(context, e)
 
@@ -212,14 +228,28 @@ class ExpandHandler:
                         subject=rts.Subject(id=request.subject.id),
                     )
                 )
-            s = request.subject.set
-            subject = SubjectSet(s.namespace, s.object, s.relation)
-            tree = self.expand_core(
-                subject, int(request.max_depth), self.r.resolve(_md(context))
-            )
-            if tree is None:
-                return expand_service_pb2.ExpandResponse()
-            return expand_service_pb2.ExpandResponse(tree=tree_to_proto(tree))
+            md = _md(context)
+            r = self.r.resolve(md)
+            with flightrec.rpc_recording(
+                r, "expand", traceparent=md.get("traceparent"),
+                detail="grpc Expand",
+            ):
+                t0 = time.perf_counter()
+                s = request.subject.set
+                subject = SubjectSet(s.namespace, s.object, s.relation)
+                flightrec.note_stage("parse", time.perf_counter() - t0)
+                t1 = time.perf_counter()
+                tree = self.expand_core(subject, int(request.max_depth), r)
+                flightrec.note_stage("compute", time.perf_counter() - t1)
+                t2 = time.perf_counter()
+                if tree is None:
+                    resp = expand_service_pb2.ExpandResponse()
+                else:
+                    resp = expand_service_pb2.ExpandResponse(
+                        tree=tree_to_proto(tree)
+                    )
+                flightrec.note_stage("encode", time.perf_counter() - t2)
+                return resp
         except Exception as e:  # noqa: BLE001
             _abort(context, e)
 

@@ -1,0 +1,74 @@
+"""gRPC server interceptors: per-RPC access logging + duration metrics.
+
+The reference chains logging/metrics middleware onto every gRPC server
+(`internal/driver/daemon.go:450-486`); this is the same seam for the
+Python servers.  `AccessLogInterceptor` wraps every unary handler to
+
+* observe ``keto_grpc_request_duration_seconds{method}`` on the shared
+  Metrics registry, and
+* emit one INFO access line per RPC (method, status, duration, peer)
+  when ``log.request_log`` is enabled — health-check RPCs are metered
+  but not logged, like the REST access log's health exclusion.
+
+Embedder-supplied interceptors (ketoctx ``grpc_interceptors``) still run;
+this one is prepended so the duration covers the whole chain.
+"""
+
+from __future__ import annotations
+
+import time
+
+import grpc
+
+
+class AccessLogInterceptor(grpc.ServerInterceptor):
+    """Per-RPC access log + duration histogram for unary methods."""
+
+    def __init__(self, registry):
+        self.registry = registry
+
+    def intercept_service(self, continuation, handler_call_details):
+        handler = continuation(handler_call_details)
+        if handler is None or handler.unary_unary is None:
+            return handler  # streaming/unknown: pass through untouched
+        method = handler_call_details.method
+        registry = self.registry
+        inner = handler.unary_unary
+
+        def wrapped(request, context):
+            t0 = time.perf_counter()
+            status = "OK"
+            try:
+                return inner(request, context)
+            except Exception:
+                status = "ERROR"
+                raise
+            finally:
+                dt = time.perf_counter() - t0
+                # abort()/set_code() paths: report the code the handler set
+                code = getattr(context, "code", lambda: None)()
+                if code is not None and code != grpc.StatusCode.OK:
+                    status = getattr(code, "name", str(code))
+                registry.metrics().observe(
+                    "keto_grpc_request_duration_seconds", dt,
+                    help="gRPC request duration by full method name",
+                    method=method,
+                )
+                if (
+                    not method.startswith("/grpc.health.")
+                    and bool(registry.config.get("log.request_log", True))
+                ):
+                    registry.logger().info(
+                        "grpc request", extra={"fields": {
+                            "method": method,
+                            "status": status,
+                            "duration_ms": round(dt * 1000.0, 3),
+                            "peer": context.peer(),
+                        }},
+                    )
+
+        return grpc.unary_unary_rpc_method_handler(
+            wrapped,
+            request_deserializer=handler.request_deserializer,
+            response_serializer=handler.response_serializer,
+        )

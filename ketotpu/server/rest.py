@@ -25,10 +25,12 @@ from __future__ import annotations
 import json
 import socket
 import time
+from contextlib import nullcontext
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlencode, urlparse
 
+from ketotpu import flightrec
 from ketotpu.api.types import (
     BadRequestError,
     KetoAPIError,
@@ -44,6 +46,15 @@ _STATUS_TEXT = {
     404: "Not Found",
     405: "Method Not Allowed",
     500: "Internal Server Error",
+}
+
+# REST paths that get the full stage decomposition (flightrec context);
+# everything else still gets the http duration histogram + access log
+_RPC_OPS = {
+    "/relation-tuples/check": "check",
+    "/relation-tuples/check/openapi": "check",
+    "/relation-tuples/check/batch": "check",
+    "/relation-tuples/expand": "expand",
 }
 
 # admin DELETE rejects unknown query params (internal/x/validate, used at
@@ -401,7 +412,16 @@ def opl_router(registry) -> Router:
 
 
 def metrics_router(registry) -> Router:
-    return Router(registry, "metrics")
+    rt = Router(registry, "metrics")
+
+    def get_flight_recorder(req):
+        # debug surface on the metrics port only (admin-port hygiene):
+        # the N slowest recent requests with their stage vectors
+        rec = registry.flight_recorder()
+        return 200, {"slowest": rec.snapshot()}
+
+    rt.add("GET", "/debug/flight-recorder", get_flight_recorder)
+    return rt
 
 
 # -- HTTP server ------------------------------------------------------------
@@ -411,6 +431,9 @@ def make_http_server(router: Router, host: str, port: int,
                      reuse_port: bool = False) -> ThreadingHTTPServer:
     registry = router.r
     logger = registry.logger()
+    # per-request access log (negroni middleware parity, daemon.go:336);
+    # benches disable it via config to keep the hammer path clean
+    access_log = bool(registry.config.get("log.request_log", True))
 
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -427,31 +450,49 @@ def make_http_server(router: Router, host: str, port: int,
             length = int(self.headers.get("Content-Length") or 0)
             body = self.rfile.read(length) if length else b""
             hdrs = {k.lower(): v for k, v in self.headers.items()}
-            status, payload, extra = router.dispatch(
-                method, parsed.path, Request(query, body, hdrs)
-            )
-            if payload is None:
-                data = b""
-                ctype = "application/json"
-            elif isinstance(payload, tuple):
-                ctype, text = payload
-                data = text.encode("utf-8")
-            else:
-                ctype = "application/json"
-                data = json.dumps(payload).encode("utf-8")
-            self.send_response(status)
-            self.send_header("Content-Type", ctype)
-            self.send_header("Content-Length", str(len(data)))
-            for k, v in extra.items():
-                self.send_header(k, v)
-            if router.cors:
-                for k, v in (cors_headers(
-                    router.cors, hdrs.get("origin")
-                ) or {}).items():
+            t_parse = time.perf_counter()
+            op = _RPC_OPS.get(parsed.path)
+            rec = flightrec.rpc_recording(
+                registry, op, traceparent=hdrs.get("traceparent"),
+                detail=f"{method} {parsed.path}", t0=t0,
+            ) if op else nullcontext()
+            with rec:
+                flightrec.note_stage("parse", t_parse - t0)
+                status, payload, extra = router.dispatch(
+                    method, parsed.path, Request(query, body, hdrs)
+                )
+                flightrec.note_stage(
+                    "compute", time.perf_counter() - t_parse
+                )
+                if (op == "check" and isinstance(payload, dict)
+                        and "allowed" in payload):
+                    flightrec.note(verdict=payload["allowed"])
+                t_enc = time.perf_counter()
+                if payload is None:
+                    data = b""
+                    ctype = "application/json"
+                elif isinstance(payload, tuple):
+                    ctype, text = payload
+                    data = text.encode("utf-8")
+                else:
+                    ctype = "application/json"
+                    data = json.dumps(payload).encode("utf-8")
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                for k, v in extra.items():
                     self.send_header(k, v)
-            self.end_headers()
-            if data:
-                self.wfile.write(data)
+                if router.cors:
+                    for k, v in (cors_headers(
+                        router.cors, hdrs.get("origin")
+                    ) or {}).items():
+                        self.send_header(k, v)
+                self.end_headers()
+                if data:
+                    self.wfile.write(data)
+                flightrec.note_stage(
+                    "encode", time.perf_counter() - t_enc
+                )
             dt = time.perf_counter() - t0
             registry.metrics().observe(
                 "keto_http_request_duration_seconds", dt,
@@ -460,9 +501,22 @@ def make_http_server(router: Router, host: str, port: int,
                 status=str(status),
             )
             if parsed.path not in ("/health/alive", "/health/ready"):
-                logger.debug(
-                    "%s %s -> %d (%.1fms)", method, parsed.path, status, dt * 1e3
-                )
+                if access_log:
+                    logger.info(
+                        "http_request", extra={"fields": {
+                            "method": method,
+                            "path": parsed.path,
+                            "status": status,
+                            "duration_ms": round(dt * 1e3, 3),
+                            "peer": "%s:%s" % self.client_address[:2],
+                            "endpoint": router.endpoint,
+                        }},
+                    )
+                else:
+                    logger.debug(
+                        "%s %s -> %d (%.1fms)",
+                        method, parsed.path, status, dt * 1e3,
+                    )
 
         def do_OPTIONS(self):
             # CORS preflight (rs/cors handles OPTIONS before routing)

@@ -33,8 +33,10 @@ import os
 import socket
 import socketserver
 import threading
+import time
 from typing import List, Optional, Sequence
 
+from ketotpu import flightrec
 from ketotpu.api.types import (
     KetoAPIError,
     RelationTuple,
@@ -97,30 +99,43 @@ class EngineHostServer:
     def _serve_one(self, req):
         r = self.registry
         op = req.get("op")
+        # workers forward their RPC's traceparent so the owner-side spans
+        # (coalescer wave, device dispatch) stitch into the same trace
+        tp = req.pop("traceparent", None)
         if op == "check":
-            tuples = [RelationTuple.from_string(s) for s in req["tuples"]]
-            eng = r.check_engine()
-            depth = int(req.get("depth", 0))
-            if len(tuples) == 1:
-                # single-check RPCs from the workers MUST go through
-                # check_is_member: that is the coalescer's enqueue point,
-                # so concurrent singles from every worker merge into one
-                # shared device wave.  batch_check passes straight
-                # through the coalescer (it is already batched) — routing
-                # singles there made each RPC its own device dispatch.
-                return {"ok": [bool(eng.check_is_member(tuples[0], depth))]}
-            batch = getattr(eng, "batch_check", None)
-            if batch is not None:
-                ok = batch(tuples, depth)
-            else:  # oracle engine: sequential surface only
-                ok = [eng.check_is_member(t, depth) for t in tuples]
-            return {"ok": [bool(v) for v in ok]}
+            with flightrec.rpc_recording(
+                r, "check", traceparent=tp, detail="worker->owner check"
+            ):
+                t0 = time.perf_counter()
+                tuples = [RelationTuple.from_string(s) for s in req["tuples"]]
+                flightrec.note_stage("parse", time.perf_counter() - t0)
+                eng = r.check_engine()
+                depth = int(req.get("depth", 0))
+                if len(tuples) == 1:
+                    # single-check RPCs from the workers MUST go through
+                    # check_is_member: that is the coalescer's enqueue point,
+                    # so concurrent singles from every worker merge into one
+                    # shared device wave.  batch_check passes straight
+                    # through the coalescer (it is already batched) — routing
+                    # singles there made each RPC its own device dispatch.
+                    ok = [bool(eng.check_is_member(tuples[0], depth))]
+                    flightrec.note(verdict=ok[0])
+                    return {"ok": ok}
+                batch = getattr(eng, "batch_check", None)
+                if batch is not None:
+                    ok = batch(tuples, depth)
+                else:  # oracle engine: sequential surface only
+                    ok = [eng.check_is_member(t, depth) for t in tuples]
+                return {"ok": [bool(v) for v in ok]}
         if op == "expand":
-            subject = _decode_subject(req["subject"])
-            tree = r.expand_engine().build_tree(
-                subject, int(req.get("depth", 0))
-            )
-            return {"tree": tree.to_json() if tree is not None else None}
+            with flightrec.rpc_recording(
+                r, "expand", traceparent=tp, detail="worker->owner expand"
+            ):
+                subject = _decode_subject(req["subject"])
+                tree = r.expand_engine().build_tree(
+                    subject, int(req.get("depth", 0))
+                )
+                return {"tree": tree.to_json() if tree is not None else None}
         if op == "ping":
             return {"pong": True}
         raise ValueError(f"unknown op {op!r}")
@@ -174,12 +189,19 @@ class RemoteCheckEngine:
         return c
 
     def _call(self, req) -> dict:
+        tp = flightrec.current_traceparent()
+        if tp:
+            req = dict(req, traceparent=tp)
+        t0 = time.perf_counter()
         try:
-            return self._conn().call(req)
-        except (ConnectionError, OSError):
-            # owner restarted: one reconnect attempt before failing
-            self._local.conn = None
-            return self._conn().call(req)
+            try:
+                return self._conn().call(req)
+            except (ConnectionError, OSError):
+                # owner restarted: one reconnect attempt before failing
+                self._local.conn = None
+                return self._conn().call(req)
+        finally:
+            flightrec.note_stage("worker_rpc", time.perf_counter() - t0)
 
     def batch_check(
         self, queries: Sequence[RelationTuple], rest_depth: int = 0
