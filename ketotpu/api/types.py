@@ -36,6 +36,18 @@ class NotFoundError(KetoAPIError):
     status_code = 404
 
 
+class TooManyRequestsError(KetoAPIError):
+    """Admission control shed this request; the client should back off."""
+
+    status_code = 429
+
+
+class DeadlineExceededError(KetoAPIError):
+    """The request's deadline budget expired before a verdict was ready."""
+
+    status_code = 504
+
+
 def ErrMalformedInput(detail: str = "") -> BadRequestError:
     # reference: ketoapi/enc_string.go:14
     msg = "malformed string input"

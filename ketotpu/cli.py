@@ -138,6 +138,9 @@ def cmd_serve(args) -> int:
     if workers > 0:
         return _serve_multiprocess(args, workers)
     cfg = Provider(config_file=args.config) if args.config else Provider()
+    from ketotpu import faults
+
+    faults.configure_from_config(cfg)
     reg = Registry(cfg)
     reg.logger().info("initializing registry (engine warmup)")
     reg.init()
@@ -162,10 +165,12 @@ def _serve_multiprocess(args, workers: int) -> int:
     import sys as _sys
     import tempfile
 
+    from ketotpu import faults
     from ketotpu.driver import Provider, Registry
-    from ketotpu.server.workers import EngineHostServer
+    from ketotpu.server.workers import EngineHostServer, WorkerSupervisor
 
     cfg = Provider(config_file=args.config) if args.config else Provider()
+    faults.configure_from_config(cfg)
     if cfg.dsn() == "memory":
         print(
             "serve --workers needs a shared durable dsn "
@@ -175,7 +180,8 @@ def _serve_multiprocess(args, workers: int) -> int:
         )
         return 2
     reg = Registry(cfg)
-    reg.logger().info("initializing device owner (engine warmup)")
+    log = reg.logger()
+    log.info("initializing device owner (engine warmup)")
     reg.init()
     # the socket lives in a fresh 0700 directory: a bare mktemp name in
     # world-writable /tmp is squattable between name pick and bind, and
@@ -183,47 +189,43 @@ def _serve_multiprocess(args, workers: int) -> int:
     # actually gates connect permission
     sockdir = tempfile.mkdtemp(prefix="keto-engine-")
     sock = os.path.join(sockdir, "engine.sock")
-    host = EngineHostServer(reg, sock).start()
-    reg.logger().info("engine host on %s; forking %d workers", sock, workers)
-    procs = [
-        subprocess.Popen([
+    host = EngineHostServer(reg, sock, health_fn=reg.health).start()
+
+    def spawn(i: int) -> "subprocess.Popen":
+        return subprocess.Popen([
             _sys.executable, "-m", "ketotpu.cli", "serve",
             *(["-c", args.config] if args.config else []),
             "--worker-of", sock,
         ])
-        for _ in range(workers)
-    ]
+
+    sup = WorkerSupervisor(spawn, workers, log=log.warning)
+    # the owner's health (served to workers over the socket's "health"
+    # op) reports `degraded` while any worker is down/respawning, so
+    # `status --block` can tell a degraded topology from a dead one
+    reg.readiness_checks["workers"] = sup.state
+    log.info("engine host on %s; forking %d workers", sock, workers)
+    sup.start()
     rc = 0
     try:
-        # poll, don't wait sequentially: ANY worker dying (port bind
-        # race, crash) must surface immediately — a sequential wait on
-        # worker 0 would mask worker 1's death while the topology
-        # silently serves at reduced width
-        live = list(procs)
-        while live:
-            for p in list(live):
-                code = p.poll()
-                if code is None:
-                    continue
-                live.remove(p)
-                if code:
-                    reg.logger().error(
-                        "worker pid %d exited rc=%d", p.pid, code
-                    )
-                    rc = 1
-            if rc:
-                for p in live:
-                    p.terminate()
-                for p in live:
-                    p.wait(timeout=10)
+        # supervise, don't just watch: a dead worker (crash, OOM) is
+        # respawned with capped backoff; only a worker that keeps dying
+        # rapidly — a systemic failure like a port bind race — makes the
+        # whole topology exit
+        while True:
+            code = sup.poll()
+            if code is not None:
+                rc = code
                 break
+            if not host.is_alive():
+                # the device owner died: respawn it too (workers ride out
+                # the gap through their reconnect backoff)
+                log.warning("engine host died; restarting")
+                host = host.restart()
             time.sleep(0.5)
+        sup.terminate()
     except KeyboardInterrupt:
-        reg.logger().info("shutting down workers")
-        for p in procs:
-            p.terminate()
-        for p in procs:
-            p.wait(timeout=10)
+        log.info("shutting down workers")
+        sup.terminate()
     finally:
         host.stop()
         try:
@@ -244,7 +246,15 @@ def cmd_serve_worker(args) -> int:
     ) if args.config else Provider(
         {"engine": {"kind": "remote", "socket": args.worker_of}}
     )
+    from ketotpu import faults
+    from ketotpu.server.workers import engine_host_readiness
+
+    faults.configure_from_config(cfg)
     reg = Registry(cfg)
+    # readiness rides the owner's: unreachable socket = down, and the
+    # owner's degraded state (CPU fallback, respawning sibling) shows
+    # through this worker's health surface too
+    reg.readiness_checks["engine_host"] = engine_host_readiness(args.worker_of)
     srv = serve_all(reg, reuse_port=True)
     try:
         srv.wait()
@@ -463,6 +473,22 @@ def cmd_ns_validate(args) -> int:
     return 0
 
 
+def _ready_degraded(metrics_remote: str) -> dict:
+    """Best-effort readiness detail off the metrics port: the degraded
+    map when the daemon reports a degraded-but-serving state, else {}."""
+    import urllib.request
+
+    url = f"http://{metrics_remote}/health/ready"
+    try:
+        with urllib.request.urlopen(url, timeout=2.0) as resp:
+            payload = json.loads(resp.read().decode("utf-8"))
+    except (OSError, ValueError):
+        return {}
+    if isinstance(payload, dict) and payload.get("status") == "degraded":
+        return payload.get("degraded") or {}
+    return {}
+
+
 def _dump_flight_recorder(metrics_remote: str) -> int:
     """Fetch + pretty-print the flight recorder's slowest-request ring from
     the metrics port's debug endpoint (server/rest.py metrics_router)."""
@@ -516,7 +542,17 @@ def cmd_status(args) -> int:
                 stub = _stub_class("grpc.health.v1.Health")(ch)
                 resp = stub.Check(health_pb2.HealthCheckRequest())
                 if resp.status == health_pb2.HealthCheckResponse.SERVING:
-                    print("status: SERVING")
+                    # SERVING covers both healthy and degraded (device
+                    # engine on CPU fallback, worker respawning): fetch
+                    # the readiness detail to tell them apart
+                    degraded = _ready_degraded(args.metrics_remote)
+                    if degraded:
+                        detail = "; ".join(
+                            f"{k}={v}" for k, v in sorted(degraded.items())
+                        )
+                        print(f"status: SERVING (degraded: {detail})")
+                    else:
+                        print("status: SERVING")
                     return 0
                 print(f"status: {resp.status}")
                 if not args.block:

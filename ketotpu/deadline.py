@@ -1,0 +1,124 @@
+"""Per-request deadline budgets propagated across every blocking hop.
+
+Zanzibar's availability story is built on deadlines, not retries: every
+RPC carries a budget and every blocking wait is bounded by whatever is
+left of it.  This module is the thread-local carrier for that budget on
+the serving path:
+
+* the gRPC layer binds ``context.time_remaining()`` around the handler
+  (see ``AdmissionInterceptor``), the REST layer binds the
+  ``X-Request-Timeout`` header (see ``rest.py``);
+* the coalescer bounds its slot wait with ``remaining()``;
+* ``RemoteCheckEngine`` forwards the budget as a ``deadline_ms`` wire
+  field and sets the owner-socket timeout from it;
+* the device engine's oracle-fallback loops call ``check()`` between
+  queries so a long tail of fallbacks cannot outlive the request.
+
+Budgets are monotonic-clock absolute expirations, so nesting keeps the
+tighter deadline and forwarding a remaining budget across a hop never
+stretches it.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Iterator, Optional, Union
+
+from ketotpu.api.types import BadRequestError, DeadlineExceededError
+
+_state = threading.local()
+
+# Budgets past this are "effectively unbounded": gRPC reports a huge
+# time_remaining() for deadline-less calls, and feeding that into
+# Event.wait() overflows CPython's _PyTime_t.
+_MAX_BUDGET = 86400.0
+
+
+def current() -> Optional[float]:
+    """Absolute monotonic expiration of the active budget, or None."""
+    return getattr(_state, "expires_at", None)
+
+
+def remaining() -> Optional[float]:
+    """Seconds left in the active budget (may be <= 0), or None."""
+    expires_at = getattr(_state, "expires_at", None)
+    if expires_at is None:
+        return None
+    return expires_at - time.monotonic()
+
+
+def check(what: str = "request") -> None:
+    """Raise DeadlineExceededError if the active budget has expired."""
+    left = remaining()
+    if left is not None and left <= 0:
+        raise DeadlineExceededError(f"deadline exceeded while serving {what}")
+
+
+@contextlib.contextmanager
+def scope(seconds: Optional[float]) -> Iterator[None]:
+    """Bind a deadline budget to the current thread.
+
+    ``None`` is a pass-through (no budget, or keep the enclosing one).
+    Nested scopes keep the TIGHTER deadline: a downstream hop may shrink
+    the budget but never extend what the caller granted.
+    """
+    if seconds is None or seconds > _MAX_BUDGET:
+        yield
+        return
+    prev = getattr(_state, "expires_at", None)
+    expires_at = time.monotonic() + max(0.0, seconds)
+    if prev is not None:
+        expires_at = min(prev, expires_at)
+    _state.expires_at = expires_at
+    try:
+        yield
+    finally:
+        _state.expires_at = prev
+
+
+def deadline_ms() -> Optional[int]:
+    """Remaining budget in whole milliseconds for the wire, or None.
+
+    An already-expired budget is reported as 0 so the receiver fails
+    fast instead of doing work nobody is waiting for.
+    """
+    left = remaining()
+    if left is None:
+        return None
+    return max(0, int(left * 1000))
+
+
+def parse_timeout(value: Union[str, float, int, None]) -> Optional[float]:
+    """Parse an ``X-Request-Timeout`` header into seconds.
+
+    Accepts ``"50ms"``, ``"1.5s"``, or a bare number of seconds.  Empty /
+    None means no budget.  Malformed or non-positive values are a client
+    error — silently ignoring them would turn a typo into an unbounded
+    request.
+    """
+    if value is None:
+        return None
+    if isinstance(value, (int, float)):
+        seconds = float(value)
+    else:
+        text = value.strip().lower()
+        if not text:
+            return None
+        try:
+            if text.endswith("ms"):
+                seconds = float(text[:-2]) / 1000.0
+            elif text.endswith("s"):
+                seconds = float(text[:-1])
+            else:
+                seconds = float(text)
+        except ValueError:
+            raise BadRequestError(
+                f"malformed request timeout {value!r}; use e.g. '50ms' or '1.5s'"
+            ) from None
+    if seconds <= 0:
+        raise BadRequestError(
+            f"request timeout must be positive, got {value!r}"
+        )
+    return seconds

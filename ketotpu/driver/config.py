@@ -87,7 +87,17 @@ def _defaults() -> Dict[str, Any]:
             }
             for name, port in DEFAULT_PORTS.items()
         },
-        "limit": {"max_read_depth": 5, "max_read_width": 100},
+        "limit": {
+            "max_read_depth": 5,
+            "max_read_width": 100,
+            # robustness envelope: bounded concurrent in-flight requests
+            # per process (0 disables shedding), the default per-request
+            # deadline budget (0 disables), and how long the mux waits for
+            # a silent client's protocol preface before disconnecting
+            "max_inflight": 1024,
+            "request_timeout_ms": 30000,
+            "sniff_timeout_ms": 10000,
+        },
         "namespaces": [],
         "engine": {
             # "tpu" = batched device engine with oracle fallback;
@@ -126,6 +136,17 @@ def _defaults() -> Dict[str, Any]:
             "opt_out": False,
             "server_url": "",
             "interval_ms": 21_600_000,
+        },
+        # fault injection (ketotpu/faults.py): all-zero = inactive.  The
+        # KETO_FAULT_* environment knobs override this block entirely —
+        # that is how the chaos CI job drives subprocesses.
+        "faults": {
+            "device_error_rate": 0.0,
+            "device_stall_ms": 0.0,
+            "socket_drop_rate": 0.0,
+            "latency_ms": 0.0,
+            "latency_rate": 0.0,
+            "seed": 0,
         },
     }
 
@@ -187,7 +208,11 @@ class Provider:
             # rejoin known multi-word leaf keys (env has one separator only)
             for known in ("max_read_depth", "max_read_width", "mesh_devices",
                           "mesh_axis", "max_batch", "retry_scale",
-                          "coalesce_ms", "experimental_strict_mode"):
+                          "coalesce_ms", "experimental_strict_mode",
+                          "max_inflight", "request_timeout_ms",
+                          "sniff_timeout_ms", "device_error_rate",
+                          "device_stall_ms", "socket_drop_rate",
+                          "latency_ms", "latency_rate"):
                 suffix = known.split("_")
                 if len(joined) > len(suffix) and joined[-len(suffix):] == suffix:
                     joined = joined[: -len(suffix)] + [known]
@@ -336,6 +361,18 @@ class Provider:
             val = self.get(key)
             if not isinstance(val, int) or val < lo:
                 raise ConfigError(key, f"must be an integer >= {lo}, got {val!r}")
+        for key in ("limit.max_inflight", "limit.request_timeout_ms",
+                    "limit.sniff_timeout_ms"):
+            val = self.get(key)
+            if not isinstance(val, int) or val < 0:
+                raise ConfigError(
+                    key, f"must be a non-negative integer, got {val!r}"
+                )
+        for key in ("faults.device_error_rate", "faults.socket_drop_rate",
+                    "faults.latency_rate"):
+            val = self.get(key, 0)
+            if not isinstance(val, (int, float)) or not (0 <= val <= 1):
+                raise ConfigError(key, f"must be a rate in [0, 1], got {val!r}")
         ns = v.get("namespaces")
         if isinstance(ns, dict):
             if "location" not in ns and "experimental_strict_mode" not in ns:

@@ -79,6 +79,7 @@ class Registry:
         self._expand_engine = None
         self._oracle_engine = None
         self._flight_recorder = None
+        self._admission = None
         self._mapper = None
         self._ro_mapper = None
         self._uuid_mapper = None
@@ -322,7 +323,9 @@ class Registry:
                             "engine.socket",
                             "engine.kind=remote needs engine.socket",
                         )
-                    self._check_engine = RemoteCheckEngine(sock)
+                    self._check_engine = RemoteCheckEngine(
+                        sock, rpc_timeout=self._request_timeout(),
+                    )
                 elif kind == "tpu":
                     common = dict(
                         max_depth=self.config.max_read_depth(),
@@ -356,12 +359,35 @@ class Registry:
                     # concurrent single checks ride one device dispatch
                     # (engine/coalesce.py); 0 disables
                     self._check_engine = (
-                        CoalescingEngine(dev, window=ms / 1000.0)
+                        CoalescingEngine(
+                            dev, window=ms / 1000.0,
+                            default_timeout=self._request_timeout(),
+                        )
                         if ms > 0 else dev
                     )
                 else:
                     self._check_engine = self.oracle_engine()
             return self._check_engine
+
+    def _request_timeout(self) -> float:
+        """Default per-request budget in seconds (limit.request_timeout_ms):
+        the fallback deadline for callers that set none; <= 0 disables."""
+        return float(
+            self.config.get("limit.request_timeout_ms", 30000) or 0
+        ) / 1000.0
+
+    def admission(self):
+        """Shared in-flight admission controller (limit.max_inflight):
+        both REST handler threads and the gRPC interceptors of every port
+        draw from this one budget; 0 disables shedding."""
+        with self._lock:
+            if self._admission is None:
+                from ketotpu.server.admission import AdmissionController
+
+                self._admission = AdmissionController(
+                    int(self.config.get("limit.max_inflight", 1024) or 0)
+                )
+            return self._admission
 
     def _device_engine(self) -> Optional[DeviceCheckEngine]:
         """The underlying device engine, unwrapping the coalescer facade."""
@@ -561,15 +587,44 @@ class Registry:
                     shard=s)
 
     def health(self) -> Dict[str, str]:
-        """Readiness probe results; "ok" or the error string per check."""
+        """Readiness probe results per check: "ok", a returned string
+        (``"degraded: ..."`` keeps the daemon SERVING but surfaced), or
+        the raised exception's message (down)."""
         out = {}
         for name, check in self.readiness_checks.items():
             try:
-                check()
-                out[name] = "ok"
+                value = check()
+                out[name] = str(value) if isinstance(value, str) else "ok"
             except Exception as e:  # noqa: BLE001 - reported, not raised
                 out[name] = str(e)
+        # built-in: a device engine serving off the CPU oracle is degraded.
+        # Only consult an engine that is already BUILT — a health probe
+        # must never trigger a multi-second lazy snapshot build.
+        with self._lock:
+            outer = self._check_engine
+        eng = getattr(outer, "inner", outer)
+        degraded = getattr(eng, "is_degraded", None)
+        if degraded is not None and degraded():
+            out["engine"] = (
+                "degraded: device dispatch failing "
+                f"({eng.device_failures} failures), serving on CPU oracle"
+            )
         return out
+
+    def close_engines(self) -> None:
+        """Retire engine workers (the coalescer's wave thread and any
+        pending slots) ahead of daemon shutdown; tenants included."""
+        with self._lock:
+            engines = [self._check_engine] + [
+                t._check_engine for t in self._tenants.values()
+            ]
+        for eng in engines:
+            close = getattr(eng, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception:  # noqa: BLE001 - shutdown must not raise
+                    pass
 
 
 class _DeviceExpandAdapter:

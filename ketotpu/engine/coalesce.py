@@ -23,8 +23,13 @@ import threading
 import time
 from typing import List, Optional, Sequence
 
-from ketotpu import flightrec
-from ketotpu.api.types import KetoAPIError, RelationTuple
+from ketotpu import deadline, flightrec
+from ketotpu.api.types import (
+    DeadlineExceededError,
+    KetoAPIError,
+    RelationTuple,
+    TooManyRequestsError,
+)
 
 
 class _Slot:
@@ -46,16 +51,23 @@ class CoalescingEngine:
     """check_is_member batching facade over a (device) check engine."""
 
     def __init__(self, inner, *, window: float = 0.002,
-                 max_pending: int = 4096):
+                 max_pending: int = 4096,
+                 default_timeout: float = 30.0):
         self.inner = inner
         self.window = window
         self.max_pending = max_pending
+        # budget for callers with no explicit deadline: no slot may wait
+        # forever — a wedged dispatch must surface as DEADLINE_EXCEEDED,
+        # not as every serving thread hanging (<= 0 disables the bound)
+        self.default_timeout = default_timeout
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
         self._pending: List[_Slot] = []
         self._closed = False
         self.waves = 0  # observability: coalesced dispatch count
         self.coalesced = 0  # observability: queries served via waves
+        self.shed = 0  # observability: queries refused on backlog
+        self.deadline_exceeded = 0  # observability: slot waits timed out
         self._worker = threading.Thread(
             target=self._run, name="keto-coalescer", daemon=True
         )
@@ -67,15 +79,41 @@ class CoalescingEngine:
         return self.check_is_member(r, rest_depth)
 
     def check_is_member(self, r: RelationTuple, rest_depth: int = 0) -> bool:
+        budget = deadline.remaining()
+        if budget is None:
+            budget = self.default_timeout if self.default_timeout > 0 else None
+        if budget is not None and budget <= 0:
+            self.deadline_exceeded += 1
+            flightrec.note_stage("deadline", 0.0)
+            raise DeadlineExceededError(
+                "deadline exceeded before check was enqueued"
+            )
         with self._wake:
             if self._closed:
                 # the worker is gone; never strand the caller on a dead
                 # queue — answer directly on the wrapped engine
                 return bool(self.inner.check_is_member(r, rest_depth))
+            if len(self._pending) >= self.max_pending:
+                # backlog saturated: shed NOW rather than queue behind a
+                # wave the device may never drain in time
+                self.shed += 1
+                flightrec.note_stage("shed", 0.0)
+                raise TooManyRequestsError(
+                    f"check backlog full ({self.max_pending} pending)"
+                )
             slot = _Slot(r, rest_depth)
             self._pending.append(slot)
             self._wake.notify()
-        slot.event.wait()
+        if not slot.event.wait(budget):
+            waited = time.perf_counter() - slot.t_enq
+            self.deadline_exceeded += 1
+            flightrec.note_stage("deadline", waited)
+            # the slot stays owned by the wave worker — it will set the
+            # event into the void; this caller is gone
+            raise DeadlineExceededError(
+                f"check did not complete within {budget:.3f}s "
+                f"(waited {waited:.3f}s)"
+            )
         # stage decomposition for the RPC that enqueued us: queue wait is
         # enqueue -> wave cut, device compute is wave cut -> wakeup (both
         # no-ops when this thread isn't serving an instrumented RPC)

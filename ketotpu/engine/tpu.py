@@ -61,8 +61,8 @@ if _plat:
     except Exception:  # noqa: BLE001 — never block engine import on this
         pass
 
-from ketotpu import flightrec
-from ketotpu.api.types import RelationTuple
+from ketotpu import deadline, faults, flightrec
+from ketotpu.api.types import KetoAPIError, RelationTuple
 from ketotpu.engine import algebra as alg
 from ketotpu.engine import delta as dl
 from ketotpu.engine import fastpath as fp
@@ -227,6 +227,12 @@ class DeviceCheckEngine:
         self.checkpoint_errors = 0
         self.metrics = metrics  # optional Metrics registry for phase hists
         self.dispatches = 0  # observability: device dispatch count
+        self.device_failures = 0  # observability: whole-dispatch failures
+        # monotonic stamp of the last device failure: health reports the
+        # engine ``degraded`` (serving from the CPU oracle) while failures
+        # are recent, and recovers on its own once dispatches stay clean
+        self._last_device_failure = 0.0
+        self.degraded_window = 30.0
         # host-side phase accumulators (seconds / samples): bench sections
         # read these directly; the same samples land in
         # keto_engine_phase_seconds when a Metrics registry is attached
@@ -247,6 +253,16 @@ class DeviceCheckEngine:
 
     def _gen_timer(self, dt: float) -> None:
         self._phase("check_gen_dispatch", dt)
+
+    def _device_failure(self) -> None:
+        self.device_failures += 1
+        self._last_device_failure = time.monotonic()
+
+    def is_degraded(self) -> bool:
+        """True while device dispatches are failing over to the CPU oracle."""
+        if not self.device_failures:
+            return False
+        return (time.monotonic() - self._last_device_failure) < self.degraded_window
 
     def _rpc_fallback_stage(self, op: str, dt: float) -> None:
         """File oracle-fallback time as the RPC-level ``fallback`` stage.
@@ -594,15 +610,40 @@ class DeviceCheckEngine:
             queries[lo : lo + self.max_batch]
             for lo in range(0, len(queries), self.max_batch)
         ]
-        # dispatch everything before syncing on anything: device executions
-        # queue back-to-back while the host reads earlier chunks' results
-        handles = [self._dispatch(c, rest_depth) for c in chunks]
-        out: List[bool] = []
-        for c, h in zip(chunks, handles):
-            out.extend(self._finish_chunk(c, h, rest_depth))
+        try:
+            # dispatch everything before syncing on anything: device
+            # executions queue back-to-back while the host reads earlier
+            # chunks' results
+            handles = [self._dispatch(c, rest_depth) for c in chunks]
+            out: List[bool] = []
+            for c, h in zip(chunks, handles):
+                out.extend(self._finish_chunk(c, h, rest_depth))
+        except KetoAPIError:
+            raise  # typed client errors (and deadline/shed) pass through
+        except Exception:  # noqa: BLE001
+            # the device dispatch itself died (runtime error, injected
+            # fault): the whole batch is servable on the CPU oracle — a
+            # degraded answer beats an error for every concurrent caller.
+            # Health reports ``degraded`` until dispatches stay clean.
+            self._device_failure()
+            out = self._serve_batch_on_oracle(queries, rest_depth)
         # RPCs that reach the engine without the coalescer (batch routes)
         # still get a device_compute stage; no-op outside a request context
         flightrec.note_stage("device_compute", time.perf_counter() - t0)
+        return out
+
+    def _serve_batch_on_oracle(
+        self, queries: Sequence[RelationTuple], rest_depth: int
+    ) -> List[bool]:
+        t_fb = time.perf_counter()
+        out: List[bool] = []
+        for q in queries:
+            deadline.check("oracle fallback")
+            self.fallbacks += 1
+            out.append(bool(self.oracle.check_is_member(q, rest_depth)))
+        dt = time.perf_counter() - t_fb
+        self._phase("check_oracle_fallback", dt)
+        self._rpc_fallback_stage("check", dt)
         return out
 
     def _pad(self, arrays, n: int, qpad: int):
@@ -619,6 +660,7 @@ class DeviceCheckEngine:
         n = len(queries)
         if n == 0:
             return None
+        faults.inject("device_dispatch")
         self.dispatches += 1
         t_enc = time.perf_counter()
         snap, dev_arrays, overlay_active = self._sync_view()
@@ -904,7 +946,9 @@ class DeviceCheckEngine:
         if fallback.any():
             t_fb = time.perf_counter()
             for i in np.flatnonzero(fallback):
-                # oracle reproduces the exact verdict or typed error
+                # oracle reproduces the exact verdict or typed error; a
+                # long fallback tail must not outlive the request's budget
+                deadline.check("oracle fallback")
                 self.fallbacks += 1
                 allowed[i] = self.oracle.check_is_member(queries[i], rest_depth)
             dt = time.perf_counter() - t_fb
@@ -965,13 +1009,30 @@ class DeviceCheckEngine:
                 out[i] = oracle.build_tree(subjects[i], rest_depth)
             return out
         timings: dict = {}
-        trees, over = xd.run_expand(
-            xarrays, snap, roots, rest_depth,
-            max_depth=self.max_depth, fanout=fanout, cap=cap,
-            ov=ov,
-            sub_expand=oracle._build,
-            timings=timings,
-        )
+        try:
+            faults.inject("device_dispatch")
+            trees, over = xd.run_expand(
+                xarrays, snap, roots, rest_depth,
+                max_depth=self.max_depth, fanout=fanout, cap=cap,
+                ov=ov,
+                sub_expand=oracle._build,
+                timings=timings,
+            )
+        except KetoAPIError:
+            raise
+        except Exception:  # noqa: BLE001
+            # device expand died wholesale: every root is servable by the
+            # sequential oracle (same degraded-health contract as check)
+            self._device_failure()
+            t_fb = time.perf_counter()
+            for i in set_idx:
+                deadline.check("oracle fallback")
+                self.fallbacks += 1
+                out[i] = oracle.build_tree(subjects[i], rest_depth)
+            dt = time.perf_counter() - t_fb
+            self._phase("expand_oracle_fallback", dt)
+            self._rpc_fallback_stage("expand", dt)
+            return out
         for name, dt in timings.items():
             self._phase("expand_" + name, dt)
         t_fb = time.perf_counter()
@@ -979,6 +1040,7 @@ class DeviceCheckEngine:
         for k, i in enumerate(set_idx):
             if over[k]:
                 fell = True
+                deadline.check("oracle fallback")
                 self.fallbacks += 1
                 out[i] = oracle.build_tree(subjects[i], rest_depth)
             else:

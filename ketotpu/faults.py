@@ -1,0 +1,188 @@
+"""Fault injection for chaos testing the serving path.
+
+A tiny, always-importable harness: production code calls ``inject(site)``
+or ``should(kind)`` at named sites and pays one attribute read when no
+plan is active.  Tests (and the CI chaos job) activate a plan either via
+``configure(...)`` in-process or via ``KETO_FAULT_*`` environment
+variables — the env path is what reaches ``serve --workers`` subprocesses.
+
+Sites wired into the stack:
+
+* ``device_dispatch`` — raised/stalled inside ``DeviceCheckEngine``'s
+  dispatch, exercising the oracle-fallback + degraded-health path;
+* ``owner_handler``   — latency spike in the owner's unix-socket handler,
+  exercising worker-side deadlines;
+* ``socket_drop``     — (via ``should``) worker-side drop of a pooled
+  owner connection mid-call, exercising discard + backoff reconnect.
+
+Knobs (env var / ``configure`` kwarg):
+
+* ``KETO_FAULT_DEVICE_ERROR_RATE`` / ``device_error_rate`` — probability a
+  device dispatch raises ``FaultInjected``;
+* ``KETO_FAULT_DEVICE_STALL_MS`` / ``device_stall_ms`` — fixed stall added
+  to every device dispatch (wedged-engine simulation);
+* ``KETO_FAULT_SOCKET_DROP_RATE`` / ``socket_drop_rate`` — probability a
+  worker→owner call drops its connection before sending;
+* ``KETO_FAULT_LATENCY_MS`` + ``KETO_FAULT_LATENCY_RATE`` /
+  ``latency_ms``, ``latency_rate`` — latency spike (rate defaults to 1.0
+  when a spike is configured);
+* ``KETO_FAULT_SEED`` / ``seed`` — deterministic RNG seed.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+
+class FaultInjected(RuntimeError):
+    """An error deliberately raised by the fault plan (not a KetoAPIError:
+    the stack must treat it exactly like a real infrastructure failure)."""
+
+
+class FaultPlan:
+    def __init__(
+        self,
+        *,
+        device_error_rate: float = 0.0,
+        device_stall_ms: float = 0.0,
+        socket_drop_rate: float = 0.0,
+        latency_ms: float = 0.0,
+        latency_rate: Optional[float] = None,
+        seed: Optional[int] = None,
+    ):
+        self.device_error_rate = float(device_error_rate)
+        self.device_stall_ms = float(device_stall_ms)
+        self.socket_drop_rate = float(socket_drop_rate)
+        self.latency_ms = float(latency_ms)
+        if latency_rate is None:
+            latency_rate = 1.0 if latency_ms > 0 else 0.0
+        self.latency_rate = float(latency_rate)
+        import random
+
+        self._rng = random.Random(seed)
+        self._rng_lock = threading.Lock()
+        self.injected: Dict[str, int] = {}
+        self._count_lock = threading.Lock()
+
+    @property
+    def active(self) -> bool:
+        return bool(
+            self.device_error_rate
+            or self.device_stall_ms
+            or self.socket_drop_rate
+            or (self.latency_ms and self.latency_rate)
+        )
+
+    def _roll(self, rate: float) -> bool:
+        if rate <= 0.0:
+            return False
+        if rate >= 1.0:
+            return True
+        with self._rng_lock:
+            return self._rng.random() < rate
+
+    def _count(self, key: str) -> None:
+        with self._count_lock:
+            self.injected[key] = self.injected.get(key, 0) + 1
+
+    @classmethod
+    def from_env(cls, environ=None) -> "FaultPlan":
+        env = os.environ if environ is None else environ
+
+        def f(name: str, default: float = 0.0) -> float:
+            raw = env.get(name, "")
+            try:
+                return float(raw) if raw else default
+            except ValueError:
+                return default
+
+        seed_raw = env.get("KETO_FAULT_SEED", "")
+        rate_raw = env.get("KETO_FAULT_LATENCY_RATE", "")
+        return cls(
+            device_error_rate=f("KETO_FAULT_DEVICE_ERROR_RATE"),
+            device_stall_ms=f("KETO_FAULT_DEVICE_STALL_MS"),
+            socket_drop_rate=f("KETO_FAULT_SOCKET_DROP_RATE"),
+            latency_ms=f("KETO_FAULT_LATENCY_MS"),
+            latency_rate=float(rate_raw) if rate_raw else None,
+            seed=int(seed_raw) if seed_raw else None,
+        )
+
+
+_plan = FaultPlan.from_env()
+
+
+def plan() -> FaultPlan:
+    return _plan
+
+
+def configure(**kwargs) -> FaultPlan:
+    """Install a new fault plan in-process (tests). Returns it."""
+    global _plan
+    _plan = FaultPlan(**kwargs)
+    return _plan
+
+
+def reset() -> None:
+    """Drop any in-process plan back to the environment-derived one."""
+    global _plan
+    _plan = FaultPlan.from_env()
+
+
+def configure_from_config(cfg) -> None:
+    """Activate a plan from the daemon config's ``faults`` block.
+
+    Environment variables win: if any ``KETO_FAULT_*`` knob is set, the
+    config block is ignored (the env is how the chaos CI job and
+    ``serve --workers`` subprocesses are driven).
+    """
+    env_plan = FaultPlan.from_env()
+    if env_plan.active:
+        return
+    block = cfg.get("faults") if hasattr(cfg, "get") else None
+    if not block:
+        return
+    configure(
+        device_error_rate=block.get("device_error_rate", 0.0),
+        device_stall_ms=block.get("device_stall_ms", 0.0),
+        socket_drop_rate=block.get("socket_drop_rate", 0.0),
+        latency_ms=block.get("latency_ms", 0.0),
+        latency_rate=block.get("latency_rate") or None,
+        seed=block.get("seed") or None,
+    )
+
+
+def inject(site: str) -> None:
+    """Maybe stall / spike / raise at a named site. No-op when inactive."""
+    p = _plan
+    if not p.active:
+        return
+    if site == "device_dispatch":
+        if p.device_stall_ms > 0:
+            p._count("device_stall")
+            time.sleep(p.device_stall_ms / 1000.0)
+        if p.latency_ms and p._roll(p.latency_rate):
+            p._count("latency")
+            time.sleep(p.latency_ms / 1000.0)
+        if p._roll(p.device_error_rate):
+            p._count("device_error")
+            raise FaultInjected(f"injected device error at {site}")
+        return
+    if site == "owner_handler":
+        if p.latency_ms and p._roll(p.latency_rate):
+            p._count("latency")
+            time.sleep(p.latency_ms / 1000.0)
+        return
+
+
+def should(kind: str) -> bool:
+    """Roll for a boolean fault (currently only ``socket_drop``)."""
+    p = _plan
+    if not p.active:
+        return False
+    if kind == "socket_drop" and p._roll(p.socket_drop_rate):
+        p._count("socket_drop")
+        return True
+    return False

@@ -78,6 +78,13 @@ SERVICES: Dict[str, Dict[str, Tuple[Type, Type]]] = {
             health_pb2.HealthCheckRequest,
             health_pb2.HealthCheckResponse,
         ),
+        # server-streaming: yields the current status, then every change
+        # (grpc/health/v1/health.proto Watch)
+        "Watch": (
+            health_pb2.HealthCheckRequest,
+            health_pb2.HealthCheckResponse,
+            "server_stream",
+        ),
     },
 }
 
@@ -87,8 +94,14 @@ def add_servicer_to_server(service_name: str, servicer, server) -> None:
     ``service_name`` on a `grpc.Server` / `grpc.aio.Server`."""
     methods = SERVICES[service_name]
     handlers = {}
-    for method, (req_t, resp_t) in methods.items():
-        handlers[method] = grpc.unary_unary_rpc_method_handler(
+    for method, spec in methods.items():
+        req_t, resp_t = spec[0], spec[1]
+        make = (
+            grpc.unary_stream_rpc_method_handler
+            if "server_stream" in spec[2:]
+            else grpc.unary_unary_rpc_method_handler
+        )
+        handlers[method] = make(
             getattr(servicer, method),
             request_deserializer=req_t.FromString,
             response_serializer=resp_t.SerializeToString,
@@ -99,14 +112,20 @@ def add_servicer_to_server(service_name: str, servicer, server) -> None:
 
 
 class _Stub:
-    """Client stub: one unary-unary callable per RPC method."""
+    """Client stub: one callable per RPC method (unary or server-stream)."""
 
     def __init__(self, channel: grpc.Channel, service_name: str):
-        for method, (req_t, resp_t) in SERVICES[service_name].items():
+        for method, spec in SERVICES[service_name].items():
+            req_t, resp_t = spec[0], spec[1]
+            make = (
+                channel.unary_stream
+                if "server_stream" in spec[2:]
+                else channel.unary_unary
+            )
             setattr(
                 self,
                 method,
-                channel.unary_unary(
+                make(
                     f"/{service_name}/{method}",
                     request_serializer=req_t.SerializeToString,
                     response_deserializer=resp_t.FromString,

@@ -1,0 +1,46 @@
+"""Bounded in-flight admission control with load shedding.
+
+One controller per registry, shared by the REST handler threads and the
+gRPC interceptor of every port: the budget bounds total concurrent
+request handling in this process, which is what protects the coalescer
+backlog and the owner socket pool from unbounded queueing.  When the
+budget is exhausted new work is shed immediately with 429 /
+``RESOURCE_EXHAUSTED`` and a ``Retry-After`` hint — a fast no is the
+whole point; queueing here would just move the hang.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class AdmissionController:
+    """Semaphore-shaped in-flight budget that sheds instead of blocking."""
+
+    def __init__(self, limit: int = 0):
+        self.limit = int(limit)
+        self.inflight = 0
+        self.shed = 0  # observability: requests refused at admission
+        self._lock = threading.Lock()
+
+    @property
+    def enabled(self) -> bool:
+        return self.limit > 0
+
+    def try_acquire(self) -> bool:
+        """Admit one request, or refuse without blocking."""
+        if self.limit <= 0:
+            return True
+        with self._lock:
+            if self.inflight >= self.limit:
+                self.shed += 1
+                return False
+            self.inflight += 1
+            return True
+
+    def release(self) -> None:
+        if self.limit <= 0:
+            return
+        with self._lock:
+            if self.inflight > 0:
+                self.inflight -= 1

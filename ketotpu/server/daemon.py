@@ -60,19 +60,46 @@ _H2_PREFACE = b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n"
 
 
 class HealthServicer:
-    """grpc.health.v1.Health/Check over the registry's readiness checks."""
+    """grpc.health.v1.Health Check + Watch over the registry's checks.
+
+    Readiness values follow a three-state convention: ``"ok"``, a
+    ``"degraded: ..."`` string (still SERVING — the device engine fell
+    back to CPU, or a worker is respawning), or anything else meaning
+    down (NOT_SERVING).  ``status --block`` reads the degraded detail off
+    the REST readiness body; the gRPC surface keeps the reference's
+    binary protocol."""
+
+    #: Watch repolls the registry at this cadence; a status CHANGE is
+    #: streamed immediately at the next tick
+    watch_interval = 0.3
 
     def __init__(self, registry):
         self.r = registry
 
-    def Check(self, request, context):
-        failing = [v for v in self.r.health().values() if v != "ok"]
-        status = (
+    def _status(self):
+        values = self.r.health().values()
+        hard = [
+            v for v in values
+            if v != "ok" and not str(v).startswith("degraded")
+        ]
+        return (
             health_pb2.HealthCheckResponse.NOT_SERVING
-            if failing
+            if hard
             else health_pb2.HealthCheckResponse.SERVING
         )
-        return health_pb2.HealthCheckResponse(status=status)
+
+    def Check(self, request, context):
+        return health_pb2.HealthCheckResponse(status=self._status())
+
+    def Watch(self, request, context):
+        """Server-streaming health: current status now, then every change."""
+        last = None
+        while context.is_active():
+            status = self._status()
+            if status != last:
+                last = status
+                yield health_pb2.HealthCheckResponse(status=status)
+            time.sleep(self.watch_interval)
 
 
 def _pump(src: socket.socket, dst: socket.socket) -> None:
@@ -104,8 +131,12 @@ class _Mux(threading.Thread):
     def __init__(self, host: str, port: int, grpc_addr: Tuple[str, int],
                  rest_addr: Tuple[str, int], logger,
                  ssl_ctx: Optional[ssl.SSLContext] = None,
-                 reuse_port: bool = False):
+                 reuse_port: bool = False,
+                 sniff_timeout: float = 10.0):
         super().__init__(daemon=True)
+        # a client that connects and never speaks is disconnected after
+        # this long — it must not hold a splice thread (limit.sniff_timeout_ms)
+        self.sniff_timeout = sniff_timeout
         # reuse_port: SO_REUSEPORT worker mode (server/workers.py) — the
         # kernel load-balances accepted connections across processes
         # bound to the same public port
@@ -126,12 +157,13 @@ class _Mux(threading.Thread):
             except OSError:
                 break
             threading.Thread(
-                target=self._splice, args=(conn,), daemon=True
+                target=self._splice, args=(conn,),
+                name="keto-mux-splice", daemon=True,
             ).start()
 
     def _splice(self, conn: socket.socket) -> None:
         try:
-            conn.settimeout(10.0)
+            conn.settimeout(self.sniff_timeout)
             if self.ssl_ctx is not None:
                 conn = self.ssl_ctx.wrap_socket(conn, server_side=True)
             # cmux buffers until it can match.  READ (not MSG_PEEK — TLS
@@ -156,9 +188,28 @@ class _Mux(threading.Thread):
             self.logger.debug("mux splice failed: %s", e)
             conn.close()
             return
-        t = threading.Thread(target=_pump, args=(conn, backend), daemon=True)
+        t = threading.Thread(target=_pump, args=(conn, backend),
+                             name="keto-mux-pump", daemon=True)
         t.start()
         _pump(backend, conn)
+        # the backend finished talking; reap the client->backend pump.
+        # A client that never closes its half would park that pump in
+        # recv() forever — and close() from this thread does NOT
+        # interrupt a blocked recv(), so fully shut both sockets down
+        # first (recv returns EOF), then close.
+        t.join(self.sniff_timeout)
+        if t.is_alive():
+            for s in (conn, backend):
+                try:
+                    s.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+            t.join(self.sniff_timeout)
+        for s in (conn, backend):
+            try:
+                s.close()
+            except OSError:
+                pass
 
     def close(self) -> None:
         self._closing.set()
@@ -200,16 +251,22 @@ class Server:
     # -- construction -------------------------------------------------------
 
     def _grpc_backend(self, services: Dict[str, object]) -> Tuple[str, int]:
-        from ketotpu.server.interceptors import AccessLogInterceptor
+        from ketotpu.server.interceptors import (
+            AccessLogInterceptor,
+            AdmissionInterceptor,
+        )
 
         server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=16),
             options=[("grpc.so_reuseport", 0)],
             # access-log/metrics interceptor first so its duration covers
             # the embedder-supplied chain (ketoctx
-            # WithGRPCUnaryInterceptors, daemon.go:450-486)
+            # WithGRPCUnaryInterceptors, daemon.go:450-486); admission runs
+            # inside it so shed RPCs still show in the access log, and it
+            # binds the RPC deadline budget around everything downstream
             interceptors=(
                 AccessLogInterceptor(self.registry),
+                AdmissionInterceptor(self.registry),
                 *self.registry.options.grpc_interceptors,
             ),
         )
@@ -286,8 +343,12 @@ class Server:
             grpc_addr = self._grpc_backend(services)
             rest_addr = self._rest_backend(router)
             ctx = self._ssl_context(name)
+            sniff_s = float(
+                r.config.get("limit.sniff_timeout_ms", 10000)
+            ) / 1000.0
             mux = _Mux(host, port, grpc_addr, rest_addr, self.logger,
-                       ssl_ctx=ctx, reuse_port=self.reuse_port)
+                       ssl_ctx=ctx, reuse_port=self.reuse_port,
+                       sniff_timeout=sniff_s)
             mux.start()
             self._muxes.append(mux)
             self.addresses[name] = mux.addr
@@ -330,6 +391,11 @@ class Server:
             self.sqa.close()
         for mux in self._muxes:
             mux.close()
+        # retire the coalescer BEFORE the gRPC backends drain: its wave
+        # worker thread and any queued slots must not outlive the daemon
+        # (a closed coalescer answers stragglers directly on the inner
+        # engine, so in-grace RPCs still complete)
+        self.registry.close_engines()
         for s in self._grpc_servers:
             s.stop(grace)
         for httpd in self._http_servers:

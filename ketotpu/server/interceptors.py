@@ -20,6 +20,65 @@ import time
 
 import grpc
 
+from ketotpu import deadline, flightrec
+
+
+class AdmissionInterceptor(grpc.ServerInterceptor):
+    """In-flight admission + deadline binding for unary methods.
+
+    Before the handler runs this interceptor (a) tries to acquire one
+    slot from the registry's shared :class:`AdmissionController`, shedding
+    with ``RESOURCE_EXHAUSTED`` when the port is saturated, and (b) binds
+    the RPC's ``context.time_remaining()`` as the thread's deadline budget
+    so every blocking hop downstream (coalescer slot wait, owner socket,
+    oracle fallback) is bounded by what the client granted.  Health RPCs
+    are exempt — an overloaded server must still answer probes.
+    """
+
+    def __init__(self, registry):
+        self.registry = registry
+
+    def intercept_service(self, continuation, handler_call_details):
+        handler = continuation(handler_call_details)
+        if handler is None or handler.unary_unary is None:
+            return handler  # streaming/unknown: pass through untouched
+        method = handler_call_details.method
+        if method.startswith("/grpc.health."):
+            return handler
+        registry = self.registry
+        inner = handler.unary_unary
+        op = method.rsplit("/", 1)[-1].lower()
+
+        def wrapped(request, context):
+            ctl = registry.admission()
+            if not ctl.try_acquire():
+                m = registry.metrics()
+                m.counter(
+                    "keto_requests_shed_total", 1.0,
+                    help="requests refused by admission control",
+                    transport="grpc",
+                )
+                m.observe(
+                    flightrec.STAGE_METRIC, 0.0,
+                    help="per-RPC stage wall time decomposition",
+                    op=op, stage="shed",
+                )
+                context.abort(
+                    grpc.StatusCode.RESOURCE_EXHAUSTED,
+                    f"in-flight limit reached ({ctl.limit}); retry later",
+                )
+            try:
+                with deadline.scope(context.time_remaining()):
+                    return inner(request, context)
+            finally:
+                ctl.release()
+
+        return grpc.unary_unary_rpc_method_handler(
+            wrapped,
+            request_deserializer=handler.request_deserializer,
+            response_serializer=handler.response_serializer,
+        )
+
 
 class AccessLogInterceptor(grpc.ServerInterceptor):
     """Per-RPC access log + duration histogram for unary methods."""

@@ -30,7 +30,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlencode, urlparse
 
-from ketotpu import flightrec
+from ketotpu import deadline, flightrec
 from ketotpu.api.types import (
     BadRequestError,
     KetoAPIError,
@@ -45,7 +45,16 @@ _STATUS_TEXT = {
     403: "Forbidden",
     404: "Not Found",
     405: "Method Not Allowed",
+    429: "Too Many Requests",
     500: "Internal Server Error",
+    503: "Service Unavailable",
+    504: "Gateway Timeout",
+}
+
+# requests that must work even when admission control is shedding: probes
+# and scrapes are how operators see the overload
+_ADMISSION_EXEMPT = {
+    "/health/alive", "/health/ready", "/version", "/metrics/prometheus",
 }
 
 # REST paths that get the full stage decomposition (flightrec context);
@@ -162,9 +171,14 @@ class Router:
     def _ready(self, req) -> Tuple[int, object]:
         health = self.r.health()
         errors = {k: v for k, v in health.items() if v != "ok"}
-        if errors:
-            return 503, {"errors": errors}
-        return 200, {"status": "ok"}
+        if not errors:
+            return 200, {"status": "ok"}
+        # degraded-only (device engine on CPU fallback, worker respawning):
+        # still ready — answering traffic is the point of degrading — but
+        # surfaced so `status --block` can tell degraded from down
+        if all(str(v).startswith("degraded") for v in errors.values()):
+            return 200, {"status": "degraded", "degraded": errors}
+        return 503, {"errors": errors}
 
     def _version(self, req) -> Tuple[int, object]:
         return 200, {"version": self.r.version}
@@ -189,7 +203,10 @@ class Router:
             return chain()
         except KetoAPIError as e:
             code = e.status_code or 500
-            return code, _error_body(code, str(e)), {}
+            # shed responses carry the backoff hint the reference's
+            # rate-limit middlewares send
+            headers = {"Retry-After": "1"} if code == 429 else {}
+            return code, _error_body(code, str(e)), headers
         except Exception as e:  # noqa: BLE001 - the panic-recovery interceptor
             self.r.logger().exception("handler panic: %s", e)
             return 500, _error_body(500, str(e)), {}
@@ -458,9 +475,52 @@ def make_http_server(router: Router, host: str, port: int,
             ) if op else nullcontext()
             with rec:
                 flightrec.note_stage("parse", t_parse - t0)
-                status, payload, extra = router.dispatch(
-                    method, parsed.path, Request(query, body, hdrs)
+                ctl = (
+                    registry.admission()
+                    if parsed.path not in _ADMISSION_EXEMPT else None
                 )
+                if ctl is not None and not ctl.try_acquire():
+                    registry.metrics().counter(
+                        "keto_requests_shed_total", 1.0,
+                        help="requests refused by admission control",
+                        transport="rest",
+                    )
+                    registry.metrics().observe(
+                        flightrec.STAGE_METRIC, 0.0,
+                        help="per-RPC stage wall time decomposition",
+                        op=op or "http", stage="shed",
+                    )
+                    status, payload, extra = (
+                        429,
+                        _error_body(
+                            429,
+                            f"in-flight limit reached ({ctl.limit}); "
+                            "retry later",
+                        ),
+                        {"Retry-After": "1"},
+                    )
+                else:
+                    try:
+                        try:
+                            # per-request budget: the X-Request-Timeout
+                            # header bounds every blocking hop downstream
+                            budget = deadline.parse_timeout(
+                                hdrs.get("x-request-timeout")
+                            )
+                        except KetoAPIError as e:
+                            code = e.status_code or 500
+                            status, payload, extra = (
+                                code, _error_body(code, str(e)), {}
+                            )
+                        else:
+                            with deadline.scope(budget):
+                                status, payload, extra = router.dispatch(
+                                    method, parsed.path,
+                                    Request(query, body, hdrs),
+                                )
+                    finally:
+                        if ctl is not None:
+                            ctl.release()
                 flightrec.note_stage(
                     "compute", time.perf_counter() - t_parse
                 )

@@ -30,14 +30,17 @@ from __future__ import annotations
 
 import json
 import os
+import random
 import socket
 import socketserver
+import subprocess
 import threading
 import time
-from typing import List, Optional, Sequence
+from typing import Callable, List, Optional, Sequence
 
-from ketotpu import flightrec
+from ketotpu import deadline, faults, flightrec
 from ketotpu.api.types import (
+    DeadlineExceededError,
     KetoAPIError,
     RelationTuple,
     Subject,
@@ -60,9 +63,11 @@ def _decode_subject(u: str) -> Subject:
 class EngineHostServer:
     """The device owner's unix-socket engine service."""
 
-    def __init__(self, registry, path: str):
+    def __init__(self, registry, path: str,
+                 health_fn: Optional[Callable[[], dict]] = None):
         self.registry = registry
         self.path = path
+        self.health_fn = health_fn
         if os.path.exists(path):
             os.unlink(path)
 
@@ -72,6 +77,7 @@ class EngineHostServer:
             def handle(self):
                 for line in self.rfile:
                     try:
+                        faults.inject("owner_handler")
                         req = json.loads(line)
                         resp = host._serve_one(req)
                     except Exception as e:  # noqa: BLE001
@@ -96,12 +102,36 @@ class EngineHostServer:
         self._thread.start()
         return self
 
+    def is_alive(self) -> bool:
+        return self._thread.is_alive()
+
+    def restart(self) -> "EngineHostServer":
+        """Replace a dead host with a fresh one on the same socket path.
+
+        The supervisor calls this when the serving thread died; pooled
+        worker connections to the old socket fail and reconnect through
+        their backoff path."""
+        try:
+            self._srv.server_close()
+        except OSError:
+            pass
+        fresh = EngineHostServer(self.registry, self.path, self.health_fn)
+        return fresh.start()
+
     def _serve_one(self, req):
-        r = self.registry
         op = req.get("op")
         # workers forward their RPC's traceparent so the owner-side spans
         # (coalescer wave, device dispatch) stitch into the same trace
         tp = req.pop("traceparent", None)
+        # workers forward the remaining budget; bind it so the coalescer
+        # slot wait and oracle-fallback loop on the owner side stay inside
+        # what the worker's client granted
+        ms = req.pop("deadline_ms", None)
+        with deadline.scope(None if ms is None else ms / 1000.0):
+            return self._serve_op(req, op, tp)
+
+    def _serve_op(self, req, op, tp):
+        r = self.registry
         if op == "check":
             with flightrec.rpc_recording(
                 r, "check", traceparent=tp, detail="worker->owner check"
@@ -138,6 +168,12 @@ class EngineHostServer:
                 return {"tree": tree.to_json() if tree is not None else None}
         if op == "ping":
             return {"pong": True}
+        if op == "health":
+            # owner-side readiness for the workers' health surface: the
+            # worker cannot see the device engine directly, so degraded
+            # state (CPU fallback, respawning workers) flows over the wire
+            fn = self.health_fn
+            return {"health": dict(fn()) if fn is not None else {}}
         raise ValueError(f"unknown op {op!r}")
 
     def stop(self) -> None:
@@ -155,14 +191,38 @@ class _Conn:
         self.sock.connect(path)
         self.rfile = self.sock.makefile("rb")
         self.lock = threading.Lock()
+        self.broken = False
 
-    def call(self, req) -> dict:
-        with self.lock:
-            self.sock.sendall(json.dumps(req).encode() + b"\n")
-            line = self.rfile.readline()
-        if not line:
-            raise ConnectionError("engine host closed the connection")
-        resp = json.loads(line)
+    def close(self) -> None:
+        self.broken = True
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    def call(self, req, timeout: Optional[float] = None) -> dict:
+        """One request/response on this connection.
+
+        Any transport error — timeout, EOF, decode failure — marks the
+        connection broken and closes it: the wire is strictly one
+        response per request, so after a partial exchange the NEXT call
+        on this socket would read THIS request's late response (the
+        desync bug).  Only a decoded typed error keeps the connection —
+        the exchange completed, the stream is still aligned.
+        """
+        if self.broken:
+            raise ConnectionError("connection already discarded")
+        try:
+            with self.lock:
+                self.sock.settimeout(timeout)
+                self.sock.sendall(json.dumps(req).encode() + b"\n")
+                line = self.rfile.readline()
+            if not line:
+                raise ConnectionError("engine host closed the connection")
+            resp = json.loads(line)
+        except Exception:
+            self.close()
+            raise
         if "error" in resp:
             err = KetoAPIError(resp["error"]["msg"])
             err.status_code = resp["error"].get("status", 500)
@@ -176,30 +236,94 @@ class RemoteCheckEngine:
     A tiny per-thread connection pool: each serving thread keeps its own
     connection (requests on one connection are serialized), so worker
     concurrency maps 1:1 onto owner-side handler threads — which is
-    exactly what feeds the owner's coalescer bigger waves."""
+    exactly what feeds the owner's coalescer bigger waves.
 
-    def __init__(self, path: str):
+    Connection errors retry on a fresh connection with capped exponential
+    backoff + jitter (the owner may be mid-respawn); a TIMEOUT does not
+    retry — the budget is spent and the caller gets DEADLINE_EXCEEDED."""
+
+    #: reconnect schedule: base*2^n jittered, capped — tuned so a worker
+    #: rides out an owner respawn without stampeding the fresh socket
+    retry_attempts = 5
+    backoff_base = 0.025
+    backoff_cap = 0.25
+
+    def __init__(self, path: str, *, rpc_timeout: float = 30.0):
         self.path = path
+        # budget for calls with no request deadline: a wedged owner must
+        # surface as an error, not hang every worker thread (<=0 disables)
+        self.rpc_timeout = rpc_timeout
+        self.reconnects = 0  # observability: retried transport failures
         self._local = threading.local()
 
     def _conn(self) -> _Conn:
         c = getattr(self._local, "conn", None)
-        if c is None:
+        if c is None or c.broken:
             c = self._local.conn = _Conn(self.path)
         return c
+
+    def _discard(self) -> None:
+        c = getattr(self._local, "conn", None)
+        if c is not None:
+            c.close()
+        self._local.conn = None
 
     def _call(self, req) -> dict:
         tp = flightrec.current_traceparent()
         if tp:
             req = dict(req, traceparent=tp)
+        budget = deadline.remaining()
+        if budget is not None:
+            if budget <= 0:
+                raise DeadlineExceededError(
+                    "deadline exceeded before owner RPC"
+                )
+            # forward the remaining budget so the owner bounds ITS waits
+            req = dict(req, deadline_ms=deadline.deadline_ms())
+        timeout = budget
+        if timeout is None and self.rpc_timeout > 0:
+            timeout = self.rpc_timeout
         t0 = time.perf_counter()
         try:
-            try:
-                return self._conn().call(req)
-            except (ConnectionError, OSError):
-                # owner restarted: one reconnect attempt before failing
-                self._local.conn = None
-                return self._conn().call(req)
+            last: Optional[BaseException] = None
+            for attempt in range(self.retry_attempts):
+                try:
+                    if faults.should("socket_drop"):
+                        self._discard()
+                        raise ConnectionError("injected owner-socket drop")
+                    return self._conn().call(req, timeout=timeout)
+                except KetoAPIError:
+                    raise
+                except TimeoutError:
+                    # budget spent waiting on the owner: retrying cannot
+                    # beat the deadline, answer DEADLINE_EXCEEDED now
+                    self._discard()
+                    raise DeadlineExceededError(
+                        f"owner RPC exceeded {timeout:.3f}s"
+                    ) from None
+                except (ConnectionError, OSError, ValueError) as e:
+                    # ValueError covers a JSON decode failure: the stream
+                    # desynced, the connection is already discarded
+                    last = e
+                    self._discard()
+                    if attempt + 1 >= self.retry_attempts:
+                        break
+                    self.reconnects += 1
+                    delay = min(
+                        self.backoff_cap, self.backoff_base * (2 ** attempt)
+                    )
+                    delay *= 0.5 + random.random() * 0.5  # decorrelate
+                    left = deadline.remaining()
+                    if left is not None:
+                        if left <= 0:
+                            raise DeadlineExceededError(
+                                "deadline exceeded during owner reconnect"
+                            ) from e
+                        delay = min(delay, left)
+                    time.sleep(delay)
+            raise ConnectionError(
+                f"owner RPC failed after {self.retry_attempts} attempts: {last}"
+            ) from last
         finally:
             flightrec.note_stage("worker_rpc", time.perf_counter() - t0)
 
@@ -237,3 +361,139 @@ class RemoteExpandEngine:
         if resp["tree"] is None:
             return None
         return Tree.from_json(resp["tree"])
+
+
+def engine_host_readiness(path: str, timeout: float = 1.0):
+    """Readiness-check factory for worker registries: probe the owner.
+
+    Unreachable owner -> raise (the worker cannot serve checks at all);
+    reachable owner with degraded health values -> return the degraded
+    string so the worker's health surface mirrors the owner's.
+    """
+
+    def probe():
+        conn = _Conn(path)
+        try:
+            resp = conn.call({"op": "health"}, timeout=timeout)
+        finally:
+            conn.close()
+        health = resp.get("health", {})
+        bad = {k: v for k, v in health.items() if v != "ok"}
+        if not bad:
+            return "ok"
+        if all(str(v).startswith("degraded") for v in bad.values()):
+            return "degraded: owner " + "; ".join(
+                f"{k}={v}" for k, v in sorted(bad.items())
+            )
+        raise ConnectionError(
+            "owner unhealthy: " + "; ".join(
+                f"{k}={v}" for k, v in sorted(bad.items())
+            )
+        )
+
+    return probe
+
+
+class WorkerSupervisor:
+    """Respawn dead serve processes with capped backoff + jitter.
+
+    ``serve --workers`` hands this every worker subprocess (and polls the
+    owner's engine-host thread itself).  A dead worker is respawned after
+    a jittered backoff that grows with its recent death count; while any
+    respawn is pending the supervisor's ``state()`` reports ``degraded``
+    (surfaced through health + ``status --block``).  A worker that keeps
+    dying — ``max_rapid_deaths`` exits inside ``rapid_window`` seconds —
+    makes the supervisor give up (``poll`` returns an exit code) instead
+    of flapping forever: at that point the failure is systemic, not
+    transient.
+    """
+
+    def __init__(
+        self,
+        spawn: Callable[[int], "subprocess.Popen"],
+        count: int,
+        *,
+        max_rapid_deaths: int = 5,
+        rapid_window: float = 30.0,
+        backoff_base: float = 0.5,
+        backoff_cap: float = 5.0,
+        log: Optional[Callable[[str], None]] = None,
+    ):
+        self._spawn = spawn
+        self.count = count
+        self.max_rapid_deaths = max_rapid_deaths
+        self.rapid_window = rapid_window
+        self.backoff_base = backoff_base
+        self.backoff_cap = backoff_cap
+        self._log = log or (lambda msg: None)
+        self.procs: List[Optional["subprocess.Popen"]] = [None] * count
+        self.respawns = 0  # observability: successful respawn count
+        self._deaths: List[float] = []  # monotonic stamps, pruned to window
+        self._death_counts = [0] * count
+        self._respawn_at: List[Optional[float]] = [None] * count
+
+    def start(self) -> "WorkerSupervisor":
+        for i in range(self.count):
+            self.procs[i] = self._spawn(i)
+        return self
+
+    def _record_death(self, i: int, rc) -> Optional[int]:
+        now = time.monotonic()
+        self._deaths.append(now)
+        self._deaths = [t for t in self._deaths if now - t < self.rapid_window]
+        self._death_counts[i] += 1
+        if len(self._deaths) >= self.max_rapid_deaths:
+            self._log(
+                f"worker {i} exited rc={rc}; {len(self._deaths)} deaths in "
+                f"{self.rapid_window:.0f}s — giving up"
+            )
+            return 1
+        delay = min(
+            self.backoff_cap,
+            self.backoff_base * (2 ** (self._death_counts[i] - 1)),
+        )
+        delay *= 0.5 + random.random() * 0.5
+        self._respawn_at[i] = now + delay
+        self._log(
+            f"worker {i} exited rc={rc}; respawning in {delay:.1f}s"
+        )
+        return None
+
+    def poll(self) -> Optional[int]:
+        """One supervision step. Returns an exit code to give up with,
+        or None to keep serving."""
+        now = time.monotonic()
+        for i, p in enumerate(self.procs):
+            if p is not None and p.poll() is not None:
+                rc = self._record_death(i, p.returncode)
+                if rc is not None:
+                    return rc
+                self.procs[i] = None
+            if self.procs[i] is None and self._respawn_at[i] is not None:
+                if now >= self._respawn_at[i]:
+                    self._respawn_at[i] = None
+                    self.procs[i] = self._spawn(i)
+                    self.respawns += 1
+                    self._log(f"worker {i} respawned")
+        return None
+
+    def state(self) -> str:
+        """Health-check value: 'ok', or 'degraded: ...' while respawning."""
+        down = [
+            i for i, p in enumerate(self.procs)
+            if p is None or p.poll() is not None
+        ]
+        if not down:
+            return "ok"
+        return "degraded: respawning worker(s) " + ",".join(map(str, down))
+
+    def terminate(self) -> None:
+        for p in self.procs:
+            if p is not None and p.poll() is None:
+                p.terminate()
+        for p in self.procs:
+            if p is not None:
+                try:
+                    p.wait(timeout=5)
+                except Exception:  # noqa: BLE001
+                    p.kill()

@@ -30,6 +30,23 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+import importlib.util  # noqa: E402
+
+if importlib.util.find_spec("xdist") is None:
+    # pyproject's addopts carries the xdist flags (-n 4 --dist loadfile);
+    # without the plugin installed pytest rejects them as unrecognized and
+    # NOTHING can run.  Absorb them as no-ops so the suite degrades to a
+    # single serial process (the codegen-split flag above is what actually
+    # keeps that stable).
+    def pytest_addoption(parser):
+        group = parser.getgroup("xdist-fallback")
+        # _addoption, not addoption: lowercase short options are reserved
+        # in the public API (xdist registers -n the same way)
+        group._addoption("-n", "--numprocesses", dest="numprocesses",
+                         default=None, help="ignored (pytest-xdist absent)")
+        group._addoption("--dist", dest="xdist_dist", default="no",
+                         help="ignored (pytest-xdist absent)")
+
 # NOTE on the persistent compilation cache: tempting for this suite's
 # hundreds of slow XLA:CPU compiles, but writing cache entries for the
 # shard_map/all_to_all mesh programs aborts inside XLA's executable

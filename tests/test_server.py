@@ -10,6 +10,9 @@ BASELINE.json.
 
 import json
 import pathlib
+import socket
+import threading
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -579,6 +582,85 @@ def test_check_latest_serves_fresh_state_without_rebuild(server, read_channel):
     )
     assert resp.allowed is True  # the pending write is visible
     assert eng.rebuilds == before  # ...without a full reprojection
+
+
+class TestMuxRobustness:
+    """Misbehaving clients must not hold mux threads (server/daemon.py):
+    a silent client is dropped after the sniff timeout, and a client
+    that never closes its half of a finished exchange must not leak the
+    client->backend pump thread."""
+
+    @staticmethod
+    def _named(name):
+        return [t for t in threading.enumerate() if t.name == name]
+
+    @staticmethod
+    def _settle(count, baseline, deadline_s=10.0):
+        settle_by = time.monotonic() + deadline_s
+        while time.monotonic() < settle_by:
+            if count() <= baseline:
+                return True
+            time.sleep(0.05)
+        return count() <= baseline
+
+    def test_silent_client_released_after_sniff_timeout(self, server):
+        mux = server._muxes[0]
+        old = mux.sniff_timeout
+        mux.sniff_timeout = 0.3
+        conns = []
+        try:
+            def splices():
+                return len(self._named("keto-mux-splice"))
+
+            baseline = splices()
+            # connect and say nothing: each connection parks a splice
+            # thread in the protocol sniff
+            conns = [socket.create_connection(mux.addr) for _ in range(3)]
+            time.sleep(0.1)
+            assert splices() > baseline, "sniff must be holding threads"
+            assert self._settle(splices, baseline), (
+                "silent clients held splice threads past the sniff timeout"
+            )
+            # and the server actually hung up on them
+            conns[0].settimeout(5.0)
+            assert conns[0].recv(16) == b""
+        finally:
+            for c in conns:
+                c.close()
+            mux.sniff_timeout = old
+
+    def test_half_closed_client_does_not_leak_pump_threads(self, server):
+        mux = server._muxes[0]
+        old = mux.sniff_timeout
+        mux.sniff_timeout = 0.5
+        c = None
+        try:
+            def pumps():
+                return len(self._named("keto-mux-pump"))
+
+            baseline = pumps()
+            c = socket.create_connection(mux.addr)
+            c.sendall(
+                b"GET /health/alive HTTP/1.1\r\n"
+                b"Host: localhost\r\nConnection: close\r\n\r\n"
+            )
+            c.settimeout(10.0)
+            data = b""
+            while True:
+                chunk = c.recv(4096)
+                if not chunk:
+                    break
+                data += chunk
+            assert b"200" in data.split(b"\r\n", 1)[0]
+            # the exchange is over but we never close our socket: the
+            # mux must reap its client->backend pump anyway
+            assert self._settle(pumps, baseline), (
+                "half-closed client leaked a _pump thread"
+            )
+        finally:
+            if c is not None:
+                c.close()
+            mux.sniff_timeout = old
 
 
 class TestWorkerMode:
