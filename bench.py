@@ -48,7 +48,18 @@ import numpy as np
 BASELINE_NS_PER_OP = 81_280  # reference benchtest.new.txt:5
 BATCH = 16384
 ROUNDS = 4
-PROBE_TIMEOUT_S = float(os.environ.get("KETO_BENCH_PROBE_TIMEOUT", 300.0))
+# Probe budget: 45s default.  The old 300s default ate the whole bench
+# budget when the tunnel was down (error_ambient_backend: probe timed out
+# after 300s) before the CPU fallback even started; a dead backend nearly
+# always hangs from t=0, so a tight timeout converts the outage into a
+# fast fall-back-to-CPU instead of a silent 5-minute stall.
+# KETO_PROBE_TIMEOUT_S is the documented knob; the legacy
+# KETO_BENCH_PROBE_TIMEOUT spelling is still honored as a fallback.
+PROBE_TIMEOUT_S = float(
+    os.environ.get("KETO_PROBE_TIMEOUT_S")
+    or os.environ.get("KETO_BENCH_PROBE_TIMEOUT")
+    or 45.0
+)
 
 
 def _engine(graph, **kw):
@@ -203,8 +214,8 @@ def main() -> None:
     # not adopted (JAX pins its backend at first init)
     in_process = {
         "link_calibration", "fast_path", "mixed_general", "wave_latency",
-        "expand", "serving", "scale_10m", "scale_10m_mixed",
-        "scale_10m_expand",
+        "expand", "leopard", "serving", "scale_10m", "scale_10m_mixed",
+        "scale_10m_expand", "leopard_10m",
     }
 
     def run(name, fn, *a):
@@ -228,10 +239,12 @@ def main() -> None:
         run("mixed_general", _mixed_general, out, state)
         run("wave_latency", _wave_latency, out, state)
         run("expand", _expand, out, state)
+        run("leopard", _leopard, out, state)
         run("serving", _serving, out, state)
         run("scale_10m", _scale_10m, out, state, baseline)
         run("scale_10m_mixed", _scale_10m_mixed, out, state)
         run("scale_10m_expand", _scale_10m_expand, out, state)
+        run("leopard_10m", _leopard_10m, out, state)
 
     _publish_phases(out, state)
     print(json.dumps(out))
@@ -467,6 +480,100 @@ def _expand_latency(eng, roots, *, samples: int, depth: int = 5):
     p50 = lats[len(lats) // 2]
     p99 = lats[min(len(lats) - 1, int(len(lats) * 0.99))]
     return round(1000 * p50, 2), round(1000 * p99, 2)
+
+
+def _leopard_rates(eng, graph, *, calls: int, seed: int):
+    """(list_objects_per_sec, list_subjects_per_sec) through the engine's
+    Leopard listing surface, randomized over users/groups."""
+    from ketotpu.api.types import SubjectID
+
+    rng = np.random.default_rng(seed)
+    users = [
+        graph.users[int(rng.integers(len(graph.users)))] for _ in range(calls)
+    ]
+    groups = [
+        graph.groups[int(rng.integers(len(graph.groups)))]
+        for _ in range(calls)
+    ]
+    eng.list_objects("Group", "members", SubjectID(users[0]))  # warm
+    t0 = time.perf_counter()
+    for u in users:
+        eng.list_objects("Group", "members", SubjectID(u), page_size=1000)
+    lo_ps = calls / (time.perf_counter() - t0)
+    eng.list_subjects("Group", groups[0], "members")
+    t0 = time.perf_counter()
+    for g in groups:
+        eng.list_subjects("Group", g, "members", page_size=1000)
+    ls_ps = calls / (time.perf_counter() - t0)
+    return round(lo_ps, 1), round(ls_ps, 1)
+
+
+def _leopard_deep(*, depth, n_chains, n_queries, seed):
+    """(p50_batch_ms, oracle_fallback_delta) for deep nested-group checks.
+
+    A dedicated rewrite-free chain graph (utils/synth.build_deep_groups):
+    every check needs ``depth`` containment hops, so on the closure path
+    each one is a single binary search and NO device program is ever
+    compiled — the whole batch is answered pre-dispatch.  n_users stays at
+    the default 64 so the deepest groups sit under leopard's max_width
+    taint threshold (wider groups would route the workload back to the
+    device, which is a different benchmark)."""
+    from ketotpu.engine.tpu import DeviceCheckEngine
+    from ketotpu.utils.synth import build_deep_groups, deep_queries
+
+    deep = build_deep_groups(depth=depth, n_chains=n_chains, seed=seed)
+    deng = DeviceCheckEngine(deep.store, deep.manager, max_depth=depth + 4)
+    deng.snapshot()
+    qs = deep_queries(deep, n_queries, depth=depth, seed=seed + 1)
+    deng.batch_check(qs)  # builds + folds the closure outside the clock
+    fb0 = deng.fallbacks
+    lats = []
+    for _ in range(20):
+        t0 = time.perf_counter()
+        deng.batch_check(qs)
+        lats.append(time.perf_counter() - t0)
+    lats.sort()
+    return round(1000 * lats[len(lats) // 2], 2), deng.fallbacks - fb0
+
+
+def _leopard(out, state) -> None:
+    # Leopard closure index (the reverse-query subsystem): listing-API
+    # rates on the 31k graph plus depth-12 nested-group checks answered
+    # entirely from the closure (zero oracle fallbacks on a clean graph)
+    graph, eng = state["graph"], state["eng"]
+    st = eng.leopard_stats()
+    lo_ps, ls_ps = _leopard_rates(eng, graph, calls=200, seed=21)
+    p50, fbs = _leopard_deep(depth=12, n_chains=8, n_queries=256, seed=31)
+    out.update(
+        closure_build_s=round(float(st.get("build_s", 0.0)), 3),
+        closure_pairs=int(st.get("pairs", 0)),
+        list_objects_per_sec=lo_ps,
+        list_subjects_per_sec=ls_ps,
+        deep_check_p50_ms=p50,
+        deep_check_depth=12,
+        deep_check_batch=256,
+        deep_check_fallbacks=int(fbs),
+    )
+
+
+def _leopard_10m(out, state) -> None:
+    # the 10M-tuple leg: closure build cost + listing rates against the
+    # columnar graph's 1.2M-user membership relation; the deep-check
+    # companion runs on a wider chain set (the 10M graph's group nesting
+    # is depth-2 by construction, so chains are measured on the dedicated
+    # deep shape at larger chain count)
+    big, beng = state["big"], state["beng"]
+    st = beng.leopard_stats()
+    lo_ps, ls_ps = _leopard_rates(beng, big, calls=100, seed=23)
+    p50, fbs = _leopard_deep(depth=12, n_chains=64, n_queries=256, seed=33)
+    out.update(
+        closure_build_s_10m=round(float(st.get("build_s", 0.0)), 3),
+        closure_pairs_10m=int(st.get("pairs", 0)),
+        list_objects_per_sec_10m=lo_ps,
+        list_subjects_per_sec_10m=ls_ps,
+        deep_check_p50_ms_10m=p50,
+        deep_check_fallbacks_10m=int(fbs),
+    )
 
 
 def _serving(out, state) -> None:

@@ -437,6 +437,78 @@ def cmd_rt_get(args) -> int:
     return 0
 
 
+def cmd_list_objects(args) -> int:
+    """`keto-tpu list objects`: reverse query — every object the subject
+    reaches in namespace#relation through the engine's closure index."""
+    from ketotpu.api.proto_codec import subject_to_proto, tuple_from_proto
+    from ketotpu.proto import read_service_pb2 as rs
+    from ketotpu.proto import relation_tuples_pb2 as rts
+    from ketotpu.proto.services import ReadServiceStub
+
+    try:
+        subject = _parse_subject(args.subject)
+    except KetoAPIError as e:
+        print(f"Could not parse subject {args.subject!r}: {e}", file=sys.stderr)
+        return 1
+    query = rts.RelationQuery(
+        namespace=args.namespace, relation=args.relation
+    )
+    query.subject.CopyFrom(subject_to_proto(subject))
+    with _channel(args.read_remote, args) as ch:
+        resp = ReadServiceStub(ch).ListObjects(
+            rs.ListRelationTuplesRequest(
+                relation_query=query,
+                page_size=args.page_size,
+                page_token=args.page_token,
+            )
+        )
+    objects = [tuple_from_proto(t).object for t in resp.relation_tuples]
+    if args.format == "json":
+        print(json.dumps({
+            "objects": objects,
+            "next_page_token": resp.next_page_token,
+        }, indent=2))
+    else:
+        for o in objects:
+            print(o)
+        if resp.next_page_token:
+            print(f"\nnext page token: {resp.next_page_token}")
+    return 0
+
+
+def cmd_list_subjects(args) -> int:
+    """`keto-tpu list subjects`: every subject reaching
+    namespace:object#relation (the closure node's element set)."""
+    from ketotpu.api.proto_codec import tuple_from_proto
+    from ketotpu.proto import read_service_pb2 as rs
+    from ketotpu.proto import relation_tuples_pb2 as rts
+    from ketotpu.proto.services import ReadServiceStub
+
+    query = rts.RelationQuery(
+        namespace=args.namespace, object=args.object, relation=args.relation
+    )
+    with _channel(args.read_remote, args) as ch:
+        resp = ReadServiceStub(ch).ListSubjects(
+            rs.ListRelationTuplesRequest(
+                relation_query=query,
+                page_size=args.page_size,
+                page_token=args.page_token,
+            )
+        )
+    subjects = [str(tuple_from_proto(t).subject) for t in resp.relation_tuples]
+    if args.format == "json":
+        print(json.dumps({
+            "subjects": subjects,
+            "next_page_token": resp.next_page_token,
+        }, indent=2))
+    else:
+        for s in subjects:
+            print(s)
+        if resp.next_page_token:
+            print(f"\nnext page token: {resp.next_page_token}")
+    return 0
+
+
 def cmd_rt_delete_all(args) -> int:
     from ketotpu.proto import write_service_pb2 as ws
     from ketotpu.proto.services import WriteServiceStub
@@ -739,6 +811,39 @@ def build_parser() -> argparse.ArgumentParser:
     rt_del_all.add_argument("--force", action="store_true")
     _add_client_flags(rt_del_all, write=True)
     rt_del_all.set_defaults(fn=cmd_rt_delete_all)
+
+    lst = sub.add_parser(
+        "list", help="reverse queries over the closure index"
+    )
+    lstsub = lst.add_subparsers(dest="list_command", required=True)
+
+    lst_obj = lstsub.add_parser(
+        "objects", help="objects a subject reaches in namespace#relation"
+    )
+    lst_obj.add_argument("namespace")
+    lst_obj.add_argument("relation")
+    lst_obj.add_argument("subject")
+    lst_obj.add_argument("--page-size", type=int, default=100)
+    lst_obj.add_argument("--page-token", default="")
+    lst_obj.add_argument(
+        "--format", choices=("table", "json"), default="table"
+    )
+    _add_client_flags(lst_obj)
+    lst_obj.set_defaults(fn=cmd_list_objects)
+
+    lst_sub = lstsub.add_parser(
+        "subjects", help="subjects reaching namespace:object#relation"
+    )
+    lst_sub.add_argument("namespace")
+    lst_sub.add_argument("object")
+    lst_sub.add_argument("relation")
+    lst_sub.add_argument("--page-size", type=int, default=100)
+    lst_sub.add_argument("--page-token", default="")
+    lst_sub.add_argument(
+        "--format", choices=("table", "json"), default="table"
+    )
+    _add_client_flags(lst_sub)
+    lst_sub.set_defaults(fn=cmd_list_subjects)
 
     ns = sub.add_parser("namespace", help="namespace commands")
     nssub = ns.add_subparsers(dest="ns_command", required=True)

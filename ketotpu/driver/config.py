@@ -121,6 +121,18 @@ def _defaults() -> Dict[str, Any]:
             # rebuild refreshes it (engine/checkpoint.py)
             "checkpoint": "",
         },
+        # Leopard closure index (ketotpu/leopard/): the transitive-closure
+        # pair index behind ListObjects/ListSubjects and closure-first
+        # checks.  max_pairs caps index memory (a graph whose closure
+        # exceeds it serves without the index); the rebuild thresholds
+        # bound how much incremental delta accumulates before the index
+        # is rebuilt from the column mirror.
+        "leopard": {
+            "enabled": True,
+            "max_pairs": 4_000_000,
+            "rebuild_delta_pairs": 4096,
+            "rebuild_dirty_sets": 512,
+        },
         # request_log: per-request access lines (REST middleware + gRPC
         # interceptor) at INFO; benches disable it to keep stderr quiet
         "log": {"level": "info", "format": "text", "request_log": True},
@@ -212,7 +224,8 @@ class Provider:
                           "max_inflight", "request_timeout_ms",
                           "sniff_timeout_ms", "device_error_rate",
                           "device_stall_ms", "socket_drop_rate",
-                          "latency_ms", "latency_rate"):
+                          "latency_ms", "latency_rate", "max_pairs",
+                          "rebuild_delta_pairs", "rebuild_dirty_sets"):
                 suffix = known.split("_")
                 if len(joined) > len(suffix) and joined[-len(suffix):] == suffix:
                     joined = joined[: -len(suffix)] + [known]
@@ -402,3 +415,15 @@ class Provider:
             val = self.get(key)
             if not isinstance(val, int) or val < 1:
                 raise ConfigError(key, f"must be a positive integer, got {val!r}")
+        if not isinstance(self.get("leopard.enabled", True), bool):
+            raise ConfigError(
+                "leopard.enabled",
+                f"must be a boolean, got {self.get('leopard.enabled')!r}",
+            )
+        for key in ("leopard.max_pairs", "leopard.rebuild_delta_pairs",
+                    "leopard.rebuild_dirty_sets"):
+            val = self.get(key)
+            if not isinstance(val, int) or val < 1:
+                raise ConfigError(
+                    key, f"must be a positive integer, got {val!r}"
+                )

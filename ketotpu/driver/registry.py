@@ -77,6 +77,7 @@ class Registry:
         self._namespace_manager = namespace_manager
         self._check_engine = None
         self._expand_engine = None
+        self._list_engine = None
         self._oracle_engine = None
         self._flight_recorder = None
         self._admission = None
@@ -336,6 +337,26 @@ class Registry:
                         max_batch=int(self.config.get("engine.max_batch")),
                         retry_scale=int(self.config.get("engine.retry_scale")),
                         metrics=self.metrics(),
+                        leopard={
+                            "enabled": bool(
+                                self.config.get("leopard.enabled", True)
+                            ),
+                            "max_pairs": int(
+                                self.config.get(
+                                    "leopard.max_pairs", 4_000_000
+                                )
+                            ),
+                            "rebuild_delta_pairs": int(
+                                self.config.get(
+                                    "leopard.rebuild_delta_pairs", 4096
+                                )
+                            ),
+                            "rebuild_dirty_sets": int(
+                                self.config.get(
+                                    "leopard.rebuild_dirty_sets", 512
+                                )
+                            ),
+                        },
                     )
                     n_mesh = int(self.config.get("engine.mesh_devices") or 0)
                     if n_mesh > 0:
@@ -433,6 +454,36 @@ class Registry:
                         self.store(), max_depth=self.config.max_read_depth()
                     )
             return self._expand_engine
+
+    def list_engine(self):
+        """Listing-engine seam for the Leopard reverse-query APIs
+        (ListObjects / ListSubjects): the device engine answers from its
+        closure index (host-oracle fallback inside), worker processes
+        relay to the device owner, and the oracle kind enumerates the
+        live store directly."""
+        with self._lock:
+            if self._list_engine is None:
+                if self.config.get("engine.kind") == "remote":
+                    from ketotpu.server.workers import (
+                        RemoteCheckEngine,
+                        RemoteListEngine,
+                    )
+
+                    check = self.check_engine()
+                    self._list_engine = RemoteListEngine(
+                        str(self.config.get("engine.socket")),
+                        check if isinstance(check, RemoteCheckEngine)
+                        else None,
+                    )
+                    return self._list_engine
+                dev = self._device_engine()
+                if dev is not None:
+                    self._list_engine = dev
+                else:
+                    from ketotpu.leopard import HostListEngine
+
+                    self._list_engine = HostListEngine(self.store())
+            return self._list_engine
 
     # -- mapping ------------------------------------------------------------
 
@@ -545,6 +596,26 @@ class Registry:
                 m.gauge("keto_engine_occupancy", float(val),
                         help="EMA per-level frontier occupancy",
                         path=path, level=str(lvl))
+        # Leopard closure-index gauges (ketotpu/leopard/): index size,
+        # delete-dirtied sets, and how often a check or listing had to be
+        # answered by the host oracle instead of the index
+        leo_fn = getattr(eng, "leopard_stats", None)
+        if leo_fn is not None:
+            ls = leo_fn()
+            m.gauge("keto_leopard_pairs", ls["pairs"],
+                    help="closure (set, element) pairs resident "
+                         "(base + delta)")
+            m.gauge("keto_leopard_dirty_sets", ls["dirty_sets"],
+                    help="closure set ids dirtied by deletions")
+            m.gauge("keto_leopard_fallbacks_total",
+                    ls["fallbacks"] + ls["list_fallbacks"],
+                    help="index declines answered by the host oracle")
+            m.gauge("keto_leopard_answered", ls["answered"],
+                    help="checks answered from the closure index")
+            m.gauge("keto_leopard_builds", ls["builds"],
+                    help="closure index full builds")
+            m.gauge("keto_leopard_build_seconds", ls["build_s"],
+                    help="last closure build wall time")
         if eng._gen_fast_ema is not None:
             m.gauge("keto_engine_occupancy", float(eng._gen_fast_ema),
                     help="EMA per-level frontier occupancy",

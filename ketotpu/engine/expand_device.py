@@ -144,7 +144,14 @@ def expand_schedule(n_roots: int, fanout: int, max_depth: int,
 
 
 class _Decoder:
-    """Reverse vocab: dense ids back to API strings/subjects."""
+    """Reverse vocab: dense ids back to API strings/subjects.
+
+    The uid-decode convention ("id:"/"set:" prefixes from
+    ``Subject.unique_id``) is shared with the Leopard listing path —
+    ``leopard.hostlist.subject_from_uid`` decodes the same strings when
+    ``ListSubjects`` enumerates a closure node's element set, so a subject
+    round-trips identically whether it surfaces through an expand tree or
+    a listing page."""
 
     def __init__(self, vocab: Vocab):
         self.ns = vocab.namespaces.strings()
@@ -167,6 +174,11 @@ class _Decoder:
         if uid.startswith("set:"):
             return SubjectSet.from_string(uid[4:])
         return SubjectID(uid[3:] if uid.startswith("id:") else uid)
+
+
+# public alias: the leopard/ listing surfaces and tests reuse the reverse
+# vocab decoder without reaching for a private name
+Decoder = _Decoder
 
 
 class OverlayMembers:

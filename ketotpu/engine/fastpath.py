@@ -740,7 +740,16 @@ def run_fast_packed(
     (device) uint8 verdict array and the int32[levels] occupancy vector —
     the caller fetches them with np.asarray when it syncs.  ``timer`` (if
     given) receives the dispatch's host wall seconds — trace/compile on a
-    fresh shape, async enqueue after."""
+    fresh shape, async enqueue after.
+
+    Row 5 of ``qpack`` is the active mask, and callers may clear bits for
+    queries answered before dispatch — the engine's Leopard closure index
+    (ketotpu/leopard/) intercepts deep-nesting checks this way, so a
+    depth-12 membership chain costs one sorted-pair binary search instead
+    of twelve BFS levels here.  An inactive query never enters the
+    frontier: its verdict byte and over/dirty bits come back zero, which
+    the collector relies on (a closure-answered query must not be claimed
+    by the overflow-retry or oracle-fallback paths)."""
     Q = qpack.shape[1]
     if Q > frontier:
         raise ValueError(f"batch {Q} exceeds frontier capacity {frontier}")

@@ -74,6 +74,9 @@ from ketotpu.engine.oracle import (
 )
 from ketotpu.engine.snapshot import Snapshot
 from ketotpu.engine.vocab import Vocab
+from ketotpu.leopard import closure as leo
+from ketotpu.leopard import device as leodev
+from ketotpu.leopard import hostlist as leolist
 from ketotpu.storage.memory import InMemoryTupleStore
 from ketotpu.storage.namespaces import NamespaceManager
 
@@ -153,6 +156,7 @@ class DeviceCheckEngine:
         gen_levels: int = 12,
         gen_levels_max: int = 24,
         metrics=None,
+        leopard: Optional[dict] = None,
     ):
         self.store = store
         self.namespace_manager = namespace_manager
@@ -238,6 +242,24 @@ class DeviceCheckEngine:
         # keto_engine_phase_seconds when a Metrics registry is attached
         self.phase_seconds: dict = {}
         self.phase_counts: dict = {}
+        # Leopard closure index (ketotpu/leopard/): rebuilt with the
+        # snapshot, folded incrementally from the same changelog as the
+        # overlay; None while disabled or stale (everything then serves
+        # through the normal paths)
+        lcfg = dict(leopard or {})
+        self.leopard_enabled = bool(lcfg.get("enabled", True))
+        self._leopard_cfg = {
+            "max_pairs": int(lcfg.get("max_pairs", 4_000_000)),
+            "rebuild_delta_pairs": int(
+                lcfg.get("rebuild_delta_pairs", 4096)
+            ),
+            "rebuild_dirty_sets": int(lcfg.get("rebuild_dirty_sets", 512)),
+        }
+        self._leopard: Optional[leo.ClosureIndex] = None
+        self._leo_device = None
+        self.leopard_answered = 0  # checks answered from the index
+        self.leopard_hits = 0  # of those, answered allowed
+        self.leopard_list_fallbacks = 0  # listings served by the host oracle
 
     def _phase(self, name: str, dt: float) -> None:
         self.phase_seconds[name] = self.phase_seconds.get(name, 0.0) + dt
@@ -345,6 +367,7 @@ class DeviceCheckEngine:
         self.projection_upload_s = time.perf_counter() - t0
         self.rebuilds += 1
         self._gen_sched_cache.clear()  # new graph, re-adapt once
+        self._install_leopard()
         if self.checkpoint_path:
             from ketotpu.engine import checkpoint as ckpt
 
@@ -355,6 +378,38 @@ class DeviceCheckEngine:
                 )
             except OSError:
                 self.checkpoint_errors += 1
+
+    def _install_leopard(self) -> None:
+        """(Re)build the closure index from the column mirror and ship
+        the pair array to HBM.  Failures disable the index (None) — the
+        engine keeps serving through the normal paths — never raise."""
+        self._leopard = None
+        self._leo_device = None
+        if not self.leopard_enabled or self._cols is None:
+            return
+        try:
+            idx = leo.ClosureIndex(
+                max_width=self.max_width, **self._leopard_cfg
+            )
+            idx.build_from_cols(self._cols, self.namespace_manager)
+            idx.bind_vocab(self._vocab)
+        except leo.ClosureTooLarge:
+            return
+        self._leopard = idx
+        self._leo_device = leodev.ship_pairs(idx)
+        self._phase("leopard_build", idx.build_s)
+
+    def _leopard_fold(self, changes) -> None:
+        """Incremental maintenance from the changelog slice already folded
+        into the column mirror: additions append closure pairs, deletions
+        mark affected set ids dirty.  When the delta cannot represent the
+        change (unknown node, thresholds) the index rebuilds vectorized
+        from the columns — same two-tier shape as the overlay."""
+        if self._leopard is None:
+            return
+        if self._leopard.apply_changes(changes):
+            return
+        self._install_leopard()
 
     def _install_device_arrays(self) -> None:
         """Ship the projection to the device.  Base arrays transfer once
@@ -414,6 +469,7 @@ class DeviceCheckEngine:
                 return self._snap
             self._overlay_active = True
             self.overlay_applies += 1
+            self._leopard_fold(changes)
         return self._snap
 
     def _overlay_apply(self, changes) -> bool:
@@ -506,6 +562,10 @@ class DeviceCheckEngine:
             self._log_cursor = log_head
             self._overlay = dl.OverlayState()
             self._overlay_active = False
+            # no column mirror to build the closure from: the index stays
+            # off (listings host-oracle) until the next full rebuild
+            self._leopard = None
+            self._leo_device = None
             self._install_device_arrays()
             return True
 
@@ -655,6 +715,43 @@ class DeviceCheckEngine:
             for a, f in zip(arrays, fills)
         )
 
+    def _leopard_answers(self, enc, err, general):
+        """(allowed, answered) bool arrays from the closure index, or None
+        while the index is off.  Runs under the sync lock so verdicts are
+        exact against the latest folded write (same contract as overlay
+        probes); the probe itself is one binary search over the sorted
+        pairs — on-device for large chunks, host numpy otherwise."""
+        if self._leopard is None or self.strict_mode:
+            return None
+        q_ns, q_obj, q_rel, q_subj, q_depth = enc
+        n = len(q_ns)
+        if n == 0:
+            return None
+        with self._sync_lock:
+            idx = self._leopard
+            if idx is None:
+                return None
+            nodes, node_hi = idx.node_ids_np(q_ns, q_obj, q_rel)
+            probed = None
+            if self._leo_device is not None and n >= leodev.DEVICE_PROBE_MIN:
+                keys = np.where(
+                    (nodes >= 0) & (q_subj >= 0),
+                    (nodes.astype(np.int64) << 32)
+                    | q_subj.astype(np.int64),
+                    np.int64(-1),
+                )
+                probed = leodev.probe_pairs(
+                    self._leo_device, keys, _bucket(n)
+                )
+            allowed, answered = idx.answer_checks(
+                nodes, q_subj, node_hi, int(q_depth[0]), probed=probed
+            )
+        answered &= ~(err | general)
+        allowed &= answered
+        self.leopard_answered += int(answered.sum())
+        self.leopard_hits += int(allowed.sum())
+        return allowed, answered
+
     def _dispatch(self, queries: Sequence[RelationTuple], rest_depth: int):
         """Enqueue one chunk's device work; returns an uncollected handle."""
         n = len(queries)
@@ -666,28 +763,40 @@ class DeviceCheckEngine:
         snap, dev_arrays, overlay_active = self._sync_view()
         enc = self._encode(snap, queries, rest_depth)
         err, general = self._classify(snap, enc[0], enc[2])
+        # Leopard first: closure-eligible fast queries resolve as one
+        # sorted-pair binary search and leave the device walk entirely
+        # (their fast_active bit drops, so the BFS does no work for them)
+        leo_res = self._leopard_answers(enc, err, general)
+        active = ~(err | general)
+        if leo_res is not None:
+            active &= ~leo_res[1]
         # pad for compile-cache reuse, but never beyond the frontier cap
         # (max_batch <= frontier guarantees n fits)
         qpad = min(_bucket(n), self.frontier)
         padded = self._pad(enc, n, qpad)
-        fast_active = np.pad(~(err | general), (0, qpad - n))
-        # ONE packed upload + ONE packed verdict download per chunk: each
-        # separate transfer is a full host-link round-trip (fastpath
-        # _run_fused_packed)
-        qpack = np.stack([*padded, fast_active.astype(np.int32)]).astype(
-            np.int32
-        )
+        fast_active = np.pad(active, (0, qpad - n))
         self._phase("check_encode", time.perf_counter() - t_enc)
-        res, occ = fp.run_fast_packed(
-            dev_arrays,
-            qpack,
-            frontier=self.frontier,
-            arena=self.arena,
-            max_depth=self.max_depth,
-            max_width=self.max_width,
-            mults=self._adaptive_mults(),
-            timer=self._fast_timer,
-        )
+        if fast_active.any():
+            # ONE packed upload + ONE packed verdict download per chunk:
+            # each separate transfer is a full host-link round-trip
+            # (fastpath _run_fused_packed)
+            qpack = np.stack(
+                [*padded, fast_active.astype(np.int32)]
+            ).astype(np.int32)
+            res, occ = fp.run_fast_packed(
+                dev_arrays,
+                qpack,
+                frontier=self.frontier,
+                arena=self.arena,
+                max_depth=self.max_depth,
+                max_width=self.max_width,
+                mults=self._adaptive_mults(),
+                timer=self._fast_timer,
+            )
+        else:
+            # the whole chunk resolved off-device (closure index and/or
+            # err/general routing): skip the dispatch, not just the work
+            res = occ = None
         # the algebra program is overlay-aware (probes consult the om_
         # delta tables, stale edge rows raise the per-query dirty bit that
         # routes just those queries to the oracle), so general queries
@@ -696,7 +805,7 @@ class DeviceCheckEngine:
         if general.any():
             gi = np.flatnonzero(general)
             gres = self._run_general(dev_arrays, enc, gi)
-        return (enc, err, general, res, gi, gres, dev_arrays, occ)
+        return (enc, err, general, res, gi, gres, dev_arrays, occ, leo_res)
 
     def _gen_schedule(self, q: int, boost: int):
         """Static shapes for one fused algebra dispatch (engine/algebra.py).
@@ -846,7 +955,7 @@ class DeviceCheckEngine:
         The retry runs against the handle's own device arrays — a write
         landing between dispatch and retry must not pair these encodings
         with a newer projection."""
-        enc, err, general, res, gi, gres, dev_arrays, occ = handle
+        enc, err, general, res, gi, gres, dev_arrays, occ, leo_res = handle
         n = err.shape[0]
         allowed = np.zeros(n, bool)
         fallback = err.copy()
@@ -887,14 +996,24 @@ class DeviceCheckEngine:
             fallback[gi] |= gover | gdirty | (codes == R_ERR)
 
         t_sync = time.perf_counter()
-        codes = np.asarray(res)[:n]  # one D2H fetch for all three masks
-        self._update_occ(np.asarray(occ))
+        if res is None:
+            # nothing was dispatched on the fast path (closure index
+            # answered everything eligible): all-zero codes, no occupancy
+            codes = np.zeros(n, np.uint8)
+        else:
+            codes = np.asarray(res)[:n]  # one D2H fetch for all 3 masks
+            self._update_occ(np.asarray(occ))
         self._phase("check_collect_sync", time.perf_counter() - t_sync)
         found = (codes & 1).astype(bool)
         over = ((codes >> 1) & 1).astype(bool)
         dirty = ((codes >> 2) & 1).astype(bool)
         fmask = ~(err | general)
         allowed[fmask] = found[fmask]
+        if leo_res is not None:
+            # closure verdicts override the (inactive, all-zero) device
+            # slots for the answered queries; their over/dirty bits are
+            # zero by construction, so no fallback/retry can claim them
+            allowed[leo_res[1]] = leo_res[0][leo_res[1]]
         # dirty queries touched a CSR row with pending writes: the oracle
         # (live store) must answer *unless* membership was already
         # established — found-bits are overlay-exact and monotone, so a
@@ -1061,3 +1180,108 @@ class DeviceCheckEngine:
             return [], []
         allowed, fallback = self._collect(handle, retry=retry)
         return allowed.tolist(), fallback.tolist()
+
+    # -- Leopard listing APIs ------------------------------------------------
+    #
+    # ListObjects / ListSubjects enumerate the closure index (sorted-pair
+    # slices, decoded through the vocab) when the touched set ids are
+    # clean, and the host oracle (live-store BFS, ketotpu/leopard/
+    # hostlist.py) when a deletion marked them dirty or the index is off.
+    # Both paths sort lexicographically, so pagination tokens are
+    # interchangeable between them.
+
+    def leopard_stats(self) -> dict:
+        """Gauge snapshot for observability (keto_leopard_* metrics)."""
+        with self._sync_lock:
+            idx = self._leopard
+            stats = idx.stats() if idx is not None else {
+                "pairs": 0.0, "dirty_sets": 0.0, "fallbacks": 0.0,
+                "build_s": 0.0, "builds": 0.0,
+            }
+        stats["answered"] = float(self.leopard_answered)
+        stats["hits"] = float(self.leopard_hits)
+        stats["list_fallbacks"] = float(self.leopard_list_fallbacks)
+        stats["active"] = 1.0 if idx is not None else 0.0
+        return stats
+
+    def list_objects(
+        self,
+        namespace: str,
+        relation: str,
+        subject,
+        *,
+        page_size: int = 0,
+        page_token: str = "",
+    ):
+        """Objects o with ``namespace:o#relation`` reaching ``subject``
+        through the set-containment closure; (objects, next_page_token)."""
+        t0 = time.perf_counter()
+        sets = None
+        with self._sync_lock:
+            self._snapshot_locked()
+            idx = self._leopard
+            if idx is not None:
+                v = self._vocab
+                lo, hi = idx.node_range(
+                    v.namespaces.lookup(namespace),
+                    v.relations.lookup(relation),
+                )
+                sets = idx.list_sets_of(v.subject_key(subject), lo, hi)
+            if sets is not None:
+                obj_tab = self._vocab.objects.strings()
+                objs = sorted(obj_tab[idx.node_obj(s)] for s in sets)
+        if sets is None:
+            self.leopard_list_fallbacks += 1
+            t_fb = time.perf_counter()
+            objs = leolist.host_list_objects(
+                self.store, namespace, relation, subject
+            )
+            self._rpc_fallback_stage(
+                "list_objects", time.perf_counter() - t_fb
+            )
+        self._phase("list_objects", time.perf_counter() - t0)
+        return leolist.paginate(objs, page_token, page_size)
+
+    def list_subjects(
+        self,
+        namespace: str,
+        object: str,
+        relation: str,
+        *,
+        page_size: int = 0,
+        page_token: str = "",
+    ):
+        """Subjects reaching ``namespace:object#relation`` through the
+        set-containment closure; (subjects, next_page_token)."""
+        t0 = time.perf_counter()
+        elems = None
+        with self._sync_lock:
+            self._snapshot_locked()
+            idx = self._leopard
+            if idx is not None:
+                v = self._vocab
+                elems = idx.list_elements(idx.node_id(
+                    v.namespaces.lookup(namespace),
+                    v.objects.lookup(object),
+                    v.relations.lookup(relation),
+                ))
+            if elems is not None:
+                subj_tab = self._vocab.subjects.strings()
+                by_uid = {
+                    subj_tab[e]: leolist.subject_from_uid(subj_tab[e])
+                    for e in elems
+                }
+        if elems is None:
+            self.leopard_list_fallbacks += 1
+            t_fb = time.perf_counter()
+            by_uid = leolist.host_list_subjects(
+                self.store, namespace, object, relation
+            )
+            self._rpc_fallback_stage(
+                "list_subjects", time.perf_counter() - t_fb
+            )
+        keys, next_token = leolist.paginate(
+            sorted(by_uid.keys()), page_token, page_size
+        )
+        self._phase("list_subjects", time.perf_counter() - t0)
+        return [by_uid[k] for k in keys], next_token

@@ -1,0 +1,45 @@
+"""Leopard: the transitive-closure index behind the reverse-query APIs.
+
+Zanzibar's serving story rests on the *Leopard index* — a denormalized
+transitive closure of group membership kept as flat, incrementally
+maintained ``(set_id, element_id)`` pairs (the paper's §2.4.1 "experience"
+section).  This package is that subsystem for the TPU engine:
+
+* :mod:`ketotpu.leopard.closure` — the index itself: sorted int32 pair
+  arrays built vectorized on the host (numpy frontier-doubling over the
+  engine's :class:`~ketotpu.engine.delta.TupleColumns`), maintained
+  incrementally from the same ``changes_since`` changelog that feeds the
+  delta overlay.  Additions append closure pairs; deletions mark the
+  affected set ids dirty so queries touching them fall back to the host
+  oracle — the same overlay-exactness contract ``engine/delta.py``
+  established for checks.
+* :mod:`ketotpu.leopard.device` — the HBM residency layer: the packed
+  pair array ships to the device next to the snapshot CSR, and batched
+  membership verdicts are one sorted-pair binary search
+  (``jnp.searchsorted``) instead of an iterative graph walk.
+* :mod:`ketotpu.leopard.hostlist` — the host-oracle enumeration of both
+  listing APIs (the parity reference and the dirty-set fallback), plus
+  the shared lexicographic pagination the REST/gRPC surfaces expose.
+
+The public APIs built on top — ``ListObjects(namespace, relation,
+subject)`` and ``ListSubjects(namespace, object, relation)`` — ride the
+normal four transports (REST, gRPC, SDK, CLI) and the worker wire
+protocol; see ``server/handlers.py`` / ``server/rest.py`` /
+``server/workers.py``.
+"""
+
+from ketotpu.leopard.closure import ClosureIndex
+from ketotpu.leopard.hostlist import (
+    HostListEngine,
+    host_list_objects,
+    host_list_subjects,
+    paginate,
+)
+
+__all__ = [
+    "ClosureIndex",
+    "HostListEngine",
+    "host_list_objects",
+    "host_list_subjects",
+    "paginate",
+]

@@ -1,0 +1,77 @@
+"""HBM residency for the Leopard pairs + the device binary-search probe.
+
+The packed ``(set_id << 32 | element_id)`` int64 array ships to the
+accelerator next to the snapshot CSR (`engine/tpu.py` installs it right
+after the base device arrays), and batched membership verdicts are a
+single ``jnp.searchsorted`` over the sorted pairs — one binary search
+per query instead of an iterative frontier walk.
+
+Compile-variant discipline matches the rest of the engine: query blocks
+are padded to power-of-two buckets (`tpu._bucket`), so the jit sees one
+variant per (pairs_len, bucket) pair — pairs_len changes only at
+rebuild.  Device probing is worth the dispatch overhead for large
+batches; small batches stay on the host numpy path (`closure.py`), which
+returns bit-identical verdicts.  Any device failure degrades to the host
+path (never to a wrong answer).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+try:  # pragma: no cover - exercised wherever jax is present
+    import jax
+    import jax.numpy as jnp
+
+    _HAS_JAX = True
+except Exception:  # pragma: no cover
+    jax = None
+    jnp = None
+    _HAS_JAX = False
+
+# below this many probes the host searchsorted wins against a device
+# round-trip (dominated by dispatch latency, not the log2(pairs) search)
+DEVICE_PROBE_MIN = 2048
+
+
+def ship_pairs(index) -> Optional[dict]:
+    """Device-put the closure pair arrays; None when jax is unavailable
+    or the index is empty."""
+    if not _HAS_JAX or index is None or len(index.elt_packed) == 0:
+        return None
+    try:
+        return {
+            "pairs": jax.device_put(index.elt_packed),
+            "hops": jax.device_put(index.elt_hop),
+        }
+    except Exception:
+        return None
+
+
+if _HAS_JAX:
+
+    @jax.jit
+    def _probe(pairs, hops, keys):
+        idx = jnp.searchsorted(pairs, keys)
+        idx = jnp.clip(idx, 0, pairs.shape[0] - 1)
+        hit = pairs[idx] == keys
+        return hit, jnp.where(hit, hops[idx], 0)
+
+
+def probe_pairs(
+    dev: Optional[dict], keys: np.ndarray, pad_to: int
+) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Batched (hit, hop) via the device pairs; None => use host path."""
+    if dev is None or not _HAS_JAX or len(keys) < DEVICE_PROBE_MIN:
+        return None
+    try:
+        padded = np.full(pad_to, -1, np.int64)
+        padded[: len(keys)] = keys
+        hit, hop = _probe(dev["pairs"], dev["hops"], padded)
+        hit = np.asarray(hit)[: len(keys)]
+        hop = np.asarray(hop)[: len(keys)]
+        return hit, hop
+    except Exception:
+        return None

@@ -1,0 +1,171 @@
+"""Host-oracle enumeration for the listing APIs + shared pagination.
+
+The closure index answers ``ListObjects`` / ``ListSubjects`` from sorted
+pairs; this module is the other half of the overlay-exactness contract —
+the enumeration that reads the **live store** and is therefore always
+correct, used as
+
+* the fallback when the index declines (dirty set ids after deletions,
+  index disabled/stale, oracle-only engine kind), and
+* the parity reference the property tests compare the index against.
+
+Semantics are the closure's: a subject reaches an object iff there is a
+chain of set-containment hops (tuple subjects that are SubjectSets) from
+the object's ``(namespace, object, relation)`` node to a tuple carrying
+that subject.  Cycles are handled with a visited set; results are
+deterministic (lexicographic) so pagination is stable and identical
+between the index path and this one.
+
+Pagination is Keto-style: an opaque ``page_token`` ("" = first page)
+that encodes the position after the last returned item; clients treat it
+as a black box and pass it back verbatim.
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ketotpu.api.types import (
+    RelationQuery,
+    Subject,
+    SubjectID,
+    SubjectSet,
+    subject_from_string,
+)
+
+# mirrors storage/memory.py's DEFAULT_PAGE_SIZE (x_keto_read_max_page parity)
+DEFAULT_PAGE_SIZE = 100
+_SCAN_PAGE = 1000
+# generous cycle/blowup guard for the BFS (the index path never walks)
+_MAX_VISITED = 1_000_000
+
+
+def paginate(
+    keys: Sequence[str], page_token: str, page_size: int
+) -> Tuple[List[str], str]:
+    """Slice a lexicographically sorted key list Keto-style.
+
+    The token is the last key of the previous page; the next page starts
+    strictly after it, so the scheme stays stable under concurrent
+    inserts (an unknown token is simply a lower bound, never an error).
+    """
+    if page_size <= 0:
+        page_size = DEFAULT_PAGE_SIZE
+    start = bisect.bisect_right(keys, page_token) if page_token else 0
+    page = list(keys[start: start + page_size])
+    next_token = page[-1] if start + page_size < len(keys) else ""
+    return page, next_token
+
+
+def host_list_subjects(
+    store, namespace: str, object: str, relation: str
+) -> Dict[str, Subject]:
+    """All subjects reaching ``namespace:object#relation``, keyed by
+    ``unique_id()`` — forward BFS over the live store's containment
+    edges, collecting every tuple subject along the way."""
+    out: Dict[str, Subject] = {}
+    seen = {(namespace, object, relation)}
+    stack = [(namespace, object, relation)]
+    while stack:
+        ns, obj, rel = stack.pop()
+        token = ""
+        while True:
+            tuples, token = store.get_relation_tuples(
+                RelationQuery(namespace=ns, object=obj, relation=rel),
+                page_token=token,
+                page_size=_SCAN_PAGE,
+            )
+            for t in tuples:
+                out[t.subject.unique_id()] = t.subject
+                if isinstance(t.subject, SubjectSet):
+                    key = (
+                        t.subject.namespace,
+                        t.subject.object,
+                        t.subject.relation,
+                    )
+                    if key not in seen and len(seen) < _MAX_VISITED:
+                        seen.add(key)
+                        stack.append(key)
+            if not token:
+                break
+    return out
+
+
+def host_list_objects(
+    store, namespace: str, relation: str, subject: Subject
+) -> List[str]:
+    """All objects o with ``namespace:o#relation`` reaching ``subject`` —
+    reverse BFS from the subject through the store's by-subject index
+    (containment chains traverse nodes of *any* relation)."""
+    out = set()
+    seen = set()
+    frontier: List[Subject] = [subject]
+    while frontier:
+        s = frontier.pop()
+        uid = s.unique_id()
+        if uid in seen or len(seen) >= _MAX_VISITED:
+            continue
+        seen.add(uid)
+        token = ""
+        while True:
+            tuples, token = store.get_relation_tuples(
+                RelationQuery().with_subject(s),
+                page_token=token,
+                page_size=_SCAN_PAGE,
+            )
+            for t in tuples:
+                if t.namespace == namespace and t.relation == relation:
+                    out.add(t.object)
+                frontier.append(
+                    SubjectSet(t.namespace, t.object, t.relation)
+                )
+            if not token:
+                break
+    return sorted(out)
+
+
+class HostListEngine:
+    """Listing engine over the live store only (oracle engine kind, and
+    the degraded mode of the device engine).  Duck-type-compatible with
+    ``DeviceCheckEngine.list_objects`` / ``list_subjects`` and
+    ``server.workers.RemoteListEngine``."""
+
+    def __init__(self, store):
+        self.store = store
+
+    def list_objects(
+        self,
+        namespace: str,
+        relation: str,
+        subject: Subject,
+        *,
+        page_size: int = 0,
+        page_token: str = "",
+    ) -> Tuple[List[str], str]:
+        objs = host_list_objects(self.store, namespace, relation, subject)
+        return paginate(objs, page_token, page_size)
+
+    def list_subjects(
+        self,
+        namespace: str,
+        object: str,
+        relation: str,
+        *,
+        page_size: int = 0,
+        page_token: str = "",
+    ) -> Tuple[List[Subject], str]:
+        by_uid = host_list_subjects(self.store, namespace, object, relation)
+        keys, next_token = paginate(
+            sorted(by_uid.keys()), page_token, page_size
+        )
+        return [by_uid[k] for k in keys], next_token
+
+
+def subject_from_uid(uid: str) -> Optional[Subject]:
+    """Decode a vocab ``unique_id()`` string back into a Subject."""
+    if uid.startswith("id:"):
+        return SubjectID(id=uid[3:])
+    if uid.startswith("set:"):
+        return subject_from_string(uid[4:])
+    return None

@@ -94,6 +94,39 @@ class MeshCheckEngine(DeviceCheckEngine):
         # last general dispatch's per-shard BFS occupancy partials
         self._shard_fallbacks = np.zeros(mesh_devices, np.int64)
         self._shard_gen_occ = np.zeros(mesh_devices)
+        # per-shard Leopard closure segments (pair counts by owner set)
+        self._leo_shard_pairs = np.zeros(mesh_devices, np.int64)
+        self._leo_segments = None
+
+    def _install_leopard(self) -> None:
+        """Build the closure index, then partition its element pairs into
+        per-shard segments by the OWNER SET's (ns, obj) hash — the same
+        partitioning as the CSR, so a shard's segment answers exactly the
+        queries whose object node it owns.  The segments replace the
+        single replicated device copy: each holds only its shard's slice
+        of the sorted pairs (sorting is preserved — the global order is
+        by packed (set, element) key, and a subsequence of a sorted array
+        is sorted), so per-device closure memory scales down with mesh
+        size just like the graph itself."""
+        super()._install_leopard()
+        # the segments stand in for the replicated HBM copy; probes on the
+        # mesh engine take the host searchsorted path (bit-identical)
+        self._leo_device = None
+        self._leo_segments = None
+        self._leo_shard_pairs = np.zeros(self.n_shards, np.int64)
+        idx = self._leopard
+        if idx is None or len(idx.elt_set) == 0:
+            return
+        hi = idx.nodes[idx.elt_set.astype(np.int64)] >> 32
+        ns = (hi // idx.R).astype(np.int64)
+        obj = (idx.nodes[idx.elt_set.astype(np.int64)] & 0xFFFFFFFF)
+        shards = graphshard.shard_of_np(ns, obj, self.n_shards)
+        self._leo_shard_pairs = np.bincount(
+            shards, minlength=self.n_shards
+        ).astype(np.int64)
+        self._leo_segments = [
+            idx.elt_packed[shards == s] for s in range(self.n_shards)
+        ]
 
     def _install_device_arrays(self) -> None:
         """Ship the SHARDED stacks (base + EMPTY overlays); the replicated
@@ -271,9 +304,15 @@ class MeshCheckEngine(DeviceCheckEngine):
             stacked = self._stacked
         enc = self._encode(snap, queries, rest_depth)
         err, general = self._classify(snap, enc[0], enc[2])
+        # Leopard first: checks the closure index answers drop out of the
+        # sharded BFS entirely (same interception as the single-chip path)
+        leo_res = self._leopard_answers(enc, err, general)
+        act = ~(err | general)
+        if leo_res is not None:
+            act &= ~leo_res[1]
         qpad = min(_bucket(n), self.frontier)
         padded = self._pad(enc, n, qpad)
-        active = np.pad(~(err | general), (0, qpad - n))
+        active = np.pad(act, (0, qpad - n))
         self._phase("check_encode", time.perf_counter() - t0)
         t0 = time.perf_counter()
         res = self._sharded_run(stacked, padded, active)
@@ -282,10 +321,11 @@ class MeshCheckEngine(DeviceCheckEngine):
             gi = np.flatnonzero(general)
             gres = self._run_general_mesh(stacked, enc, gi)
         self._phase("check_mesh_dispatch", time.perf_counter() - t0)
-        return (enc, err, general, res, gi, gres, stacked, None)
+        return (enc, err, general, res, gi, gres, stacked, None, leo_res)
 
     def _collect(self, handle, retry: bool = True):
-        enc, fallback_mask, general, res, gi, gres, stacked, replica = handle
+        (enc, fallback_mask, general, res, gi, gres, stacked, replica,
+         leo_res) = handle
         n = fallback_mask.shape[0]
         allowed = np.zeros(n, bool)
         fallback = fallback_mask.copy()
@@ -359,6 +399,12 @@ class MeshCheckEngine(DeviceCheckEngine):
             allowed[ri] = rfound
             unres[ri] = (rover | rdirty) & ~rfound
         fallback |= unres
+        if leo_res is not None:
+            # closure-answered queries never fall back: they were masked
+            # out of the BFS, so their device bits are inert zeros
+            ans = leo_res[1]
+            allowed[ans] = leo_res[0][ans]
+            fallback &= ~ans
         fb = np.flatnonzero(fallback)
         if len(fb):
             # attribute each oracle fallback to the query's owner shard
@@ -394,5 +440,7 @@ class MeshCheckEngine(DeviceCheckEngine):
                 "overlay_dirty": int(dirty),
                 "nodes": nodes,
                 "gen_occupancy": float(self._shard_gen_occ[i]),
+                "leopard_pairs": int(self._leo_shard_pairs[i])
+                if i < len(self._leo_shard_pairs) else 0,
             })
         return out

@@ -50,6 +50,19 @@ SERVICES: Dict[str, Dict[str, Tuple[Type, Type]]] = {
             read_service_pb2.ListRelationTuplesRequest,
             read_service_pb2.ListRelationTuplesResponse,
         ),
+        # Leopard reverse-query APIs: ListObjects enumerates objects a
+        # subject reaches through the closure; ListSubjects enumerates a
+        # node's element set.  Both reuse the ListRelationTuples wire
+        # shapes — the relation_query carries the fixed coordinates and
+        # each result row comes back as a full relation tuple.
+        "ListObjects": (
+            read_service_pb2.ListRelationTuplesRequest,
+            read_service_pb2.ListRelationTuplesResponse,
+        ),
+        "ListSubjects": (
+            read_service_pb2.ListRelationTuplesRequest,
+            read_service_pb2.ListRelationTuplesResponse,
+        ),
     },
     f"{_RTS}.WriteService": {
         "TransactRelationTuples": (

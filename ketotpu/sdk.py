@@ -36,6 +36,7 @@ from ketotpu.api.types import (
     Subject,
     SubjectSet,
     Tree,
+    subject_from_string,
 )
 
 
@@ -179,6 +180,82 @@ class KetoClient:
         data = json.loads(body)
         return (
             [RelationTuple.from_json(d) for d in data["relation_tuples"]],
+            data.get("next_page_token", ""),
+        )
+
+    # -- Leopard listing APIs (reverse queries) -----------------------------
+
+    def list_objects(
+        self,
+        namespace: str,
+        relation: str,
+        subject: "Subject | str",
+        *,
+        page_token: str = "",
+        page_size: int = 0,
+    ) -> Tuple[List[str], str]:
+        """Objects the subject reaches in ``namespace#relation`` through
+        set containment (GET /relation-tuples/list-objects, answered from
+        the engine's closure index).  Returns (objects, next_page_token).
+
+        ``subject`` may be a ``Subject`` or its string form ("alice",
+        "Group:eng#members")."""
+        if isinstance(subject, str):
+            subject = subject_from_string(subject)
+        params = dict(
+            RelationQuery(
+                namespace=namespace, relation=relation
+            ).with_subject(subject).to_url_query()
+        )
+        if page_token:
+            params["page_token"] = page_token
+        if page_size:
+            params["page_size"] = str(page_size)
+        q = urllib.parse.urlencode(params)
+        status, body = self._request(
+            "GET", f"{self.read_url}/relation-tuples/list-objects?{q}"
+        )
+        if status != 200:
+            self._raise_for(status, body)
+        data = json.loads(body)
+        objs = data.get("objects")
+        if objs is None:
+            objs = [
+                RelationTuple.from_json(d).object
+                for d in data["relation_tuples"]
+            ]
+        return list(objs), data.get("next_page_token", "")
+
+    def list_subjects(
+        self,
+        namespace: str,
+        object: str,
+        relation: str,
+        *,
+        page_token: str = "",
+        page_size: int = 0,
+    ) -> Tuple[List[Subject], str]:
+        """Subjects reaching ``namespace:object#relation`` (GET
+        /relation-tuples/list-subjects).  Returns (subjects, token)."""
+        params = {
+            "namespace": namespace, "object": object, "relation": relation,
+        }
+        if page_token:
+            params["page_token"] = page_token
+        if page_size:
+            params["page_size"] = str(page_size)
+        q = urllib.parse.urlencode(params)
+        status, body = self._request(
+            "GET", f"{self.read_url}/relation-tuples/list-subjects?{q}"
+        )
+        if status != 200:
+            self._raise_for(status, body)
+        data = json.loads(body)
+        return (
+            [
+                RelationTuple.from_json(d).subject
+                for d in data["relation_tuples"]
+            ],
             data.get("next_page_token", ""),
         )
 

@@ -327,6 +327,133 @@ class RelationTupleHandler:
         except Exception as e:  # noqa: BLE001
             _abort(context, e)
 
+    # -- Leopard listing cores (reverse-query APIs) -------------------------
+
+    def list_objects_core(
+        self, namespace, relation, subject, page_size, page_token, r=None
+    ):
+        """Objects a subject reaches in ``namespace#relation`` through the
+        closure (ketotpu/leopard/): answered from the index when clean,
+        host-oracle enumeration otherwise.  Returns (objects, next_token)."""
+        r = r if r is not None else self.r
+        if not namespace or not relation:
+            raise BadRequestError(
+                "list-objects requires namespace, relation and subject"
+            )
+        if subject is None:
+            raise BadRequestError(
+                "list-objects requires namespace, relation and subject"
+            )
+        with r.tracer().span("leopard.Engine.ListObjects"):
+            q = RelationQuery(namespace=namespace, relation=relation)
+            r.read_only_mapper().from_query(q)  # unknown ns => 404
+            objs, next_token = r.list_engine().list_objects(
+                namespace, relation, subject,
+                page_size=page_size, page_token=page_token or "",
+            )
+        r.metrics().counter(
+            "keto_list_requests_total", 1,
+            help="listing (reverse-query) requests served", op="list_objects",
+        )
+        return objs, next_token
+
+    def list_subjects_core(
+        self, namespace, object_, relation, page_size, page_token, r=None
+    ):
+        """Subjects reaching ``namespace:object#relation`` (the node's
+        closure element set).  Returns (subjects, next_token)."""
+        r = r if r is not None else self.r
+        if not namespace or not object_ or not relation:
+            raise BadRequestError(
+                "list-subjects requires namespace, object and relation"
+            )
+        with r.tracer().span("leopard.Engine.ListSubjects"):
+            q = RelationQuery(namespace=namespace, relation=relation)
+            r.read_only_mapper().from_query(q)
+            subs, next_token = r.list_engine().list_subjects(
+                namespace, object_, relation,
+                page_size=page_size, page_token=page_token or "",
+            )
+        r.metrics().counter(
+            "keto_list_requests_total", 1,
+            help="listing (reverse-query) requests served", op="list_subjects",
+        )
+        return subs, next_token
+
+    # -- gRPC ReadService: Leopard listing RPCs -----------------------------
+
+    def _list_query(self, request):
+        if request.HasField("relation_query"):
+            return query_from_proto(request.relation_query)
+        raise BadRequestError("you must provide a relation_query")
+
+    def ListObjects(self, request, context):
+        try:
+            md = _md(context)
+            r = self.r.resolve(md)
+            with flightrec.rpc_recording(
+                r, "list_objects", traceparent=md.get("traceparent"),
+                detail="grpc ListObjects",
+            ):
+                t0 = time.perf_counter()
+                q = self._list_query(request)
+                flightrec.note_stage("parse", time.perf_counter() - t0)
+                t1 = time.perf_counter()
+                objs, next_token = self.list_objects_core(
+                    q.namespace, q.relation, q.subject(),
+                    int(request.page_size), request.page_token, r,
+                )
+                flightrec.note_stage("compute", time.perf_counter() - t1)
+                flightrec.note(results=len(objs))
+                t2 = time.perf_counter()
+                subject = q.subject()
+                resp = read_service_pb2.ListRelationTuplesResponse(
+                    relation_tuples=[
+                        tuple_to_proto(RelationTuple(
+                            q.namespace, o, q.relation, subject
+                        ))
+                        for o in objs
+                    ],
+                    next_page_token=next_token,
+                )
+                flightrec.note_stage("encode", time.perf_counter() - t2)
+                return resp
+        except Exception as e:  # noqa: BLE001
+            _abort(context, e)
+
+    def ListSubjects(self, request, context):
+        try:
+            md = _md(context)
+            r = self.r.resolve(md)
+            with flightrec.rpc_recording(
+                r, "list_subjects", traceparent=md.get("traceparent"),
+                detail="grpc ListSubjects",
+            ):
+                t0 = time.perf_counter()
+                q = self._list_query(request)
+                flightrec.note_stage("parse", time.perf_counter() - t0)
+                t1 = time.perf_counter()
+                subs, next_token = self.list_subjects_core(
+                    q.namespace, q.object, q.relation,
+                    int(request.page_size), request.page_token, r,
+                )
+                flightrec.note_stage("compute", time.perf_counter() - t1)
+                flightrec.note(results=len(subs))
+                t2 = time.perf_counter()
+                resp = read_service_pb2.ListRelationTuplesResponse(
+                    relation_tuples=[
+                        tuple_to_proto(RelationTuple(
+                            q.namespace, q.object, q.relation, s
+                        ))
+                        for s in subs
+                    ],
+                    next_page_token=next_token,
+                )
+                flightrec.note_stage("encode", time.perf_counter() - t2)
+                return resp
+        except Exception as e:  # noqa: BLE001
+            _abort(context, e)
+
     # -- gRPC WriteService --------------------------------------------------
 
     def TransactRelationTuples(self, request, context):

@@ -64,6 +64,8 @@ _RPC_OPS = {
     "/relation-tuples/check/openapi": "check",
     "/relation-tuples/check/batch": "check",
     "/relation-tuples/expand": "expand",
+    "/relation-tuples/list-objects": "list_objects",
+    "/relation-tuples/list-subjects": "list_subjects",
 }
 
 # admin DELETE rejects unknown query params (internal/x/validate, used at
@@ -350,6 +352,58 @@ def read_router(registry) -> Router:
         }
 
     rt.add("GET", "/relation-tuples", get_relations)
+
+    def _page_args(req):
+        page_size = 0
+        if "page_size" in req.query:
+            try:
+                page_size = int(req.query["page_size"])
+            except ValueError as e:
+                raise BadRequestError(str(e)) from None
+        return page_size, req.query.get("page_token", "")
+
+    def get_list_objects(req):
+        # Leopard reverse query: objects the subject reaches in
+        # namespace#relation through the closure index (host-oracle
+        # fallback on dirty sets).  Rows come back as full relation
+        # tuples so clients reuse the ListRelationTuples decoding.
+        query = RelationQuery.from_url_query(req.query)
+        page_size, page_token = _page_args(req)
+        objs, next_token = tuples.list_objects_core(
+            query.namespace, query.relation, query.subject(),
+            page_size, page_token, registry.resolve(req.headers),
+        )
+        subject = query.subject()
+        return 200, {
+            "relation_tuples": [
+                RelationTuple(
+                    query.namespace, o, query.relation, subject
+                ).to_json()
+                for o in objs
+            ],
+            "objects": objs,
+            "next_page_token": next_token,
+        }
+
+    def get_list_subjects(req):
+        query = RelationQuery.from_url_query(req.query)
+        page_size, page_token = _page_args(req)
+        subs, next_token = tuples.list_subjects_core(
+            query.namespace, query.object, query.relation,
+            page_size, page_token, registry.resolve(req.headers),
+        )
+        return 200, {
+            "relation_tuples": [
+                RelationTuple(
+                    query.namespace, query.object, query.relation, s
+                ).to_json()
+                for s in subs
+            ],
+            "next_page_token": next_token,
+        }
+
+    rt.add("GET", "/relation-tuples/list-objects", get_list_objects)
+    rt.add("GET", "/relation-tuples/list-subjects", get_list_subjects)
 
     def get_namespaces(req):
         return 200, {

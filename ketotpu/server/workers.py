@@ -166,6 +166,32 @@ class EngineHostServer:
                     subject, int(req.get("depth", 0))
                 )
                 return {"tree": tree.to_json() if tree is not None else None}
+        if op == "list_objects":
+            with flightrec.rpc_recording(
+                r, "list_objects", traceparent=tp,
+                detail="worker->owner list_objects",
+            ):
+                objs, next_token = r.list_engine().list_objects(
+                    req["namespace"], req["relation"],
+                    _decode_subject(req["subject"]),
+                    page_size=int(req.get("page_size", 0)),
+                    page_token=req.get("page_token", ""),
+                )
+                return {"objects": list(objs), "next_page_token": next_token}
+        if op == "list_subjects":
+            with flightrec.rpc_recording(
+                r, "list_subjects", traceparent=tp,
+                detail="worker->owner list_subjects",
+            ):
+                subs, next_token = r.list_engine().list_subjects(
+                    req["namespace"], req["object"], req["relation"],
+                    page_size=int(req.get("page_size", 0)),
+                    page_token=req.get("page_token", ""),
+                )
+                return {
+                    "subjects": [_encode_subject(s) for s in subs],
+                    "next_page_token": next_token,
+                }
         if op == "ping":
             return {"pong": True}
         if op == "health":
@@ -361,6 +387,43 @@ class RemoteExpandEngine:
         if resp["tree"] is None:
             return None
         return Tree.from_json(resp["tree"])
+
+
+class RemoteListEngine:
+    """Listing-engine surface forwarding to the device owner (the Leopard
+    closure index lives with the device; workers only relay)."""
+
+    def __init__(self, path: str, check: Optional[RemoteCheckEngine] = None):
+        self._remote = check if check is not None else RemoteCheckEngine(path)
+
+    def list_objects(
+        self, namespace: str, relation: str, subject: Subject,
+        *, page_size: int = 0, page_token: str = "",
+    ):
+        resp = self._remote._call({
+            "op": "list_objects",
+            "namespace": namespace,
+            "relation": relation,
+            "subject": _encode_subject(subject),
+            "page_size": page_size,
+            "page_token": page_token,
+        })
+        return list(resp["objects"]), resp.get("next_page_token", "")
+
+    def list_subjects(
+        self, namespace: str, object: str, relation: str,
+        *, page_size: int = 0, page_token: str = "",
+    ):
+        resp = self._remote._call({
+            "op": "list_subjects",
+            "namespace": namespace,
+            "object": object,
+            "relation": relation,
+            "page_size": page_size,
+            "page_token": page_token,
+        })
+        subs = [_decode_subject(u) for u in resp["subjects"]]
+        return subs, resp.get("next_page_token", "")
 
 
 def engine_host_readiness(path: str, timeout: float = 1.0):

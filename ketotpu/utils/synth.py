@@ -183,6 +183,66 @@ def synth_queries_mixed(
     return out
 
 
+def build_deep_groups(
+    *,
+    depth: int = 12,
+    n_chains: int = 8,
+    n_users: int = 64,
+    seed: int = 0,
+) -> SynthGraph:
+    """Deep nested-group chains for the Leopard deep-check workload.
+
+    ``n_chains`` independent chains of ``depth`` groups each:
+    ``gc_0.members`` contains ``gc_1#members`` contains ... down to
+    ``gc_{depth-1}``, whose members are direct users.  A check
+    ``Group:gc_0#members@user`` therefore needs ``depth`` containment hops —
+    the shape where the closure index replaces a BFS level per hop with one
+    binary search.  The graph is rewrite-free and narrow, so every node is
+    clean and closure verdicts carry the whole workload (zero fallbacks).
+    """
+    rng = np.random.default_rng(seed)
+    namespaces, errors = parse(SYNTH_OPL)
+    assert not errors, errors
+    manager = StaticNamespaceManager(namespaces)
+    store = InMemoryTupleStore()
+
+    users = [f"u{i}" for i in range(n_users)]
+    groups: List[str] = []
+    tuples: List[RelationTuple] = []
+    for c in range(n_chains):
+        chain = [f"g{c}_{d}" for d in range(depth)]
+        groups.extend(chain)
+        for d in range(depth - 1):
+            tuples.append(RelationTuple(
+                "Group", chain[d], "members",
+                SubjectSet("Group", chain[d + 1], "members"),
+            ))
+        # users land in the deepest group of a random subset of chains
+        for u in users:
+            if rng.random() < 0.5:
+                tuples.append(RelationTuple(
+                    "Group", chain[-1], "members", SubjectID(u)))
+    store.write_relation_tuples(*tuples)
+    return SynthGraph(
+        store=store, manager=manager, users=users, docs=[],
+        folders=[], groups=groups,
+    )
+
+
+def deep_queries(
+    graph: SynthGraph, n: int, *, depth: int = 12, seed: int = 1
+) -> List[RelationTuple]:
+    """Checks against chain roots: each needs ``depth`` containment hops."""
+    rng = np.random.default_rng(seed)
+    roots = [g for g in (graph.groups or []) if g.endswith("_0")]
+    out = []
+    for _ in range(n):
+        g = roots[int(rng.integers(len(roots)))]
+        u = graph.users[int(rng.integers(len(graph.users)))]
+        out.append(RelationTuple("Group", g, "members", SubjectID(u)))
+    return out
+
+
 def build_synth_columnar(
     *,
     n_users: int = 1_200_000,
