@@ -661,9 +661,19 @@ def _scale_10m_expand(out, state) -> None:
         for _ in range(512)
     ]
     beng.batch_expand(xroots[:64], 5)
+    # snapshot the engine's cumulative phase counters around the timed
+    # pass so the throughput number decomposes into host vs device time
+    # (BENCH_r05 anomaly: 78 trees/s here vs 27.5k/s at 1M — the delta
+    # between these two timers says which side eats the wall clock)
+    ph0 = dict(getattr(beng, "phase_seconds", {}) or {})
     t0 = time.perf_counter()
     btrees = beng.batch_expand(xroots, 5)
     dt = time.perf_counter() - t0
+    ph1 = dict(getattr(beng, "phase_seconds", {}) or {})
+
+    def _delta(*keys):
+        return round(sum(ph1.get(k, 0.0) - ph0.get(k, 0.0) for k in keys), 3)
+
     p50, p99 = _expand_latency(beng, xroots[:1], samples=20)
     out.update(
         expand_trees_per_sec_10m=round(len(btrees) / dt, 1),
@@ -672,6 +682,10 @@ def _scale_10m_expand(out, state) -> None:
         ),
         expand_p50_ms_10m=p50,
         expand_p99_ms_10m=p99,
+        expand_10m_device_seconds=_delta("expand_device", "expand_sync"),
+        expand_10m_host_seconds=_delta(
+            "expand_snapshot", "expand_assemble", "expand_oracle_fallback"
+        ),
     )
 
 

@@ -48,6 +48,14 @@ class DeadlineExceededError(KetoAPIError):
     status_code = 504
 
 
+class StaleSnapshotError(KetoAPIError):
+    """The serving snapshot could not be brought at-least-as-fresh as the
+    client's snaptoken within the freshness-barrier budget (Zanzibar's
+    zookie contract): 412 on REST, FAILED_PRECONDITION on gRPC."""
+
+    status_code = 412
+
+
 def ErrMalformedInput(detail: str = "") -> BadRequestError:
     # reference: ketoapi/enc_string.go:14
     msg = "malformed string input"

@@ -316,6 +316,37 @@ def cmd_expand(args) -> int:
     return 0
 
 
+def cmd_watch(args) -> int:
+    from ketotpu.api.proto_codec import tuple_from_proto
+    from ketotpu.proto import watch_service_pb2 as wps
+    from ketotpu.proto.services import WatchServiceStub
+
+    with _channel(args.read_remote, args) as ch:
+        stream = WatchServiceStub(ch).Watch(
+            wps.WatchRelationTuplesRequest(
+                snaptoken=args.since, namespace=args.namespace
+            )
+        )
+        try:
+            for resp in stream:
+                if resp.event == "heartbeat" and not args.heartbeats:
+                    continue
+                out = {"event": resp.event, "snaptoken": resp.snaptoken}
+                if resp.event == "delta":
+                    out["action"] = resp.action
+                    out["relation_tuple"] = tuple_from_proto(
+                        resp.relation_tuple
+                    ).to_json()
+                print(json.dumps(out), flush=True)
+                if resp.event == "resync_required":
+                    # cursor fell off the bounded changelog: the caller
+                    # must re-list and subscribe fresh
+                    return 1
+        except KeyboardInterrupt:
+            pass
+    return 0
+
+
 def _iter_tuple_files(paths):
     for p in paths:
         path = pathlib.Path(p)
@@ -780,6 +811,23 @@ def build_parser() -> argparse.ArgumentParser:
     expand.add_argument("--max-depth", type=int, default=0)
     _add_client_flags(expand)
     expand.set_defaults(fn=cmd_expand)
+
+    watch = sub.add_parser(
+        "watch", help="stream relation-tuple changes (JSON lines)"
+    )
+    watch.add_argument(
+        "--since", default="",
+        help="snaptoken to resume from (replays changes after it)",
+    )
+    watch.add_argument(
+        "--namespace", default="", help="only stream this namespace"
+    )
+    watch.add_argument(
+        "--heartbeats", action="store_true",
+        help="also print heartbeat events",
+    )
+    _add_client_flags(watch)
+    watch.set_defaults(fn=cmd_watch)
 
     rt = sub.add_parser("relation-tuple", help="relation tuple commands")
     rtsub = rt.add_subparsers(dest="rt_command", required=True)

@@ -1,0 +1,40 @@
+"""Consistency subsystem: snaptokens, the freshness barrier, and Watch.
+
+Zanzibar's consistency surface (Pang et al., USENIX ATC '19 §2.4) made
+real for this stack:
+
+* :mod:`ketotpu.consistency.tokens` — structured, versioned snaptokens
+  (store version + changelog cursor + engine snapshot epoch + per-shard
+  cursor vector), opaque base64 on the wire, forward-compatible decode.
+* :mod:`ketotpu.consistency.barrier` — ``ensure_fresh``: the
+  deadline-bounded at-least-as-fresh barrier behind the ``snaptoken`` and
+  ``latest`` read modes; refuses with 412/FAILED_PRECONDITION instead of
+  answering from a stale snapshot.
+* :mod:`ketotpu.consistency.watch` — the change-watch hub behind the gRPC
+  ``WatchService.Watch`` stream and REST SSE ``GET /relation-tuples/watch``.
+"""
+
+from ketotpu.consistency.barrier import ensure_fresh
+from ketotpu.consistency.tokens import Snaptoken, decode, mint, try_decode
+from ketotpu.consistency.watch import (
+    DELTA,
+    HEARTBEAT,
+    RESYNC_REQUIRED,
+    Subscription,
+    WatchEvent,
+    WatchHub,
+)
+
+__all__ = [
+    "DELTA",
+    "HEARTBEAT",
+    "RESYNC_REQUIRED",
+    "Snaptoken",
+    "Subscription",
+    "WatchEvent",
+    "WatchHub",
+    "decode",
+    "ensure_fresh",
+    "mint",
+    "try_decode",
+]

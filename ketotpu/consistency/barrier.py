@@ -1,0 +1,124 @@
+"""The at-least-as-fresh freshness barrier (Zanzibar §2.4.1).
+
+``ensure_fresh(r, snaptoken, latest)`` is called by every read path
+(Check/Expand/List on both transports) before evaluating:
+
+* no token, no ``latest`` — returns immediately; the default read mode
+  stays minimize-latency and the barrier costs one branch.
+* ``latest`` — force a changelog drain into the engine before answering
+  (full consistency without a reprojection).
+* ``snaptoken`` — drain ``changes_since`` deltas into the engine until its
+  cursor is >= the token's cursor, polling under the request's deadline
+  budget (``ketotpu/deadline.py``, falling back to
+  ``consistency.barrier_timeout_ms``).  If the budget expires first the
+  read is REFUSED — :class:`StaleSnapshotError` (412 / FAILED_PRECONDITION)
+  plus a ``keto_stale_reads_refused_total`` bump — rather than answered
+  from the old snapshot; that refusal is what closes the "new enemy"
+  window.
+
+Worker processes don't own the device engine, so their
+``RemoteCheckEngine`` carries a ``consistency_barrier`` method that
+forwards token + mode over the wire to the device owner; a refusal comes
+back as the same typed error through the wire-error path.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from ketotpu import deadline
+from ketotpu.api.types import StaleSnapshotError
+from ketotpu.consistency.tokens import Snaptoken, decode
+
+_DEFAULT_TIMEOUT_MS = 2000
+_DEFAULT_POLL_MS = 5
+
+
+def ensure_fresh(
+    r,
+    snaptoken: Optional[str] = None,
+    latest: bool = False,
+    *,
+    op: str = "check",
+    use_engine: bool = True,
+) -> Optional[Snaptoken]:
+    """Block until the serving state is at least as fresh as ``snaptoken``
+    (and/or fully drained when ``latest``).  ``use_engine=False`` is the
+    list path: rows are read straight from the store, so only the store's
+    changelog head has to cover the token."""
+    if not snaptoken and not latest:
+        return None  # default mode: zero work on the fast path
+
+    engine = r.check_engine() if use_engine else None
+    forward = getattr(engine, "consistency_barrier", None)
+    if forward is not None:
+        # worker process: the device owner runs the barrier
+        forward(snaptoken=snaptoken, latest=latest, op=op)
+        return decode(snaptoken) if snaptoken else None
+
+    token = decode(snaptoken) if snaptoken else None
+    drain = getattr(engine, "snapshot", None) if engine is not None else None
+    if drain is not None:
+        drain()  # both modes start from a drained engine
+    if token is None:
+        return None  # latest-only: one drain is the whole contract
+
+    store = r.store()
+    budget = deadline.remaining()
+    if budget is None:
+        budget = _cfg_ms(r, "consistency.barrier_timeout_ms",
+                         _DEFAULT_TIMEOUT_MS) / 1000.0
+    poll = _cfg_ms(r, "consistency.barrier_poll_ms", _DEFAULT_POLL_MS) / 1000.0
+    give_up = time.monotonic() + max(budget, 0.0)
+    t0 = time.perf_counter()
+    while True:
+        if _satisfied(token, engine, store):
+            r.metrics().observe(
+                "keto_freshness_barrier_seconds",
+                time.perf_counter() - t0,
+                help="time spent draining to satisfy a snaptoken barrier",
+                op=op,
+            )
+            return token
+        if time.monotonic() >= give_up:
+            r.metrics().counter(
+                "keto_stale_reads_refused_total", 1,
+                help="reads refused because the snapshot could not reach"
+                     " the client's snaptoken within the deadline budget",
+                op=op,
+            )
+            raise StaleSnapshotError(
+                "snapshot is not as fresh as the supplied snaptoken"
+                f" (need changelog cursor >= {token.cursor}, store version"
+                f" >= {token.version}); retry or drop the token"
+            )
+        time.sleep(poll)
+        if drain is not None:
+            drain()
+
+
+def _satisfied(token: Snaptoken, engine, store) -> bool:
+    if engine is not None:
+        cursors = getattr(engine, "consistency_cursors", None)
+        if cursors is not None:
+            cur = cursors()
+            if token.shards and len(token.shards) == len(cur):
+                # mesh path: elementwise per-shard comparison
+                return all(c >= s for c, s in zip(cur, token.shards))
+            if token.cursor >= 0:
+                return min(cur) >= token.cursor
+            # legacy version-only token: a drained engine is exactly as
+            # fresh as the store, so the store version answers for it
+            return store.version >= token.version
+        # engine without a drain cursor (oracle) reads the store live
+    if token.cursor >= 0:
+        return store.log_head >= token.cursor
+    return store.version >= token.version
+
+
+def _cfg_ms(r, key: str, default: int) -> float:
+    try:
+        return float(r.config.get(key, default))
+    except (TypeError, ValueError, AttributeError):
+        return float(default)

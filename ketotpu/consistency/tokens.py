@@ -1,0 +1,108 @@
+"""Structured snaptokens (Zanzibar zookies, Pang et al. §2.4).
+
+The reference stubs its snaptoken surface ("not yet implemented",
+check/handler.go:329); earlier PRs here minted the ad-hoc string
+``v{store_version}``.  This module replaces that with a real, versioned
+token that captures everything the freshness barrier and the Watch API
+need to reason about staleness:
+
+    version  store write version the token was minted at
+    cursor   absolute changelog position (store.log_head) — the unit the
+             engine's ``changes_since`` drain advances through
+    epoch    device-engine snapshot epoch (rebuild count) at mint time
+    shards   per-shard cursor vector for the mesh path; today the mesh
+             drains all shards in lockstep so the entries are equal, but
+             the vector is the wire contract that lets shards diverge
+
+On the wire the token is opaque base64url over a compact JSON object with
+a format tag::
+
+    {"v": 1, "sv": <version>, "c": <cursor>, "e": <epoch>, "sh": [...]}
+
+Decoding is forward-compatible: unknown fields are ignored, and a future
+format tag only needs ``sv``/``c`` to stay readable.  The legacy ``v{N}``
+strings minted before this subsystem existed still decode (version-only,
+no cursor).  Malformed tokens raise :class:`BadRequestError` — a client
+bug, not staleness.
+"""
+
+from __future__ import annotations
+
+import base64
+import binascii
+import json
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+from ketotpu.api.types import BadRequestError
+
+# format tag for the current wire layout; bump when the JSON shape changes
+# incompatibly (decode only requires sv/c, so additive changes don't)
+_FORMAT = 1
+
+
+@dataclass(frozen=True)
+class Snaptoken:
+    """A decoded consistency token.  ``cursor < 0`` means the token carries
+    no changelog position (legacy ``v{N}``) and only the store version can
+    be compared."""
+
+    version: int
+    cursor: int = -1
+    epoch: int = 0
+    shards: Tuple[int, ...] = ()
+
+    def encode(self) -> str:
+        payload = {"v": _FORMAT, "sv": self.version, "c": self.cursor,
+                   "e": self.epoch}
+        if self.shards:
+            payload["sh"] = list(self.shards)
+        raw = json.dumps(payload, separators=(",", ":")).encode()
+        return base64.urlsafe_b64encode(raw).decode().rstrip("=")
+
+
+def decode(token: str) -> Snaptoken:
+    """Parse a wire snaptoken; raises BadRequestError when it is not a
+    token at all (undecodable), never when it is merely old or stale."""
+    if not isinstance(token, str) or not token:
+        raise BadRequestError("malformed snaptoken: empty")
+    if token.startswith("v") and token[1:].isdigit():
+        # legacy ad-hoc token from pre-subsystem writes: version only
+        return Snaptoken(version=int(token[1:]))
+    try:
+        raw = base64.urlsafe_b64decode(token + "=" * (-len(token) % 4))
+        payload = json.loads(raw.decode())
+    except (binascii.Error, ValueError, UnicodeDecodeError):
+        raise BadRequestError("malformed snaptoken") from None
+    if not isinstance(payload, dict) or not isinstance(payload.get("sv"), int):
+        raise BadRequestError("malformed snaptoken: no store version")
+    shards = payload.get("sh") or ()
+    if shards and not all(isinstance(s, int) for s in shards):
+        raise BadRequestError("malformed snaptoken: bad shard vector")
+    return Snaptoken(
+        version=payload["sv"],
+        cursor=payload["c"] if isinstance(payload.get("c"), int) else -1,
+        epoch=payload["e"] if isinstance(payload.get("e"), int) else 0,
+        shards=tuple(shards),
+    )
+
+
+def mint(store, engine=None) -> Snaptoken:
+    """Mint a token for the store's current state.  ``engine`` is the local
+    device engine when this process owns one (contributes snapshot epoch +
+    shard vector); worker processes mint from the shared store alone."""
+    version = store.version
+    cursor = store.log_head
+    epoch = 0
+    shards: Tuple[int, ...] = ()
+    if engine is not None:
+        epoch = int(getattr(engine, "rebuilds", 0))
+        n = int(getattr(engine, "n_shards", 0) or 0)
+        if n > 1:
+            shards = (cursor,) * n
+    return Snaptoken(version=version, cursor=cursor, epoch=epoch,
+                     shards=shards)
+
+
+def try_decode(token: Optional[str]) -> Optional[Snaptoken]:
+    return decode(token) if token else None

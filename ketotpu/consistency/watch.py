@@ -1,0 +1,304 @@
+"""Change-watch hub: the Zanzibar Watch API (Pang et al. §2.4.3) over the
+bounded changelog the stores already keep for the engine drain.
+
+One :class:`WatchHub` per registry fans the store's changelog out to many
+subscribers.  The write path is never blocked: the store's change listener
+only sets an event that wakes a dedicated pump thread, which reads
+``changes_since`` and pushes :class:`WatchEvent` deltas into bounded
+per-subscriber queues.  A subscriber that falls a full queue behind is
+dropped — its queue is cleared and replaced with a terminal
+``resync_required`` marker — rather than ever applying backpressure to
+writers.
+
+Resume semantics: ``subscribe(snaptoken=...)`` replays the changelog
+suffix after the token's cursor, then splices the subscriber into the live
+feed with no gap and no duplicates.  When the bounded log has already
+evicted the cursor the stream consists of exactly one terminal
+``resync_required`` event — a silent gap is never possible.
+
+Lock order is hub -> store everywhere; the store fires listeners under its
+own lock, which is why the listener must not touch the hub lock (it only
+sets ``threading.Event``).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Iterator, List, Optional, Tuple
+
+from ketotpu.api.types import BadRequestError, TooManyRequestsError
+from ketotpu.consistency.tokens import Snaptoken, decode
+
+# event kinds (wire values for both the gRPC `event` field and SSE `event:`)
+DELTA = "delta"
+HEARTBEAT = "heartbeat"
+RESYNC_REQUIRED = "resync_required"
+
+
+class WatchEvent:
+    __slots__ = ("kind", "action", "tuple", "snaptoken")
+
+    def __init__(self, kind: str, action: Optional[str] = None,
+                 tuple_=None, snaptoken: str = ""):
+        self.kind = kind
+        self.action = action  # "insert" | "delete" for deltas
+        self.tuple = tuple_
+        self.snaptoken = snaptoken  # resume cursor after this event
+
+
+class Subscription:
+    """One consumer's bounded queue.  ``_push`` runs on the hub's pump
+    thread; ``events`` runs on the consumer's (transport) thread."""
+
+    def __init__(self, hub: "WatchHub", cap: int):
+        self._hub = hub
+        self._cap = max(int(cap), 1)
+        self._cond = threading.Condition()
+        self._queue: deque = deque()
+        self._terminal = False  # a resync marker is queued; nothing follows
+        self._closed = False
+
+    def _push(self, ev: WatchEvent) -> bool:
+        """Enqueue from the pump; returns False when the event was refused
+        (closed/terminal) or displaced the whole queue (slow consumer)."""
+        with self._cond:
+            if self._terminal or self._closed:
+                return False
+            if ev.kind == RESYNC_REQUIRED:
+                self._queue.append(ev)
+                self._terminal = True
+                self._cond.notify()
+                return True
+            if len(self._queue) >= self._cap:
+                # slow consumer: drop everything it hasn't read and leave
+                # a terminal resync marker — never a silent gap, never
+                # backpressure on the write path
+                self._queue.clear()
+                self._queue.append(WatchEvent(
+                    RESYNC_REQUIRED, snaptoken=ev.snaptoken))
+                self._terminal = True
+                self._cond.notify()
+                return False
+            self._queue.append(ev)
+            self._cond.notify()
+            return True
+
+    def events(self, heartbeat_s: float = 15.0) -> Iterator[WatchEvent]:
+        """Yield events until the stream ends (terminal resync or close);
+        emits a heartbeat when nothing arrives for ``heartbeat_s``."""
+        while True:
+            with self._cond:
+                if not self._queue and not self._closed:
+                    self._cond.wait(heartbeat_s)
+                if self._queue:
+                    ev = self._queue.popleft()
+                elif self._closed:
+                    return
+                else:
+                    ev = WatchEvent(
+                        HEARTBEAT, snaptoken=self._hub.current_token())
+            yield ev
+            if ev.kind == RESYNC_REQUIRED:
+                return
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+
+class WatchHub:
+    def __init__(
+        self,
+        store,
+        *,
+        metrics=None,
+        queue_cap: int = 1024,
+        max_subscribers: int = 256,
+    ):
+        self.store = store
+        self.metrics = metrics
+        self.queue_cap = int(queue_cap)
+        self.max_subscribers = int(max_subscribers)
+        self._lock = threading.RLock()
+        self._subs: List[Tuple[Subscription, Optional[str]]] = []
+        self._cursor = store.log_head  # hub's drained changelog position
+        self._tick = threading.Event()
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        # never touch the hub lock here: listeners fire under the store lock
+        store.on_change(lambda _v: self._tick.set())
+
+    # -- public API ----------------------------------------------------------
+
+    def subscribe(
+        self,
+        snaptoken: Optional[str] = None,
+        namespace: Optional[str] = None,
+    ) -> Subscription:
+        """Register a subscriber; replays the changelog suffix after
+        ``snaptoken`` first so resume sees every missed delta in order."""
+        with self._lock:
+            if len(self._subs) >= self.max_subscribers:
+                self._count("keto_watch_rejected_total",
+                            reason="subscriber_limit")
+                raise TooManyRequestsError(
+                    f"watch subscriber limit reached"
+                    f" ({self.max_subscribers}); raise watch.max_subscribers"
+                )
+            self._ensure_thread()
+            self._pump_locked()  # bring the hub cursor to the store head
+            sub = Subscription(self, self.queue_cap)
+            if snaptoken:
+                token = decode(snaptoken)
+                if token.cursor < 0:
+                    raise BadRequestError(
+                        "snaptoken carries no changelog cursor; watch resume"
+                        " needs a token minted by this version"
+                    )
+                if not self._replay_locked(sub, token, namespace):
+                    # cursor evicted from the bounded log: terminal resync
+                    self._count("keto_watch_resyncs_total", reason="evicted")
+                    return sub  # never registered; stream is one event long
+            self._subs.append((sub, namespace or None))
+            self._gauge()
+            self._count("keto_watch_subscribes_total")
+            return sub
+
+    def unsubscribe(self, sub: Subscription) -> None:
+        with self._lock:
+            self._subs = [(s, ns) for (s, ns) in self._subs if s is not sub]
+            self._gauge()
+        sub.close()
+
+    def current_token(self) -> str:
+        """Resume token for "now" (used by heartbeats)."""
+        return Snaptoken(
+            version=self.store.version, cursor=self.store.log_head
+        ).encode()
+
+    def close(self) -> None:
+        with self._lock:
+            self._stop = True
+            self._tick.set()
+            subs, self._subs = self._subs, []
+        for s, _ns in subs:
+            s.close()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2.0)
+
+    # -- pump ----------------------------------------------------------------
+
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._run, name="keto-watch-pump", daemon=True
+            )
+            self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop:
+            self._tick.wait(0.5)
+            self._tick.clear()
+            if self._stop:
+                return
+            with self._lock:
+                self._pump_locked()
+
+    def _pump_locked(self) -> None:
+        changes, head = self.store.changes_since(self._cursor)
+        if changes is None:
+            # the hub itself fell behind the bounded log (no pump ran while
+            # the cap's worth of writes landed): every subscriber must
+            # resync — the missed deltas are unrecoverable
+            for sub, _ns in self._subs:
+                sub._push(WatchEvent(
+                    RESYNC_REQUIRED, snaptoken=self.current_token()))
+            if self._subs:
+                self._count("keto_watch_resyncs_total", reason="hub_lagged")
+            self._subs = []
+            self._gauge()
+            self._cursor = head
+            return
+        if not changes:
+            self._cursor = head
+            return
+        version = self.store.version
+        dropped = 0
+        for i, (op, t) in enumerate(changes):
+            ev = WatchEvent(
+                DELTA,
+                action="insert" if op > 0 else "delete",
+                tuple_=t,
+                snaptoken=Snaptoken(
+                    version=version, cursor=self._cursor + i + 1
+                ).encode(),
+            )
+            for sub, ns in self._subs:
+                if ns is not None and t.namespace != ns:
+                    continue
+                if not sub._push(ev):
+                    dropped += 1
+        self._cursor = head
+        self._count("keto_watch_events_total", n=len(changes))
+        if dropped:
+            self._count("keto_watch_dropped_total", n=dropped)
+            # detach terminal subscribers so the pump stops pushing at them
+            self._subs = [
+                (s, ns) for (s, ns) in self._subs if not s._terminal
+            ]
+            self._gauge()
+
+    def _replay_locked(
+        self, sub: Subscription, token: Snaptoken, namespace: Optional[str]
+    ) -> bool:
+        """Queue the changelog suffix (token.cursor, hub cursor].  Returns
+        False when the bounded log no longer covers the cursor (the caller
+        emits the terminal resync)."""
+        if token.cursor >= self._cursor:
+            return True  # nothing missed (incl. tokens from the future)
+        changes, _head = self.store.changes_since(token.cursor)
+        if changes is None:
+            sub._push(WatchEvent(
+                RESYNC_REQUIRED, snaptoken=self.current_token()))
+            return False
+        # the store head may have advanced past the hub cursor between the
+        # pump above and this read; replay only up to the hub cursor — the
+        # live feed owns everything after it (no duplicates)
+        version = self.store.version
+        for i, (op, t) in enumerate(changes[: self._cursor - token.cursor]):
+            if namespace is not None and t.namespace != namespace:
+                continue
+            sub._push(WatchEvent(
+                DELTA,
+                action="insert" if op > 0 else "delete",
+                tuple_=t,
+                snaptoken=Snaptoken(
+                    version=version, cursor=token.cursor + i + 1
+                ).encode(),
+            ))
+        return True
+
+    # -- metrics -------------------------------------------------------------
+
+    def _count(self, name: str, n: float = 1, **labels) -> None:
+        if self.metrics is not None:
+            helps = {
+                "keto_watch_events_total": "changelog deltas fanned out to watch subscribers",
+                "keto_watch_dropped_total": "slow watch subscribers dropped with a resync marker",
+                "keto_watch_resyncs_total": "terminal resync_required events emitted",
+                "keto_watch_subscribes_total": "watch subscriptions accepted",
+                "keto_watch_rejected_total": "watch subscriptions refused",
+            }
+            self.metrics.counter(
+                name, float(n), help=helps.get(name, name), **labels
+            )
+
+    def _gauge(self) -> None:
+        if self.metrics is not None:
+            self.metrics.gauge(
+                "keto_watch_subscribers", float(len(self._subs)),
+                help="active watch subscribers",
+            )

@@ -133,6 +133,23 @@ def _defaults() -> Dict[str, Any]:
             "rebuild_delta_pairs": 4096,
             "rebuild_dirty_sets": 512,
         },
+        # consistency subsystem (ketotpu/consistency/): the snaptoken
+        # freshness barrier's budget when the request carries no deadline
+        # of its own, and how often the barrier re-drains while waiting
+        "consistency": {
+            "barrier_timeout_ms": 2000,
+            "barrier_poll_ms": 5,
+        },
+        # Watch API fan-out: per-subscriber event queue bound (a consumer
+        # that falls a full queue behind is dropped with a resync marker),
+        # the subscriber cap (watch streams are exempt from in-flight
+        # admission control, this cap bounds them instead), and the idle
+        # heartbeat cadence
+        "watch": {
+            "queue_cap": 1024,
+            "max_subscribers": 256,
+            "heartbeat_ms": 15000,
+        },
         # request_log: per-request access lines (REST middleware + gRPC
         # interceptor) at INFO; benches disable it to keep stderr quiet
         "log": {"level": "info", "format": "text", "request_log": True},
@@ -225,7 +242,9 @@ class Provider:
                           "sniff_timeout_ms", "device_error_rate",
                           "device_stall_ms", "socket_drop_rate",
                           "latency_ms", "latency_rate", "max_pairs",
-                          "rebuild_delta_pairs", "rebuild_dirty_sets"):
+                          "rebuild_delta_pairs", "rebuild_dirty_sets",
+                          "barrier_timeout_ms", "barrier_poll_ms",
+                          "queue_cap", "max_subscribers", "heartbeat_ms"):
                 suffix = known.split("_")
                 if len(joined) > len(suffix) and joined[-len(suffix):] == suffix:
                     joined = joined[: -len(suffix)] + [known]
@@ -377,6 +396,15 @@ class Provider:
         for key in ("limit.max_inflight", "limit.request_timeout_ms",
                     "limit.sniff_timeout_ms"):
             val = self.get(key)
+            if not isinstance(val, int) or val < 0:
+                raise ConfigError(
+                    key, f"must be a non-negative integer, got {val!r}"
+                )
+        for key in ("consistency.barrier_timeout_ms",
+                    "consistency.barrier_poll_ms",
+                    "watch.queue_cap", "watch.max_subscribers",
+                    "watch.heartbeat_ms"):
+            val = self.get(key, 0)
             if not isinstance(val, int) or val < 0:
                 raise ConfigError(
                     key, f"must be a non-negative integer, got {val!r}"

@@ -79,6 +79,7 @@ class Registry:
         self._expand_engine = None
         self._list_engine = None
         self._oracle_engine = None
+        self._watch_hub = None
         self._flight_recorder = None
         self._admission = None
         self._mapper = None
@@ -226,7 +227,54 @@ class Registry:
         with self._lock:
             if self._store is None:
                 self._store = self._build_store(str(self.network_id))
+            self._wire_overflow(self._store)
             return self._store
+
+    def _wire_overflow(self, store) -> None:
+        """Surface bounded-changelog eviction (instead of readers silently
+        full-rebuilding): keto_changelog_overflow_total counts evicted
+        entries, and the log warns once per overflow episode.  Idempotent;
+        also covers stores injected via the constructor."""
+        if getattr(store, "overflow_hook", "absent") is not None:
+            return  # store has no hook seam, or one is already installed
+        metrics, logger = self.metrics(), self.logger()
+
+        def hook(n: int, first: bool) -> None:
+            metrics.counter(
+                "keto_changelog_overflow_total", float(n),
+                help="bounded change-log entries evicted before every"
+                     " reader drained them",
+            )
+            if first:
+                logger.warning(
+                    "change log overflowed (cap reached): %d entries"
+                    " evicted; lagging readers and watch resumes will"
+                    " need a full rebuild/resync", n,
+                )
+
+        store.overflow_hook = hook
+
+    def watch_hub(self):
+        """Lazy change-watch hub (ketotpu/consistency/watch.py) over this
+        registry's store — shared by the gRPC WatchService stream and the
+        REST SSE route.  Watch streams are exempt from in-flight admission
+        control (a stream parked on a heartbeat would pin a slot forever);
+        the hub's own ``watch.max_subscribers`` cap bounds them instead."""
+        with self._lock:
+            if self._watch_hub is None:
+                from ketotpu.consistency.watch import WatchHub
+
+                self._watch_hub = WatchHub(
+                    self.store(),
+                    metrics=self.metrics(),
+                    queue_cap=int(
+                        self.config.get("watch.queue_cap", 1024) or 1024
+                    ),
+                    max_subscribers=int(
+                        self.config.get("watch.max_subscribers", 256) or 256
+                    ),
+                )
+            return self._watch_hub
 
     def _build_store(self, nid: str):
         """One dsn-dispatch path for the default network and every tenant
@@ -689,7 +737,10 @@ class Registry:
             engines = [self._check_engine] + [
                 t._check_engine for t in self._tenants.values()
             ]
-        for eng in engines:
+            hubs = [self._watch_hub] + [
+                t._watch_hub for t in self._tenants.values()
+            ]
+        for eng in engines + hubs:
             close = getattr(eng, "close", None)
             if close is not None:
                 try:

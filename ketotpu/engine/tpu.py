@@ -511,6 +511,14 @@ class DeviceCheckEngine:
         with self._sync_lock:
             self._rebuild(config_fingerprint(self.namespace_manager))
 
+    def consistency_cursors(self) -> tuple:
+        """Drained changelog cursor(s) for the freshness barrier
+        (ketotpu/consistency/barrier.py): the serving state covers every
+        store delta at positions <= the cursor.  One entry here; the mesh
+        engine overrides with a per-shard vector."""
+        with self._sync_lock:
+            return (self._log_cursor,)
+
     # -- checkpoint / resume (SURVEY §5.4) ----------------------------------
 
     def save_checkpoint(self, path: str) -> None:

@@ -419,6 +419,17 @@ class MeshCheckEngine(DeviceCheckEngine):
             np.add.at(self._shard_fallbacks, shards, 1)
         return allowed, fallback
 
+    def consistency_cursors(self) -> tuple:
+        """Per-shard drained-cursor vector for the freshness barrier and
+        the shard field of minted snaptokens.  Today the mesh drains the
+        shared changelog in lockstep (one ``changes_since`` call routes
+        deltas to every shard overlay inside the same ``_sync_lock``
+        section), so all entries are equal — but the vector is the
+        wire/API contract that lets a future per-shard drain diverge
+        without changing any caller."""
+        with self._sync_lock:
+            return (self._log_cursor,) * self.n_shards
+
     def shard_stats(self) -> List[dict]:
         """Per-shard serving counters for the registry's mesh gauges and
         `cli.py status`: overlay pressure, graph size, last general

@@ -24,5 +24,6 @@ from ory.keto.relation_tuples.v1alpha2 import (  # noqa: E402,F401
     read_service_pb2,
     relation_tuples_pb2,
     version_pb2,
+    watch_service_pb2,
     write_service_pb2,
 )

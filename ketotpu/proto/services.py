@@ -31,6 +31,7 @@ from ketotpu.proto import (
     read_service_pb2,
     syntax_service_pb2,
     version_pb2,
+    watch_service_pb2,
     write_service_pb2,
 )
 
@@ -62,6 +63,16 @@ SERVICES: Dict[str, Dict[str, Tuple[Type, Type]]] = {
         "ListSubjects": (
             read_service_pb2.ListRelationTuplesRequest,
             read_service_pb2.ListRelationTuplesResponse,
+        ),
+    },
+    f"{_RTS}.WatchService": {
+        # EXTENSION: the Zanzibar Watch API (no reference analog at this
+        # version) — server-streaming change feed with snaptoken resume
+        # (proto/ory/keto/relation_tuples/v1alpha2/watch_service.proto)
+        "Watch": (
+            watch_service_pb2.WatchRelationTuplesRequest,
+            watch_service_pb2.WatchRelationTuplesResponse,
+            "server_stream",
         ),
     },
     f"{_RTS}.WriteService": {
@@ -156,6 +167,7 @@ def _stub_class(service: str) -> Callable[[grpc.Channel], _Stub]:
 CheckServiceStub = _stub_class(f"{_RTS}.CheckService")
 ExpandServiceStub = _stub_class(f"{_RTS}.ExpandService")
 ReadServiceStub = _stub_class(f"{_RTS}.ReadService")
+WatchServiceStub = _stub_class(f"{_RTS}.WatchService")
 WriteServiceStub = _stub_class(f"{_RTS}.WriteService")
 NamespacesServiceStub = _stub_class(f"{_RTS}.NamespacesService")
 VersionServiceStub = _stub_class(f"{_RTS}.VersionService")
@@ -164,6 +176,7 @@ SyntaxServiceStub = _stub_class(f"{_OPL}.SyntaxService")
 CHECK_SERVICE = f"{_RTS}.CheckService"
 EXPAND_SERVICE = f"{_RTS}.ExpandService"
 READ_SERVICE = f"{_RTS}.ReadService"
+WATCH_SERVICE = f"{_RTS}.WatchService"
 WRITE_SERVICE = f"{_RTS}.WriteService"
 NAMESPACES_SERVICE = f"{_RTS}.NamespacesService"
 VERSION_SERVICE = f"{_RTS}.VersionService"

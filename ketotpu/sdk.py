@@ -33,6 +33,7 @@ from ketotpu.api.types import (
     NotFoundError,
     RelationQuery,
     RelationTuple,
+    StaleSnapshotError,
     Subject,
     SubjectSet,
     Tree,
@@ -62,6 +63,10 @@ class KetoClient:
         self.write_url = (write_url or read_url).rstrip("/")
         self.opl_url = (opl_url or read_url).rstrip("/")
         self.timeout = timeout
+        #: snaptoken minted by the most recent write on this client
+        #: (X-Keto-Snaptoken response header); feed it back into
+        #: ``check(..., snaptoken=...)`` for read-your-writes
+        self.last_snaptoken: Optional[str] = None
 
     # -- transport ----------------------------------------------------------
 
@@ -77,6 +82,9 @@ class KetoClient:
         )
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                token = resp.headers.get("X-Keto-Snaptoken")
+                if token:
+                    self.last_snaptoken = token
                 return resp.status, resp.read().decode()
         except urllib.error.HTTPError as e:
             return e.code, e.read().decode()
@@ -87,6 +95,8 @@ class KetoClient:
             raise BadRequestError(_error_message(body))
         if status == 404:
             raise NotFoundError(_error_message(body))
+        if status == 412:
+            raise StaleSnapshotError(_error_message(body))
         raise SDKError(status, body)
 
     # -- check --------------------------------------------------------------
@@ -99,14 +109,25 @@ class KetoClient:
         subject: Subject,
         *,
         max_depth: int = 0,
+        snaptoken: Optional[str] = None,
+        latest: bool = False,
     ) -> bool:
         """Permission check via the non-mirroring openapi variant
         (`getCheckNoStatus`, check/handler.go:156): unknown namespace is
-        ``False``, not an error."""
+        ``False``, not an error.
+
+        ``snaptoken`` requests an at-least-as-fresh read (the server
+        raises :class:`StaleSnapshotError` if it cannot catch up in the
+        request budget); ``latest=True`` forces a fully fresh read."""
         r = RelationTuple(namespace, object, relation, subject)
-        q = urllib.parse.urlencode(
-            dict(r.to_url_query(), **({"max-depth": str(max_depth)} if max_depth else {}))
-        )
+        params = dict(r.to_url_query())
+        if max_depth:
+            params["max-depth"] = str(max_depth)
+        if snaptoken:
+            params["snaptoken"] = snaptoken
+        if latest:
+            params["latest"] = "true"
+        q = urllib.parse.urlencode(params)
         status, body = self._request(
             "GET", f"{self.read_url}/relation-tuples/check/openapi?{q}"
         )
@@ -114,20 +135,40 @@ class KetoClient:
             self._raise_for(status, body)
         return bool(json.loads(body)["allowed"])
 
-    def check_tuple(self, t: RelationTuple, *, max_depth: int = 0) -> bool:
+    def check_tuple(
+        self,
+        t: RelationTuple,
+        *,
+        max_depth: int = 0,
+        snaptoken: Optional[str] = None,
+        latest: bool = False,
+    ) -> bool:
         return self.check(
-            t.namespace, t.object, t.relation, t.subject, max_depth=max_depth
+            t.namespace, t.object, t.relation, t.subject,
+            max_depth=max_depth, snaptoken=snaptoken, latest=latest,
         )
 
     def batch_check(
-        self, tuples: Sequence[RelationTuple], *, max_depth: int = 0
+        self,
+        tuples: Sequence[RelationTuple],
+        *,
+        max_depth: int = 0,
+        snaptoken: Optional[str] = None,
+        latest: bool = False,
     ) -> List[bool]:
         """Many checks in one request (extension endpoint
         POST /relation-tuples/check/batch; the TPU engine answers the whole
         list in fused device dispatches)."""
-        url = f"{self.read_url}/relation-tuples/check/batch"
+        params = {}
         if max_depth:
-            url += f"?max-depth={max_depth}"
+            params["max-depth"] = str(max_depth)
+        if snaptoken:
+            params["snaptoken"] = snaptoken
+        if latest:
+            params["latest"] = "true"
+        url = f"{self.read_url}/relation-tuples/check/batch"
+        if params:
+            url += "?" + urllib.parse.urlencode(params)
         status, body = self._request(
             "POST", url, {"tuples": [t.to_json() for t in tuples]}
         )
@@ -138,7 +179,12 @@ class KetoClient:
     # -- expand -------------------------------------------------------------
 
     def expand(
-        self, subject_set: SubjectSet, *, max_depth: int = 0
+        self,
+        subject_set: SubjectSet,
+        *,
+        max_depth: int = 0,
+        snaptoken: Optional[str] = None,
+        latest: bool = False,
     ) -> Optional[Tree]:
         params = {
             "namespace": subject_set.namespace,
@@ -147,6 +193,10 @@ class KetoClient:
         }
         if max_depth:
             params["max-depth"] = str(max_depth)
+        if snaptoken:
+            params["snaptoken"] = snaptoken
+        if latest:
+            params["latest"] = "true"
         q = urllib.parse.urlencode(params)
         status, body = self._request(
             "GET", f"{self.read_url}/relation-tuples/expand?{q}"
@@ -297,6 +347,69 @@ class KetoClient:
         )
         if status != 204:
             self._raise_for(status, out)
+
+    # -- watch --------------------------------------------------------------
+
+    def watch(
+        self,
+        *,
+        snaptoken: Optional[str] = None,
+        namespace: Optional[str] = None,
+        heartbeats: bool = False,
+    ):
+        """Stream relation-tuple changes (GET /relation-tuples/watch,
+        server-sent events).  Yields dicts shaped like::
+
+            {"event": "delta", "action": "insert",
+             "relation_tuple": {...}, "snaptoken": "..."}
+
+        ``snaptoken`` resumes from a previous position, replaying every
+        change after it.  The stream ends after a terminal
+        ``resync_required`` event (the cursor fell off the bounded
+        changelog — re-list and subscribe fresh).  Heartbeat events are
+        suppressed unless ``heartbeats=True``.  Iterate and ``close()``
+        the returned generator (or break out of the loop) to detach."""
+        params = {}
+        if snaptoken:
+            params["snaptoken"] = snaptoken
+        if namespace:
+            params["namespace"] = namespace
+        url = f"{self.read_url}/relation-tuples/watch"
+        if params:
+            url += "?" + urllib.parse.urlencode(params)
+        req = urllib.request.Request(url, method="GET")
+        try:
+            resp = urllib.request.urlopen(req, timeout=self.timeout)
+        except urllib.error.HTTPError as e:
+            body = e.read().decode()
+            e.close()
+            self._raise_for(e.code, body)
+
+        def events():
+            event, data = None, None
+            try:
+                for raw in resp:
+                    line = raw.decode("utf-8").rstrip("\r\n")
+                    if line.startswith(":"):
+                        continue  # SSE comment / stream-open ping
+                    if line.startswith("event:"):
+                        event = line[6:].strip()
+                    elif line.startswith("data:"):
+                        data = line[5:].strip()
+                    elif line == "" and event is not None:
+                        out = json.loads(data) if data else {}
+                        out["event"] = event
+                        terminal = event == "resync_required"
+                        skip = event == "heartbeat" and not heartbeats
+                        event, data = None, None
+                        if not skip:
+                            yield out
+                        if terminal:
+                            return
+            finally:
+                resp.close()
+
+        return events()
 
     # -- opl ----------------------------------------------------------------
 

@@ -40,6 +40,7 @@ from ketotpu.proto.services import (
     READ_SERVICE,
     SYNTAX_SERVICE,
     VERSION_SERVICE,
+    WATCH_SERVICE,
     WRITE_SERVICE,
     add_servicer_to_server,
 )
@@ -51,6 +52,7 @@ from ketotpu.server.handlers import (
     RelationTupleHandler,
     SyntaxHandler,
     VersionHandler,
+    WatchHandler,
 )
 
 HEALTH_SERVICE = "grpc.health.v1.Health"
@@ -308,6 +310,7 @@ class Server:
         tuples = RelationTupleHandler(r)
         namespaces = NamespaceHandler(r)
         syntax = SyntaxHandler(r)
+        watch = WatchHandler(r)
 
         ports = {
             "read": (
@@ -315,6 +318,7 @@ class Server:
                     CHECK_SERVICE: check,
                     EXPAND_SERVICE: expand,
                     READ_SERVICE: tuples,
+                    WATCH_SERVICE: watch,
                     NAMESPACES_SERVICE: namespaces,
                     VERSION_SERVICE: version,
                     HEALTH_SERVICE: health,

@@ -27,7 +27,7 @@ import time
 
 import grpc
 
-from ketotpu import flightrec
+from ketotpu import consistency, flightrec
 from ketotpu.api.proto_codec import (
     query_from_proto,
     tree_to_proto,
@@ -56,6 +56,7 @@ from ketotpu.proto import (
     read_service_pb2,
     syntax_service_pb2,
     version_pb2,
+    watch_service_pb2,
     write_service_pb2,
 )
 
@@ -64,6 +65,7 @@ _GRPC_CODES = {
     403: grpc.StatusCode.PERMISSION_DENIED,
     404: grpc.StatusCode.NOT_FOUND,
     409: grpc.StatusCode.ALREADY_EXISTS,
+    412: grpc.StatusCode.FAILED_PRECONDITION,  # stale snaptoken (Zanzibar)
     429: grpc.StatusCode.RESOURCE_EXHAUSTED,
     500: grpc.StatusCode.INTERNAL,
     503: grpc.StatusCode.UNAVAILABLE,
@@ -114,12 +116,13 @@ class CheckHandler:
         return allowed
 
     def check_rest(
-        self, tuple_: RelationTuple, max_depth: int, headers=None
+        self, tuple_: RelationTuple, max_depth: int, headers=None,
+        *, snaptoken=None, latest=False,
     ) -> bool:
+        r = self.r.resolve(headers)
+        consistency.ensure_fresh(r, snaptoken, latest, op="check")
         try:
-            return self.check_core(
-                tuple_, max_depth, self.r.resolve(headers)
-            )
+            return self.check_core(tuple_, max_depth, r)
         except NotFoundError:
             return False  # check/handler.go:169-171
 
@@ -159,10 +162,12 @@ class CheckHandler:
         return out
 
     def snaptoken(self, r=None) -> str:
-        """A real snaptoken: the store version the verdict was computed at
-        (the Zanzibar zookie the reference stubs, check_service.proto:51-60)."""
+        """A real snaptoken (the Zanzibar zookie the reference stubs,
+        check_service.proto:51-60): store version + changelog cursor +
+        engine snapshot epoch + per-shard cursor vector, opaque base64 on
+        the wire (ketotpu/consistency/tokens.py)."""
         r = r if r is not None else self.r
-        return f"v{r.store().version}"
+        return consistency.mint(r.store(), r._device_engine()).encode()
 
     # gRPC CheckService.Check
     def Check(self, request, context):
@@ -177,16 +182,23 @@ class CheckHandler:
                 src = request.tuple if request.HasField("tuple") else request
                 tuple_ = tuple_from_proto(src)
                 flightrec.note_stage("parse", time.perf_counter() - t0)
-                if getattr(request, "latest", False):
-                    # CheckRequest.latest (check_service.proto:60-66):
-                    # evaluate against the freshest possible state.
-                    # snapshot() drains the change log into the write-exact
-                    # overlay; a full refresh() rebuild is stronger than
-                    # needed and would let any latest=true client stall all
-                    # traffic for a reprojection at 10M-tuple scale.
-                    sync = getattr(r.check_engine(), "snapshot", None)
-                    if sync is not None:
-                        sync()
+                if request.snaptoken or request.latest:
+                    # the consistency modes (check_service.proto:51-66):
+                    # `latest` forces a changelog drain into the engine's
+                    # write-exact overlay (a full refresh() rebuild is
+                    # stronger than needed and would let any latest=true
+                    # client stall all traffic for a reprojection at
+                    # 10M-tuple scale); `snaptoken` blocks until the
+                    # engine is at-least-as-fresh, refusing with
+                    # FAILED_PRECONDITION on budget expiry.
+                    tb = time.perf_counter()
+                    consistency.ensure_fresh(
+                        r, request.snaptoken or None, bool(request.latest),
+                        op="check",
+                    )
+                    flightrec.note_stage(
+                        "barrier", time.perf_counter() - tb
+                    )
                 t1 = time.perf_counter()
                 allowed = self.check_core(tuple_, int(request.max_depth), r)
                 flightrec.note_stage("compute", time.perf_counter() - t1)
@@ -241,6 +253,16 @@ class ExpandHandler:
                 s = request.subject.set
                 subject = SubjectSet(s.namespace, s.object, s.relation)
                 flightrec.note_stage("parse", time.perf_counter() - t0)
+                if request.snaptoken:
+                    # ExpandRequest.snaptoken (expand_service.proto): the
+                    # tree must be at-least-as-fresh as the token
+                    tb = time.perf_counter()
+                    consistency.ensure_fresh(
+                        r, request.snaptoken, op="expand"
+                    )
+                    flightrec.note_stage(
+                        "barrier", time.perf_counter() - tb
+                    )
                 t1 = time.perf_counter()
                 tree = self.expand_core(subject, int(request.max_depth), r)
                 flightrec.note_stage("compute", time.perf_counter() - t1)
@@ -316,9 +338,16 @@ class RelationTupleHandler:
                     query = query.with_subject(subject_from_proto(q.subject))
             else:
                 raise BadRequestError("you must provide a query")
+            r = self.r.resolve(_md(context))
+            if request.snaptoken:
+                # list rows come straight from the store, so only the
+                # store's changelog head must cover the token (no engine
+                # drain) — use_engine=False skips the device path
+                consistency.ensure_fresh(
+                    r, request.snaptoken, op="list", use_engine=False
+                )
             tuples, next_token = self.list_core(
-                query, int(request.page_size), request.page_token,
-                self.r.resolve(_md(context)),
+                query, int(request.page_size), request.page_token, r,
             )
             return read_service_pb2.ListRelationTuplesResponse(
                 relation_tuples=[tuple_to_proto(t) for t in tuples],
@@ -467,8 +496,16 @@ class RelationTupleHandler:
                     deletes.append(t)
             r = self.r.resolve(_md(context))
             self.transact_core(inserts, deletes, r)
+            # one token per requested delta — inserts AND deletes (the old
+            # code returned len(inserts) copies, so delete-only
+            # transactions got none and mixed ones the wrong count).  All
+            # deltas commit in one store transaction, so every token is
+            # the same post-commit cursor; per-entry attribution is
+            # ill-defined anyway (a delete may expand to several log rows,
+            # or none for a no-op).
+            token = consistency.mint(r.store(), r._device_engine()).encode()
             return write_service_pb2.TransactRelationTuplesResponse(
-                snaptokens=[f"v{r.store().version}"] * len(inserts)
+                snaptokens=[token] * (len(inserts) + len(deletes))
             )
         except Exception as e:  # noqa: BLE001
             _abort(context, e)
@@ -554,3 +591,58 @@ class VersionHandler:
 
     def GetVersion(self, request, context):
         return version_pb2.GetVersionResponse(version=self.r.version)
+
+
+class WatchHandler:
+    """WatchService servicer: the Zanzibar Watch API
+    (ketotpu/consistency/watch.py) as a gRPC server-stream on the read
+    port.  Streaming handlers pass through both interceptors untouched
+    (server/interceptors.py), so this RPC is exempt from in-flight
+    admission control BY DESIGN — a stream parked on a heartbeat would
+    pin an admission slot forever; the hub's watch.max_subscribers cap
+    bounds subscribers instead (excess subscribes abort with
+    RESOURCE_EXHAUSTED)."""
+
+    def __init__(self, registry):
+        self.r = registry
+
+    def Watch(self, request, context):
+        try:
+            md = _md(context)
+            r = self.r.resolve(md)
+            hub = r.watch_hub()
+            with flightrec.rpc_recording(
+                r, "watch", traceparent=md.get("traceparent"),
+                detail="grpc Watch",
+            ):
+                # the recorded stage is subscription setup (decode the
+                # resume token + replay the missed changelog suffix into
+                # the queue); the tail of the stream is unbounded and
+                # lives outside the record
+                t0 = time.perf_counter()
+                sub = hub.subscribe(
+                    snaptoken=request.snaptoken or None,
+                    namespace=request.namespace or None,
+                )
+                flightrec.note_stage("parse", time.perf_counter() - t0)
+                flightrec.note(resume=bool(request.snaptoken))
+        except Exception as e:  # noqa: BLE001 - mapped to status codes
+            _abort(context, e)
+            return
+        heartbeat_s = float(
+            self.r.config.get("watch.heartbeat_ms", 15000) or 15000
+        ) / 1000.0
+        try:
+            for ev in sub.events(heartbeat_s):
+                if not context.is_active():
+                    break
+                resp = watch_service_pb2.WatchRelationTuplesResponse(
+                    event=ev.kind,
+                    action=ev.action or "",
+                    snaptoken=ev.snaptoken or "",
+                )
+                if ev.tuple is not None:
+                    resp.relation_tuple.CopyFrom(tuple_to_proto(ev.tuple))
+                yield resp
+        finally:
+            hub.unsubscribe(sub)

@@ -30,7 +30,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlencode, urlparse
 
-from ketotpu import deadline, flightrec
+from ketotpu import consistency, deadline, flightrec
 from ketotpu.api.types import (
     BadRequestError,
     KetoAPIError,
@@ -45,6 +45,7 @@ _STATUS_TEXT = {
     403: "Forbidden",
     404: "Not Found",
     405: "Method Not Allowed",
+    412: "Precondition Failed",
     429: "Too Many Requests",
     500: "Internal Server Error",
     503: "Service Unavailable",
@@ -52,9 +53,14 @@ _STATUS_TEXT = {
 }
 
 # requests that must work even when admission control is shedding: probes
-# and scrapes are how operators see the overload
+# and scrapes are how operators see the overload.  The watch stream is
+# exempt BY DESIGN, not oversight: a long-lived SSE stream parked on a
+# heartbeat would pin an admission slot forever and starve point reads;
+# the watch hub's own watch.max_subscribers cap bounds subscribers
+# instead (excess subscribes get 429 from the hub).
 _ADMISSION_EXEMPT = {
     "/health/alive", "/health/ready", "/version", "/metrics/prometheus",
+    "/relation-tuples/watch",
 }
 
 # REST paths that get the full stage decomposition (flightrec context);
@@ -66,6 +72,7 @@ _RPC_OPS = {
     "/relation-tuples/expand": "expand",
     "/relation-tuples/list-objects": "list_objects",
     "/relation-tuples/list-subjects": "list_subjects",
+    "/relation-tuples/watch": "watch",
 }
 
 # admin DELETE rejects unknown query params (internal/x/validate, used at
@@ -79,6 +86,32 @@ _QUERY_KEYS = {
 
 def _flatten_query(qs: Dict[str, list]) -> Dict[str, str]:
     return {k: v[0] for k, v in qs.items() if v}
+
+
+def _consistency_params(q: Dict[str, str]):
+    """(snaptoken, latest) read-consistency query params.  `latest` takes
+    the usual REST boolean spellings; anything else is a client bug."""
+    token = q.get("snaptoken") or None
+    raw = q.get("latest")
+    if raw is None:
+        return token, False
+    if raw.lower() in ("true", "1", "yes", ""):
+        return token, True
+    if raw.lower() in ("false", "0", "no"):
+        return token, False
+    raise BadRequestError(
+        f"unable to parse 'latest' query parameter as bool: {raw!r}"
+    )
+
+
+class StreamingResponse:
+    """Route payload for long-lived streaming responses (the SSE watch
+    stream): instead of buffering a body, the HTTP handler writes chunks
+    as ``iterator`` yields them and closes the connection afterwards."""
+
+    def __init__(self, iterator, content_type: str = "text/event-stream"):
+        self.iterator = iterator
+        self.content_type = content_type
 
 
 def _max_depth(q: Dict[str, str]) -> int:
@@ -279,7 +312,11 @@ def read_router(registry) -> Router:
     def get_check(mirror: bool):
         def handler(req):
             tuple_ = RelationTuple.from_url_query(req.query)
-            allowed = check.check_rest(tuple_, _max_depth(req.query), req.headers)
+            token, latest = _consistency_params(req.query)
+            allowed = check.check_rest(
+                tuple_, _max_depth(req.query), req.headers,
+                snaptoken=token, latest=latest,
+            )
             status = 403 if (mirror and not allowed) else 200
             return status, {"allowed": allowed}
 
@@ -288,7 +325,11 @@ def read_router(registry) -> Router:
     def post_check(mirror: bool):
         def handler(req):
             tuple_ = RelationTuple.from_json(req.json() or {})
-            allowed = check.check_rest(tuple_, _max_depth(req.query), req.headers)
+            token, latest = _consistency_params(req.query)
+            allowed = check.check_rest(
+                tuple_, _max_depth(req.query), req.headers,
+                snaptoken=token, latest=latest,
+            )
             status = 403 if (mirror and not allowed) else 200
             return status, {"allowed": allowed}
 
@@ -309,6 +350,9 @@ def read_router(registry) -> Router:
             raise BadRequestError('expected {"tuples": [...]}')
         tuples_in = [RelationTuple.from_json(d or {}) for d in body["tuples"]]
         r = registry.resolve(req.headers)
+        token, latest = _consistency_params(req.query)
+        if token or latest:
+            consistency.ensure_fresh(r, token, latest, op="check")
         results = check.batch_check_core(
             tuples_in, _max_depth(req.query), r
         )
@@ -325,9 +369,11 @@ def read_router(registry) -> Router:
             object=req.query.get("object", ""),
             relation=req.query.get("relation", ""),
         )
-        tree = expand.expand_core(
-            subject, _max_depth(req.query), registry.resolve(req.headers)
-        )
+        r = registry.resolve(req.headers)
+        token, latest = _consistency_params(req.query)
+        if token or latest:
+            consistency.ensure_fresh(r, token, latest, op="expand")
+        tree = expand.expand_core(subject, _max_depth(req.query), r)
         if tree is None:
             return 404, _error_body(404, "no relation tuple found")
         return 200, tree.to_json()
@@ -342,9 +388,16 @@ def read_router(registry) -> Router:
                 page_size = int(req.query["page_size"])
             except ValueError as e:
                 raise BadRequestError(str(e)) from None
+        r = registry.resolve(req.headers)
+        token, latest = _consistency_params(req.query)
+        if token or latest:
+            # list reads the store directly, so the barrier only needs
+            # the store to have reached the token — not the device view
+            consistency.ensure_fresh(
+                r, token, latest, op="list", use_engine=False
+            )
         out, next_token = tuples.list_core(
-            query, page_size, req.query.get("page_token", ""),
-            registry.resolve(req.headers),
+            query, page_size, req.query.get("page_token", ""), r,
         )
         return 200, {
             "relation_tuples": [t.to_json() for t in out],
@@ -411,6 +464,44 @@ def read_router(registry) -> Router:
         }
 
     rt.add("GET", "/namespaces", get_namespaces)
+
+    def get_watch(req):
+        # EXTENSION endpoint: Zanzibar Watch over SSE.  Subscribe before
+        # returning so subscribe-time errors (bad token, subscriber cap)
+        # still come back as ordinary JSON error bodies; only once the
+        # stream is live do errors degrade to a dropped connection.
+        r = registry.resolve(req.headers)
+        hub = r.watch_hub()
+        sub = hub.subscribe(
+            snaptoken=req.query.get("snaptoken") or None,
+            namespace=req.query.get("namespace") or None,
+        )
+        flightrec.note(resume=bool(req.query.get("snaptoken")))
+        heartbeat_s = (
+            float(r.config.get("watch.heartbeat_ms", 15000) or 15000)
+            / 1000.0
+        )
+
+        def gen():
+            try:
+                # SSE comment line: flushes proxy buffers and lets the
+                # client see the stream is open before the first event
+                yield b": watch stream open\n\n"
+                for ev in sub.events(heartbeat_s):
+                    data = {"snaptoken": ev.snaptoken or ""}
+                    if ev.kind == consistency.DELTA:
+                        data["action"] = ev.action
+                        data["relation_tuple"] = ev.tuple.to_json()
+                    yield (
+                        f"event: {ev.kind}\n"
+                        f"data: {json.dumps(data)}\n\n"
+                    ).encode("utf-8")
+            finally:
+                hub.unsubscribe(sub)
+
+        return 200, StreamingResponse(gen())
+
+    rt.add("GET", "/relation-tuples/watch", get_watch)
     return rt
 
 
@@ -420,13 +511,22 @@ def write_router(registry) -> Router:
     rt = Router(registry, "write")
     tuples = RelationTupleHandler(registry)
 
+    def _post_write_token(r) -> str:
+        # post-commit snaptoken, echoed in a response header so REST
+        # writers can do read-your-writes without a second round trip
+        return consistency.mint(r.store(), r._device_engine()).encode()
+
     def put_tuple(req):
         tuple_ = RelationTuple.from_json(req.json() or {})
-        tuples.transact_core([tuple_], [], registry.resolve(req.headers))
+        r = registry.resolve(req.headers)
+        tuples.transact_core([tuple_], [], r)
         registry.tracer().event(RELATIONTUPLES_CREATED)
         # urlencode: raw values in a header invite response splitting
         location = "/relation-tuples?" + urlencode(tuple_.to_url_query())
-        return 201, tuple_.to_json(), {"Location": location}
+        return 201, tuple_.to_json(), {
+            "Location": location,
+            "X-Keto-Snaptoken": _post_write_token(r),
+        }
 
     def delete_tuples(req):
         # validate.All parity (transact_server.go:193-199)
@@ -440,8 +540,9 @@ def write_router(registry) -> Router:
         if req.body:
             raise BadRequestError("the request body must be empty")
         query = RelationQuery.from_url_query(req.query)
-        tuples.delete_all_core(query, registry.resolve(req.headers))
-        return 204, None
+        r = registry.resolve(req.headers)
+        tuples.delete_all_core(query, r)
+        return 204, None, {"X-Keto-Snaptoken": _post_write_token(r)}
 
     def patch_tuples(req):
         deltas = req.json()
@@ -459,8 +560,9 @@ def write_router(registry) -> Router:
                 deletes.append(t)
             else:
                 raise BadRequestError(f"unknown action {action}")
-        tuples.transact_core(inserts, deletes, registry.resolve(req.headers))
-        return 204, None
+        r = registry.resolve(req.headers)
+        tuples.transact_core(inserts, deletes, r)
+        return 204, None, {"X-Keto-Snaptoken": _post_write_token(r)}
 
     rt.add("PUT", "/admin/relation-tuples", put_tuple)
     rt.add("DELETE", "/admin/relation-tuples", delete_tuples)
@@ -582,6 +684,57 @@ def make_http_server(router: Router, host: str, port: int,
                         and "allowed" in payload):
                     flightrec.note(verdict=payload["allowed"])
                 t_enc = time.perf_counter()
+                if isinstance(payload, StreamingResponse):
+                    # SSE escape hatch: no Content-Length, one chunk per
+                    # event, connection closed when the stream ends.  A
+                    # client that disappears (or stalls past the socket
+                    # timeout) just ends the stream — the generator's
+                    # finally block unsubscribes from the hub.
+                    self.close_connection = True
+                    self.send_response(status)
+                    self.send_header("Content-Type", payload.content_type)
+                    self.send_header("Cache-Control", "no-store")
+                    for k, v in extra.items():
+                        self.send_header(k, v)
+                    if router.cors:
+                        for k, v in (cors_headers(
+                            router.cors, hdrs.get("origin")
+                        ) or {}).items():
+                            self.send_header(k, v)
+                    self.end_headers()
+                    try:
+                        for chunk in payload.iterator:
+                            self.wfile.write(chunk)
+                            self.wfile.flush()
+                    except (BrokenPipeError, ConnectionResetError,
+                            OSError):
+                        pass
+                    finally:
+                        close = getattr(payload.iterator, "close", None)
+                        if close is not None:
+                            close()
+                    flightrec.note_stage(
+                        "encode", time.perf_counter() - t_enc
+                    )
+                    dt = time.perf_counter() - t0
+                    registry.metrics().observe(
+                        "keto_http_request_duration_seconds", dt,
+                        help="REST request latency",
+                        endpoint=router.endpoint, method=method,
+                        status=str(status),
+                    )
+                    if access_log:
+                        logger.info(
+                            "http_stream", extra={"fields": {
+                                "method": method,
+                                "path": parsed.path,
+                                "status": status,
+                                "duration_ms": round(dt * 1e3, 3),
+                                "peer": "%s:%s" % self.client_address[:2],
+                                "endpoint": router.endpoint,
+                            }},
+                        )
+                    return
                 if payload is None:
                     data = b""
                     ctype = "application/json"

@@ -192,6 +192,26 @@ class EngineHostServer:
                     "subjects": [_encode_subject(s) for s in subs],
                     "next_page_token": next_token,
                 }
+        if op == "barrier":
+            # freshness barrier forwarded from a worker: the worker can
+            # see the shared store but not the device engine, so the
+            # owner runs ensure_fresh (token + mode as wire fields); a
+            # StaleSnapshotError (412) rides the ordinary wire-error
+            # path and re-raises typed on the worker side
+            from ketotpu import consistency
+
+            with flightrec.rpc_recording(
+                r, "barrier", traceparent=tp, detail="worker->owner barrier"
+            ):
+                t0 = time.perf_counter()
+                consistency.ensure_fresh(
+                    r,
+                    req.get("snaptoken") or None,
+                    bool(req.get("latest")),
+                    op=str(req.get("rpc") or "check"),
+                )
+                flightrec.note_stage("barrier", time.perf_counter() - t0)
+                return {"ok": True}
         if op == "ping":
             return {"pong": True}
         if op == "health":
@@ -370,6 +390,21 @@ class RemoteCheckEngine:
 
     def check_is_member(self, r: RelationTuple, rest_depth: int = 0) -> bool:
         return self.check(r, rest_depth)
+
+    def consistency_barrier(
+        self, snaptoken: Optional[str] = None, latest: bool = False,
+        op: str = "check",
+    ) -> None:
+        """Run the freshness barrier on the device owner
+        (ketotpu/consistency/barrier.py routes here when the engine is
+        remote).  Raises the owner's typed refusal — StaleSnapshotError
+        412 — through the wire-error path."""
+        req = {"op": "barrier", "rpc": op}
+        if snaptoken:
+            req["snaptoken"] = snaptoken
+        if latest:
+            req["latest"] = True
+        self._call(req)
 
 
 class RemoteExpandEngine:

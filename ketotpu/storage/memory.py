@@ -51,6 +51,13 @@ class InMemoryTupleStore:
         self._log: List[Tuple[int, RelationTuple]] = []
         self._log_start = 0  # index of _log[0] in the all-time sequence
         self._log_cap = 65536
+        # overflow surfacing (keto_changelog_overflow_total): the registry
+        # installs a hook(n_evicted, first_of_episode); an "episode" runs
+        # from the first eviction until a lagging reader actually observes
+        # the gap (changes_since -> None) and rebuilds.
+        self.overflow_hook: Optional[Callable[[int, bool], None]] = None
+        self.overflow_evictions = 0
+        self._overflow_episode = False
 
     # -- change notification -------------------------------------------------
 
@@ -199,6 +206,11 @@ class InMemoryTupleStore:
             drop = len(self._log) - self._log_cap
             del self._log[:drop]
             self._log_start += drop
+            first = not self._overflow_episode
+            self._overflow_episode = True
+            self.overflow_evictions += drop
+            if self.overflow_hook is not None:
+                self.overflow_hook(drop, first)
 
     @property
     def log_head(self) -> int:
@@ -214,6 +226,9 @@ class InMemoryTupleStore:
         with self._lock:
             head = self._log_start + len(self._log)
             if cursor < self._log_start:
+                # the lagging reader has seen the gap and will rebuild:
+                # the overflow episode is over (the next eviction logs anew)
+                self._overflow_episode = False
                 return None, head
             return list(self._log[cursor - self._log_start:]), head
 

@@ -210,6 +210,12 @@ class SQLiteTupleStore:
         # trim probes walk O(log_cap) index entries; amortize them
         self._trim_interval = max(1, min(1024, log_cap // 4))
         self._writes_since_trim = 0
+        # overflow surfacing, same contract as the in-memory store: the
+        # registry installs hook(n_evicted, first_of_episode); an episode
+        # ends when a lagging reader sees the gap (changes_since -> None)
+        self.overflow_hook: Optional[Callable[[int, bool], None]] = None
+        self.overflow_evictions = 0
+        self._overflow_episode = False
         self._listeners: List[Callable[[int], None]] = []
         # autocommit connection; transactions are explicit (_tx) so that
         # (a) DDL participates in migration transactions and (b) multi-
@@ -568,7 +574,7 @@ class SQLiteTupleStore:
             (self.nid, self._log_cap),
         ).fetchone()
         if row is not None:
-            self._db.execute(
+            cur = self._db.execute(
                 "DELETE FROM keto_change_log WHERE nid = ? AND id <= ?",
                 (self.nid, row[0]),
             )
@@ -578,6 +584,13 @@ class SQLiteTupleStore:
                 " DO UPDATE SET value = excluded.value",
                 (self.nid, str(row[0] + 1)),
             )
+            dropped = max(int(cur.rowcount or 0), 0)
+            if dropped:
+                first = not self._overflow_episode
+                self._overflow_episode = True
+                self.overflow_evictions += dropped
+                if self.overflow_hook is not None:
+                    self.overflow_hook(dropped, first)
 
     def _log_head_locked(self) -> int:
         row = self._db.execute(
@@ -606,6 +619,9 @@ class SQLiteTupleStore:
                     (self.nid,),
                 ).fetchone()
                 if row is not None and cursor < int(row[0]):
+                    # the lagging reader has seen the gap and will
+                    # rebuild: the overflow episode is over
+                    self._overflow_episode = False
                     return None, head  # trimmed past the cursor
                 rows = self._db.execute(
                     f"SELECT op, {self._COLS} FROM keto_change_log"

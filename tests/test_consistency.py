@@ -1,0 +1,589 @@
+"""Consistency subsystem tests: snaptoken codec, freshness barriers,
+REST/gRPC refusal parity, per-delta write tokens, changelog-overflow
+surfacing, and the read-your-writes acceptance run against the real
+``serve --workers 2`` topology (slow leg).
+
+The contract under test is Zanzibar's zookie protocol (Pang et al.
+§2.2/§2.4.1): a read carrying a snaptoken either observes every write up
+to that token or is refused — never silently answered from an older
+snapshot (the "new enemy" window).
+"""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import grpc
+import pytest
+
+from ketotpu import consistency
+from ketotpu.api.types import (
+    BadRequestError,
+    RelationTuple,
+    StaleSnapshotError,
+)
+from ketotpu.consistency.tokens import Snaptoken
+from ketotpu.driver import Provider, Registry
+from ketotpu.observability import Metrics
+from ketotpu.proto import check_service_pb2 as cs
+from ketotpu.proto import read_service_pb2 as rs
+from ketotpu.proto import relation_tuples_pb2 as rts
+from ketotpu.proto.services import CheckServiceStub, ReadServiceStub
+from ketotpu.server import serve_all
+from ketotpu.storage.memory import InMemoryTupleStore
+
+FIXTURES = pathlib.Path(__file__).parent / "fixtures"
+
+
+def _http(method, url, body=None, headers=None, timeout=30.0):
+    req = urllib.request.Request(
+        url, data=body, method=method, headers=headers or {}
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read().decode(), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode(), dict(e.headers)
+
+
+# -- token codec --------------------------------------------------------------
+
+
+class TestSnaptokenCodec:
+    def test_roundtrip(self):
+        t = Snaptoken(version=7, cursor=42, epoch=3, shards=(42, 41, 42))
+        got = consistency.decode(t.encode())
+        assert got == t
+
+    def test_opaque_wire_form(self):
+        # clients must treat the token as a cookie: no raw JSON on the wire
+        enc = Snaptoken(version=1, cursor=5).encode()
+        assert "{" not in enc and '"' not in enc
+
+    def test_legacy_version_token_decodes(self):
+        t = consistency.decode("v17")
+        assert t.version == 17
+        assert t.cursor < 0  # carries no changelog cursor
+
+    def test_unknown_fields_ignored(self):
+        # forward compatibility: a newer server may add fields
+        import base64
+
+        raw = json.dumps(
+            {"v": 1, "sv": 9, "c": 3, "e": 1, "future_field": "x"}
+        ).encode()
+        enc = base64.urlsafe_b64encode(raw).decode().rstrip("=")
+        t = consistency.decode(enc)
+        assert t.version == 9 and t.cursor == 3
+
+    @pytest.mark.parametrize(
+        "bad", ["", "!!!!", "vNaN", "bm90LWpzb24", "eyJub3QiOiJzdiJ9"]
+    )
+    def test_malformed_is_bad_request(self, bad):
+        with pytest.raises(BadRequestError):
+            consistency.decode(bad)
+
+    def test_mint_carries_store_position(self):
+        store = InMemoryTupleStore()
+        store.write_relation_tuples(
+            RelationTuple.from_string("Doc:a#view@alice")
+        )
+        t = consistency.mint(store)
+        assert t.version == store.version
+        assert t.cursor == store.log_head
+
+
+# -- barrier unit tests -------------------------------------------------------
+
+
+class _StubRegistry:
+    """The slice of Registry the barrier touches: config/store/metrics
+    plus an optional engine."""
+
+    def __init__(self, store, engine=None, cfg=None):
+        self.config = Provider(cfg or {})
+        self._store = store
+        self._engine = engine
+        self._metrics = Metrics()
+
+    def store(self):
+        return self._store
+
+    def metrics(self):
+        return self._metrics
+
+    def check_engine(self):
+        return self._engine
+
+
+class TestBarrier:
+    def test_default_mode_is_free(self):
+        r = _StubRegistry(InMemoryTupleStore())
+        assert consistency.ensure_fresh(r) is None
+
+    def test_satisfied_token_returns(self):
+        store = InMemoryTupleStore()
+        store.write_relation_tuples(
+            RelationTuple.from_string("Doc:a#view@alice")
+        )
+        r = _StubRegistry(store)
+        tok = consistency.mint(store).encode()
+        got = consistency.ensure_fresh(r, tok, use_engine=False)
+        assert got is not None and got.cursor == store.log_head
+
+    def test_unreachable_token_refused_and_counted(self):
+        store = InMemoryTupleStore()
+        r = _StubRegistry(
+            store,
+            cfg={"consistency": {"barrier_timeout_ms": 50,
+                                 "barrier_poll_ms": 1}},
+        )
+        future = Snaptoken(
+            version=store.version + 10, cursor=store.log_head + 10
+        ).encode()
+        with pytest.raises(StaleSnapshotError):
+            consistency.ensure_fresh(r, future, use_engine=False, op="check")
+        assert r.metrics().get_counter(
+            "keto_stale_reads_refused_total", op="check"
+        ) == 1.0
+
+    def test_barrier_waits_for_concurrent_write(self):
+        import threading
+
+        store = InMemoryTupleStore()
+        r = _StubRegistry(
+            store,
+            cfg={"consistency": {"barrier_timeout_ms": 5000,
+                                 "barrier_poll_ms": 1}},
+        )
+        future = Snaptoken(
+            version=store.version + 1, cursor=store.log_head + 1
+        ).encode()
+
+        def write_soon():
+            time.sleep(0.05)
+            store.write_relation_tuples(
+                RelationTuple.from_string("Doc:late#view@alice")
+            )
+
+        t = threading.Thread(target=write_soon)
+        t.start()
+        got = consistency.ensure_fresh(r, future, use_engine=False)
+        t.join()
+        assert got is not None
+        assert store.log_head >= got.cursor
+
+    def test_legacy_token_compares_store_version(self):
+        store = InMemoryTupleStore()
+        store.write_relation_tuples(
+            RelationTuple.from_string("Doc:a#view@alice")
+        )
+        r = _StubRegistry(store)
+        assert (
+            consistency.ensure_fresh(r, f"v{store.version}", use_engine=False)
+            is not None
+        )
+
+
+# -- changelog overflow surfacing --------------------------------------------
+
+
+class TestChangelogOverflow:
+    def _registry(self):
+        return Registry(
+            Provider(
+                {
+                    "namespaces": {
+                        "location": str(
+                            FIXTURES / "rewrites_namespaces.keto.ts"
+                        )
+                    },
+                    "engine": {"kind": "tpu", "frontier": 256,
+                               "arena": 1024, "max_batch": 64,
+                               "mesh_devices": 0, "mesh_axis": "shard"},
+                }
+            )
+        ).init()
+
+    def test_overflow_bumps_metric_and_keeps_verdicts(self):
+        reg = self._registry()
+        try:
+            store = reg.store()
+            store._log_cap = 8  # tiny bounded log to force eviction
+            eng = reg._device_engine()
+            assert eng is not None
+            q = RelationTuple.from_string("Group:admin#members@alice")
+            store.write_relation_tuples(q)
+            eng.snapshot()  # drain: engine is current
+            assert reg.metrics().get_counter(
+                "keto_changelog_overflow_total"
+            ) == 0.0
+            # blow past the bounded log while the engine is NOT draining
+            for i in range(40):
+                store.write_relation_tuples(
+                    RelationTuple.from_string(f"Doc:d{i}#view@alice")
+                )
+            assert reg.metrics().get_counter(
+                "keto_changelog_overflow_total"
+            ) > 0.0
+            # a lagging reader is told to rebuild, never handed a gap
+            changes, _head = store.changes_since(1)
+            assert changes is None
+            # and verdicts after the forced snapshot rebuild match reality
+            allowed = eng.batch_check([q])[0]
+            assert allowed is True or allowed == 1
+            gone = eng.batch_check(
+                [RelationTuple.from_string("Group:admin#members@mallory")]
+            )[0]
+            assert not gone
+        finally:
+            reg.close_engines()
+
+    def test_overflow_logs_once_per_episode(self):
+        reg = self._registry()
+        try:
+            store = reg.store()
+            store._log_cap = 4
+            fires = []
+            inner = store.overflow_hook
+
+            def spy(drop, first):
+                fires.append((drop, first))
+                inner(drop, first)
+
+            store.overflow_hook = spy
+            for i in range(12):
+                store.write_relation_tuples(
+                    RelationTuple.from_string(f"Doc:e{i}#view@alice")
+                )
+            firsts = [f for _, f in fires if f]
+            assert len(firsts) == 1  # one log line per episode, not per write
+            # a reader observing the gap ends the episode ...
+            assert store.changes_since(0)[0] is None
+            for i in range(12):
+                store.write_relation_tuples(
+                    RelationTuple.from_string(f"Doc:f{i}#view@alice")
+                )
+            # ... so the next overflow logs again
+            firsts = [f for _, f in fires if f]
+            assert len(firsts) == 2
+        finally:
+            reg.close_engines()
+
+
+# -- REST / gRPC parity over a live daemon ------------------------------------
+
+
+@pytest.fixture(scope="module")
+def server():
+    cfg = Provider(
+        {
+            "serve": {
+                n: {"host": "127.0.0.1", "port": 0}
+                for n in ("read", "write", "metrics", "opl")
+            },
+            "namespaces": {
+                "location": str(FIXTURES / "rewrites_namespaces.keto.ts")
+            },
+            "engine": {"kind": "tpu", "frontier": 1024, "arena": 4096,
+                       "max_batch": 256, "mesh_devices": 0,
+                       "mesh_axis": "shard"},
+            # short barrier budget: the refusal tests shouldn't idle 2s
+            "consistency": {"barrier_timeout_ms": 150, "barrier_poll_ms": 2},
+            "log": {"request_log": False},
+        }
+    )
+    reg = Registry(cfg).init()
+    srv = serve_all(reg)
+    reg.store().write_relation_tuples(
+        RelationTuple.from_string("Group:admin#members@alice")
+    )
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture(scope="module")
+def read_addr(server):
+    return "http://%s:%d" % tuple(server.addresses["read"])
+
+
+@pytest.fixture(scope="module")
+def write_addr(server):
+    return "http://%s:%d" % tuple(server.addresses["write"])
+
+
+@pytest.fixture(scope="module")
+def read_channel(server):
+    ch = grpc.insecure_channel("%s:%d" % tuple(server.addresses["read"]))
+    yield ch
+    ch.close()
+
+
+def _future_token(server):
+    store = server.registry.store()
+    return Snaptoken(
+        version=store.version + 10_000, cursor=store.log_head + 10_000
+    ).encode()
+
+
+CHECK_QS = "namespace=Group&object=admin&relation=members&subject_id=alice"
+
+
+class TestRefusalParity:
+    def test_rest_stale_token_is_412(self, server, read_addr):
+        stale = _future_token(server)
+        status, body, _ = _http(
+            "GET",
+            f"{read_addr}/relation-tuples/check/openapi?{CHECK_QS}"
+            f"&snaptoken={stale}",
+        )
+        assert status == 412
+        assert json.loads(body)["error"]["code"] == 412
+
+    def test_grpc_stale_token_is_failed_precondition(
+        self, server, read_channel
+    ):
+        stale = _future_token(server)
+        stub = CheckServiceStub(read_channel)
+        with pytest.raises(grpc.RpcError) as exc:
+            stub.Check(
+                cs.CheckRequest(
+                    tuple=rts.RelationTuple(
+                        namespace="Group", object="admin",
+                        relation="members",
+                        subject=rts.Subject(id="alice"),
+                    ),
+                    snaptoken=stale,
+                )
+            )
+        assert exc.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+        assert server.registry.metrics().get_counter(
+            "keto_stale_reads_refused_total", op="check"
+        ) >= 2.0  # the REST refusal above + this one
+
+    def test_rest_list_stale_token_is_412(self, server, read_addr):
+        stale = _future_token(server)
+        status, _, _ = _http(
+            "GET",
+            f"{read_addr}/relation-tuples?namespace=Group&snaptoken={stale}",
+        )
+        assert status == 412
+
+    def test_grpc_list_stale_token_is_failed_precondition(
+        self, server, read_channel
+    ):
+        stale = _future_token(server)
+        with pytest.raises(grpc.RpcError) as exc:
+            ReadServiceStub(read_channel).ListRelationTuples(
+                rs.ListRelationTuplesRequest(
+                    relation_query=rts.RelationQuery(namespace="Group"),
+                    snaptoken=stale,
+                )
+            )
+        assert exc.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+
+    def test_rest_expand_stale_token_is_412(self, server, read_addr):
+        stale = _future_token(server)
+        status, _, _ = _http(
+            "GET",
+            f"{read_addr}/relation-tuples/expand?namespace=Group"
+            f"&object=admin&relation=members&snaptoken={stale}",
+        )
+        assert status == 412
+
+    def test_rest_latest_param_honored(self, read_addr):
+        status, body, _ = _http(
+            "GET",
+            f"{read_addr}/relation-tuples/check/openapi?{CHECK_QS}"
+            "&latest=true",
+        )
+        assert status == 200
+        assert json.loads(body)["allowed"] is True
+
+    def test_rest_bad_latest_is_400(self, read_addr):
+        status, _, _ = _http(
+            "GET",
+            f"{read_addr}/relation-tuples/check/openapi?{CHECK_QS}"
+            "&latest=banana",
+        )
+        assert status == 400
+
+
+class TestReadYourWrites:
+    def test_rest_write_token_satisfies_check(self, read_addr, write_addr):
+        t = RelationTuple.from_string("File:ryw#owners@carol")
+        status, _, headers = _http(
+            "PUT", f"{write_addr}/admin/relation-tuples",
+            json.dumps(t.to_json()).encode(),
+            {"Content-Type": "application/json"},
+        )
+        assert status == 201
+        token = headers.get("X-Keto-Snaptoken")
+        assert token, "writes must mint a snaptoken header"
+        decoded = consistency.decode(token)
+        assert decoded.cursor >= 0
+        status, body, _ = _http(
+            "GET",
+            f"{read_addr}/relation-tuples/check/openapi?namespace=File"
+            f"&object=ryw&relation=owners&subject_id=carol&snaptoken={token}",
+        )
+        assert status == 200
+        assert json.loads(body)["allowed"] is True
+
+    def test_delete_and_patch_mint_tokens(self, write_addr):
+        t = RelationTuple.from_string("File:ryw2#owners@dave")
+        deltas = [{"action": "insert", "relation_tuple": t.to_json()}]
+        status, _, headers = _http(
+            "PATCH", f"{write_addr}/admin/relation-tuples",
+            json.dumps(deltas).encode(),
+            {"Content-Type": "application/json"},
+        )
+        assert status == 204
+        assert consistency.decode(headers["X-Keto-Snaptoken"]).cursor >= 0
+        status, _, headers = _http(
+            "DELETE",
+            f"{write_addr}/admin/relation-tuples?namespace=File&object=ryw2",
+        )
+        assert status == 204
+        assert consistency.decode(headers["X-Keto-Snaptoken"]).cursor >= 0
+
+    def test_sdk_tracks_last_snaptoken(self, read_addr, write_addr):
+        from ketotpu.sdk import KetoClient
+
+        sdk = KetoClient(read_addr, write_addr)
+        t = RelationTuple.from_string("File:sdkryw#owners@erin")
+        sdk.create_relation_tuple(t)
+        assert sdk.last_snaptoken
+        assert sdk.check(
+            "File", "sdkryw", "owners", t.subject,
+            snaptoken=sdk.last_snaptoken,
+        )
+        # the new-enemy direction: revoke, then check AT the delete token
+        sdk.delete_relation_tuple(t)
+        assert not sdk.check(
+            "File", "sdkryw", "owners", t.subject,
+            snaptoken=sdk.last_snaptoken,
+        )
+
+    def test_sdk_stale_raises_typed_error(self, server, read_addr):
+        from ketotpu.sdk import KetoClient
+
+        sdk = KetoClient(read_addr)
+        with pytest.raises(StaleSnapshotError):
+            sdk.check(
+                "Group", "admin", "members",
+                RelationTuple.from_string(
+                    "Group:admin#members@alice"
+                ).subject,
+                snaptoken=_future_token(server),
+            )
+
+
+# -- acceptance: read-your-writes through `serve --workers 2` -----------------
+
+
+def _free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.slow
+def test_read_your_writes_through_worker_topology(tmp_path):
+    """ISSUE acceptance: boot ``serve --workers 2`` (remote-engine path:
+    workers forward barriers over the owner wire protocol), write through
+    one worker, immediately check with the returned snaptoken — allowed
+    must be True every round — and a deliberately-stale token must be
+    refused with 412."""
+    db = tmp_path / "ryw.db"
+    seed = Registry(Provider({"dsn": f"sqlite://{db}"}))
+    seed.store().migrate_up()
+
+    ports = {n: _free_port() for n in ("read", "write", "metrics", "opl")}
+    config = {
+        "dsn": f"sqlite://{db}",
+        "serve": {
+            n: {"host": "127.0.0.1", "port": p} for n, p in ports.items()
+        },
+        "namespaces": {
+            "location": str(FIXTURES / "rewrites_namespaces.keto.ts")
+        },
+        "engine": {"kind": "tpu", "frontier": 512, "arena": 2048,
+                   "max_batch": 128, "mesh_devices": 0,
+                   "mesh_axis": "shard"},
+        "consistency": {"barrier_timeout_ms": 5000},
+        "log": {"request_log": False},
+    }
+    cfg_path = tmp_path / "ryw.json"
+    cfg_path.write_text(json.dumps(config))
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ketotpu.cli", "serve",
+         "-c", str(cfg_path), "--workers", "2"],
+        env=env, cwd=str(pathlib.Path(__file__).parent.parent),
+    )
+    read = f"http://127.0.0.1:{ports['read']}"
+    write = f"http://127.0.0.1:{ports['write']}"
+    metrics = f"http://127.0.0.1:{ports['metrics']}"
+    try:
+        ready_by = time.monotonic() + 180.0
+        while True:
+            assert proc.poll() is None, "serve --workers died during boot"
+            try:
+                if _http("GET", f"{metrics}/health/ready",
+                         timeout=2.0)[0] == 200:
+                    break
+            except OSError:
+                pass
+            assert time.monotonic() < ready_by, "topology never became ready"
+            time.sleep(0.5)
+
+        for i in range(10):
+            t = RelationTuple.from_string(f"File:wrk{i}#owners@user{i}")
+            status, _, headers = _http(
+                "PUT", f"{write}/admin/relation-tuples",
+                json.dumps(t.to_json()).encode(),
+                {"Content-Type": "application/json"},
+            )
+            assert status == 201, f"write {i} failed"
+            token = headers.get("X-Keto-Snaptoken")
+            assert token, "worker writes must mint snaptokens"
+            status, body, _ = _http(
+                "GET",
+                f"{read}/relation-tuples/check/openapi?namespace=File"
+                f"&object=wrk{i}&relation=owners&subject_id=user{i}"
+                f"&snaptoken={token}",
+            )
+            assert status == 200, f"barriered check {i} -> {status}: {body}"
+            assert json.loads(body)["allowed"] is True, (
+                f"read-your-writes violated on round {i}"
+            )
+
+        # deliberate staleness: a token far past the store head refuses
+        stale = Snaptoken(version=10**9, cursor=10**9).encode()
+        status, body, _ = _http(
+            "GET",
+            f"{read}/relation-tuples/check/openapi?namespace=File"
+            f"&object=wrk0&relation=owners&subject_id=user0"
+            f"&snaptoken={stale}",
+            headers={"X-Request-Timeout": "300ms"},
+        )
+        assert status == 412, f"expected refusal, got {status}: {body}"
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=20)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=10)

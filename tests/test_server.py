@@ -327,24 +327,62 @@ class TestReadWrite:
         assert status == 204
 
     def test_grpc_transact_returns_real_snaptokens(self, write_channel):
+        from ketotpu import consistency
+
         stub = WriteServiceStub(write_channel)
+
+        def delta(action, obj, sid):
+            return ws.RelationTupleDelta(
+                action=action,
+                relation_tuple=rts.RelationTuple(
+                    namespace="Group",
+                    object=obj,
+                    relation="members",
+                    subject=rts.Subject(id=sid),
+                ),
+            )
+
         resp = stub.TransactRelationTuples(
             ws.TransactRelationTuplesRequest(
                 relation_tuple_deltas=[
-                    ws.RelationTupleDelta(
-                        action=ws.RelationTupleDelta.ACTION_INSERT,
-                        relation_tuple=rts.RelationTuple(
-                            namespace="Group",
-                            object="grpcgrp",
-                            relation="members",
-                            subject=rts.Subject(id="gal"),
-                        ),
-                    )
+                    delta(ws.RelationTupleDelta.ACTION_INSERT,
+                          "grpcgrp", "gal")
                 ]
             )
         )
         assert len(resp.snaptokens) == 1
-        assert resp.snaptokens[0].startswith("v")
+        tok = consistency.decode(resp.snaptokens[0])
+        assert tok.version > 0 and tok.cursor >= 0
+        # one token per delta, deletes included: a mixed transact with
+        # 2 inserts and 1 delete must return exactly 3 tokens
+        resp = stub.TransactRelationTuples(
+            ws.TransactRelationTuplesRequest(
+                relation_tuple_deltas=[
+                    delta(ws.RelationTupleDelta.ACTION_INSERT,
+                          "grpcgrp", "hal"),
+                    delta(ws.RelationTupleDelta.ACTION_INSERT,
+                          "grpcgrp", "ida"),
+                    delta(ws.RelationTupleDelta.ACTION_DELETE,
+                          "grpcgrp", "gal"),
+                ]
+            )
+        )
+        assert len(resp.snaptokens) == 3
+        assert all(
+            consistency.decode(t).version > 0 for t in resp.snaptokens
+        )
+        # delete-only transacts mint tokens too (the seed returned none)
+        resp = stub.TransactRelationTuples(
+            ws.TransactRelationTuplesRequest(
+                relation_tuple_deltas=[
+                    delta(ws.RelationTupleDelta.ACTION_DELETE,
+                          "grpcgrp", "hal"),
+                    delta(ws.RelationTupleDelta.ACTION_DELETE,
+                          "grpcgrp", "ida"),
+                ]
+            )
+        )
+        assert len(resp.snaptokens) == 2
         stub.DeleteRelationTuples(
             ws.DeleteRelationTuplesRequest(
                 relation_query=rts.RelationQuery(
@@ -475,7 +513,9 @@ class TestBatchCheck:
         assert status == 200
         data = json.loads(out)
         assert [r["allowed"] for r in data["results"]] == [w for _, w in CASES]
-        assert data["snaptoken"].startswith("v")
+        from ketotpu import consistency
+
+        assert consistency.decode(data["snaptoken"]).version >= 0
 
     def test_sdk_batch_check(self, read_addr, write_addr):
         from ketotpu.sdk import KetoClient

@@ -1,0 +1,357 @@
+"""Watch API tests: hub resume/eviction/slow-consumer semantics, the
+gRPC server stream, and the REST SSE smoke (slow leg).
+
+The contract (Pang et al. §2.4.3): a watcher resuming from a snaptoken
+sees exactly the deltas after that token, in commit order, with no gap
+and no duplicate — and when the bounded changelog can no longer honor
+that, it is TOLD to resync rather than silently skipped ahead.
+"""
+
+import json
+import pathlib
+import time
+import urllib.request
+
+import grpc
+import pytest
+
+from ketotpu import consistency
+from ketotpu.api.types import RelationTuple, TooManyRequestsError
+from ketotpu.consistency import (
+    DELTA,
+    HEARTBEAT,
+    RESYNC_REQUIRED,
+    WatchHub,
+)
+from ketotpu.driver import Provider, Registry
+from ketotpu.observability import Metrics
+from ketotpu.proto import watch_service_pb2 as wps
+from ketotpu.proto.services import WatchServiceStub
+from ketotpu.server import serve_all
+from ketotpu.storage.memory import InMemoryTupleStore
+
+FIXTURES = pathlib.Path(__file__).parent / "fixtures"
+
+
+def _tuples(n, prefix="d"):
+    return [
+        RelationTuple.from_string(f"Doc:{prefix}{i}#view@alice")
+        for i in range(n)
+    ]
+
+
+def _drain(sub, want, timeout_s=5.0):
+    """Pull events until ``want`` non-heartbeat events arrived (or the
+    stream terminated), skipping heartbeats; bounded by ``timeout_s``."""
+    out = []
+    give_up = time.monotonic() + timeout_s
+    gen = sub.events(heartbeat_s=0.02)
+    for ev in gen:
+        if ev.kind == HEARTBEAT:
+            if time.monotonic() > give_up:
+                break
+            continue
+        out.append(ev)
+        if len(out) >= want or ev.kind == RESYNC_REQUIRED:
+            break
+    return out
+
+
+class TestWatchHub:
+    def _hub(self, store=None, **kw):
+        store = store or InMemoryTupleStore()
+        return store, WatchHub(store, metrics=Metrics(), **kw)
+
+    def test_resume_replays_exactly_the_missed_suffix(self):
+        store, hub = self._hub()
+        try:
+            early = _tuples(2, "early")
+            store.write_relation_tuples(*early)
+            token = consistency.mint(store).encode()
+            missed = _tuples(3, "missed")
+            for t in missed:  # one log entry each, in order
+                store.write_relation_tuples(t)
+            sub = hub.subscribe(snaptoken=token)
+            evs = _drain(sub, want=3)
+            assert [e.kind for e in evs] == [DELTA] * 3
+            assert [e.tuple.object for e in evs] == [
+                "missed0", "missed1", "missed2"
+            ]
+            assert all(e.action == "insert" for e in evs)
+            # live splice: the next write arrives with no gap/duplicate
+            store.write_relation_tuples(
+                RelationTuple.from_string("Doc:live#view@alice")
+            )
+            evs = _drain(sub, want=1)
+            assert len(evs) == 1 and evs[0].tuple.object == "live"
+        finally:
+            hub.close()
+
+    def test_delta_tokens_chain_resumes(self):
+        # the snaptoken on each event is itself a valid resume point
+        store, hub = self._hub()
+        try:
+            token = consistency.mint(store).encode()
+            for t in _tuples(4, "c"):
+                store.write_relation_tuples(t)
+            sub = hub.subscribe(snaptoken=token)
+            evs = _drain(sub, want=4)
+            hub.unsubscribe(sub)
+            # resume from the 2nd event's token -> exactly events 3 and 4
+            sub2 = hub.subscribe(snaptoken=evs[1].snaptoken)
+            evs2 = _drain(sub2, want=2)
+            assert [e.tuple.object for e in evs2] == ["c2", "c3"]
+        finally:
+            hub.close()
+
+    def test_deletes_stream_as_deltas(self):
+        store, hub = self._hub()
+        try:
+            t = RelationTuple.from_string("Doc:del#view@alice")
+            store.write_relation_tuples(t)
+            token = consistency.mint(store).encode()
+            store.delete_relation_tuples(t)
+            sub = hub.subscribe(snaptoken=token)
+            evs = _drain(sub, want=1)
+            assert evs[0].action == "delete"
+            assert evs[0].tuple.object == "del"
+        finally:
+            hub.close()
+
+    def test_evicted_cursor_is_terminal_resync(self):
+        store, hub = self._hub()
+        try:
+            store._log_cap = 4
+            store.write_relation_tuples(*_tuples(1, "seed"))
+            token = consistency.mint(store).encode()
+            # enough writes that the token's cursor falls off the log;
+            # the hub keeps pace (it drains on subscribe), the token not
+            hub.subscribe(snaptoken=consistency.mint(store).encode())
+            for t in _tuples(12, "flood"):
+                store.write_relation_tuples(t)
+            sub = hub.subscribe(snaptoken=token)
+            evs = _drain(sub, want=5)
+            assert [e.kind for e in evs] == [RESYNC_REQUIRED]
+            assert hub.metrics.get_counter(
+                "keto_watch_resyncs_total", reason="evicted"
+            ) >= 1.0
+        finally:
+            hub.close()
+
+    def test_slow_consumer_dropped_with_resync_not_blocking(self):
+        store, hub = self._hub(queue_cap=2)
+        try:
+            sub = hub.subscribe()
+            t0 = time.monotonic()
+            for t in _tuples(20, "burst"):  # never blocks the writer
+                store.write_relation_tuples(t)
+            assert time.monotonic() - t0 < 5.0
+            deadline = time.monotonic() + 5.0
+            while (
+                hub.metrics.get_counter("keto_watch_dropped_total") == 0
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.01)
+            assert hub.metrics.get_counter("keto_watch_dropped_total") > 0
+            evs = _drain(sub, want=50)
+            assert evs[-1].kind == RESYNC_REQUIRED  # never a silent gap
+        finally:
+            hub.close()
+
+    def test_namespace_filter(self):
+        store, hub = self._hub()
+        try:
+            token = consistency.mint(store).encode()
+            store.write_relation_tuples(
+                RelationTuple.from_string("Doc:a#view@alice"),
+                RelationTuple.from_string("Group:g#members@bob"),
+                RelationTuple.from_string("Doc:b#view@alice"),
+            )
+            sub = hub.subscribe(snaptoken=token, namespace="Doc")
+            evs = _drain(sub, want=2)
+            assert [e.tuple.object for e in evs] == ["a", "b"]
+            assert all(e.tuple.namespace == "Doc" for e in evs)
+        finally:
+            hub.close()
+
+    def test_heartbeat_carries_resume_token(self):
+        store, hub = self._hub()
+        try:
+            sub = hub.subscribe()
+            gen = sub.events(heartbeat_s=0.01)
+            ev = next(gen)
+            assert ev.kind == HEARTBEAT
+            assert consistency.decode(ev.snaptoken).cursor == store.log_head
+        finally:
+            hub.close()
+
+    def test_subscriber_cap(self):
+        store, hub = self._hub(max_subscribers=1)
+        try:
+            hub.subscribe()
+            with pytest.raises(TooManyRequestsError):
+                hub.subscribe()
+            assert hub.metrics.get_counter(
+                "keto_watch_rejected_total", reason="subscriber_limit"
+            ) == 1.0
+        finally:
+            hub.close()
+
+    def test_unsubscribe_updates_gauge(self):
+        store, hub = self._hub()
+        try:
+            sub = hub.subscribe()
+            assert hub.metrics.get_gauge("keto_watch_subscribers") == 1.0
+            hub.unsubscribe(sub)
+            assert hub.metrics.get_gauge("keto_watch_subscribers") == 0.0
+        finally:
+            hub.close()
+
+
+# -- transports ---------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def server():
+    cfg = Provider(
+        {
+            "serve": {
+                n: {"host": "127.0.0.1", "port": 0}
+                for n in ("read", "write", "metrics", "opl")
+            },
+            "namespaces": {
+                "location": str(FIXTURES / "rewrites_namespaces.keto.ts")
+            },
+            "engine": {"kind": "tpu", "frontier": 1024, "arena": 4096,
+                       "max_batch": 256, "mesh_devices": 0,
+                       "mesh_axis": "shard"},
+            "watch": {"heartbeat_ms": 200},
+            "log": {"request_log": False},
+        }
+    )
+    reg = Registry(cfg).init()
+    srv = serve_all(reg)
+    yield srv
+    srv.stop()
+
+
+class TestGrpcWatch:
+    def test_stream_replays_and_tails(self, server):
+        reg = server.registry
+        store = reg.store()
+        token = consistency.mint(store).encode()
+        store.write_relation_tuples(
+            RelationTuple.from_string("File:w1#owners@alice"),
+            RelationTuple.from_string("File:w2#owners@bob"),
+        )
+        addr = "%s:%d" % tuple(server.addresses["read"])
+        with grpc.insecure_channel(addr) as ch:
+            stream = WatchServiceStub(ch).Watch(
+                wps.WatchRelationTuplesRequest(snaptoken=token),
+                timeout=30.0,
+            )
+            got = []
+            for resp in stream:
+                if resp.event == "heartbeat":
+                    continue
+                got.append(resp)
+                if len(got) == 2:
+                    break
+            assert [r.relation_tuple.object for r in got] == ["w1", "w2"]
+            assert all(r.event == "delta" for r in got)
+            assert all(r.action == "insert" for r in got)
+            # each response carries a resumable token
+            assert consistency.decode(got[-1].snaptoken).cursor >= 2
+            stream.cancel()
+
+    def test_stream_evicted_cursor_terminates_with_resync(self, server):
+        reg = server.registry
+        store = reg.store()
+        cap = store._log_cap
+        store._log_cap = 4
+        try:
+            store.write_relation_tuples(
+                RelationTuple.from_string("File:ev#owners@alice")
+            )
+            token = consistency.mint(store).encode()
+            for i in range(12):
+                store.write_relation_tuples(
+                    RelationTuple.from_string(f"File:ev{i}#owners@alice")
+                )
+            addr = "%s:%d" % tuple(server.addresses["read"])
+            with grpc.insecure_channel(addr) as ch:
+                stream = WatchServiceStub(ch).Watch(
+                    wps.WatchRelationTuplesRequest(snaptoken=token),
+                    timeout=30.0,
+                )
+                events = [r.event for r in stream if r.event != "heartbeat"]
+            # the stream is exactly one terminal resync marker long
+            assert events == ["resync_required"]
+        finally:
+            store._log_cap = cap
+
+    def test_namespace_mismatch_filtered(self, server):
+        reg = server.registry
+        store = reg.store()
+        token = consistency.mint(store).encode()
+        store.write_relation_tuples(
+            RelationTuple.from_string("Group:ns#members@alice"),
+            RelationTuple.from_string("File:ns#owners@alice"),
+        )
+        addr = "%s:%d" % tuple(server.addresses["read"])
+        with grpc.insecure_channel(addr) as ch:
+            stream = WatchServiceStub(ch).Watch(
+                wps.WatchRelationTuplesRequest(
+                    snaptoken=token, namespace="File"
+                ),
+                timeout=30.0,
+            )
+            for resp in stream:
+                if resp.event == "heartbeat":
+                    continue
+                assert resp.relation_tuple.namespace == "File"
+                assert resp.relation_tuple.object == "ns"
+                break
+            stream.cancel()
+
+
+@pytest.mark.slow
+def test_sse_watch_smoke(server):
+    """SSE leg of the Watch API: subscribe over plain HTTP, see the
+    replayed deltas arrive as `event:`/`data:` frames, resume token
+    included; heartbeats flow while idle."""
+    reg = server.registry
+    store = reg.store()
+    token = consistency.mint(store).encode()
+    store.write_relation_tuples(
+        RelationTuple.from_string("File:sse1#owners@alice"),
+        RelationTuple.from_string("File:sse2#owners@bob"),
+    )
+    read = "http://%s:%d" % tuple(server.addresses["read"])
+    req = urllib.request.Request(
+        f"{read}/relation-tuples/watch?snaptoken={token}", method="GET"
+    )
+    resp = urllib.request.urlopen(req, timeout=10.0)
+    try:
+        assert resp.status == 200
+        assert resp.headers.get("Content-Type", "").startswith(
+            "text/event-stream"
+        )
+        frames, event = [], None
+        give_up = time.monotonic() + 15.0
+        for raw in resp:
+            assert time.monotonic() < give_up, "SSE frames never arrived"
+            line = raw.decode().rstrip("\r\n")
+            if line.startswith("event:"):
+                event = line[6:].strip()
+            elif line.startswith("data:") and event == "delta":
+                frames.append(json.loads(line[5:].strip()))
+                if len(frames) == 2:
+                    break
+        assert [f["relation_tuple"]["object"] for f in frames] == [
+            "sse1", "sse2"
+        ]
+        assert all(f["action"] == "insert" for f in frames)
+        assert consistency.decode(frames[-1]["snaptoken"]).cursor >= 2
+    finally:
+        resp.close()
