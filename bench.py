@@ -214,8 +214,8 @@ def main() -> None:
     # not adopted (JAX pins its backend at first init)
     in_process = {
         "link_calibration", "fast_path", "mixed_general", "wave_latency",
-        "expand", "leopard", "serving", "scale_10m", "scale_10m_mixed",
-        "scale_10m_expand", "leopard_10m",
+        "expand", "leopard", "serving", "cache_shield", "scale_10m",
+        "scale_10m_mixed", "scale_10m_expand", "leopard_10m",
     }
 
     def run(name, fn, *a):
@@ -241,6 +241,7 @@ def main() -> None:
         run("expand", _expand, out, state)
         run("leopard", _leopard, out, state)
         run("serving", _serving, out, state)
+        run("cache_shield", _cache_shield, out, state)
         run("scale_10m", _scale_10m, out, state, baseline)
         run("scale_10m_mixed", _scale_10m_mixed, out, state)
         run("scale_10m_expand", _scale_10m_expand, out, state)
@@ -587,6 +588,82 @@ def _serving(out, state) -> None:
     out.update(run_serving_bench(state["graph"], concurrency=32, duration=10.0))
 
 
+def _cache_shield(out, state) -> None:
+    # Hot-spot shield microbench (ketotpu/cache/): a 90%-repeat workload
+    # through the coalescer path the server actually serves singles on —
+    # cache on vs off — plus the singleflight collapse ratio under a
+    # same-key thundering herd.  The ISSUE 5 acceptance bar is >=5x
+    # checks/sec with the shield on.
+    import threading
+
+    from ketotpu.cache import ResultCache
+    from ketotpu.engine.coalesce import CoalescingEngine
+    from ketotpu.utils.synth import synth_queries
+
+    graph, eng = state["graph"], state["eng"]
+    rng = np.random.default_rng(21)
+    hot = synth_queries(graph, 8, seed=23)
+    cold = synth_queries(graph, 2048, seed=29)
+    n = 400
+    workload = [
+        hot[int(rng.integers(len(hot)))] if rng.random() < 0.9
+        else cold[int(rng.integers(len(cold)))]
+        for _ in range(n)
+    ]
+
+    def drive(co):
+        t0 = time.perf_counter()
+        for q in workload:
+            co.check_is_member(q)
+        return n / (time.perf_counter() - t0)
+
+    off = CoalescingEngine(eng, window=0.001)
+    drive(off)  # warm compile shapes
+    uncached_per_sec = drive(off)
+    off.close()
+
+    rc = ResultCache(max_entries=65536, shards=8)
+    rc.attach_store(graph.store)
+    eng.result_cache = rc
+    try:
+        on = CoalescingEngine(eng, window=0.001, cache=rc)
+        drive(on)  # warm the cache
+        cached_per_sec = drive(on)
+        hit_ratio = rc.stats()["hit_ratio"]
+        on.close()
+    finally:
+        eng.result_cache = None
+
+    # singleflight collapse: a 16-thread herd on one key, no cache so
+    # every check must either own the slot or join an in-flight twin
+    herd = CoalescingEngine(eng, window=0.005)
+    per_thread, n_threads = 25, 16
+    q = hot[0]
+
+    def hammer():
+        for _ in range(per_thread):
+            herd.check_is_member(q)
+
+    threads = [threading.Thread(target=hammer) for _ in range(n_threads)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    total = per_thread * n_threads
+    collapse_ratio = herd.singleflight_collapsed / total
+    herd.close()
+
+    out["cache"] = {
+        "check_cached_per_sec": round(cached_per_sec, 1),
+        "check_uncached_per_sec": round(uncached_per_sec, 1),
+        "cached_speedup": round(cached_per_sec / uncached_per_sec, 2),
+        "cache_hit_ratio": round(hit_ratio, 4),
+        "singleflight_collapse_ratio": round(collapse_ratio, 4),
+        "repeat_fraction": 0.9,
+    }
+
+
 def _serving_workers(out, state) -> None:
     # the multi-process topology (`serve --workers 2`): SO_REUSEPORT
     # workers around one device owner — measures the wire-path scaling
@@ -660,11 +737,13 @@ def _scale_10m_expand(out, state) -> None:
         SubjectSet("Doc", big.docs[int(rng2.integers(len(big.docs)))], "parents")
         for _ in range(512)
     ]
-    beng.batch_expand(xroots[:64], 5)
+    # warm at the MEASURED root-count: _run_expand's schedule is a static
+    # jit argument, so a 64-root warm pass compiles a different program
+    # and the 512-root timed pass then eats the XLA compile (~3s on CPU —
+    # this was the whole BENCH_r05 "anomaly"; see ROADMAP)
+    beng.batch_expand(xroots, 5)
     # snapshot the engine's cumulative phase counters around the timed
     # pass so the throughput number decomposes into host vs device time
-    # (BENCH_r05 anomaly: 78 trees/s here vs 27.5k/s at 1M — the delta
-    # between these two timers says which side eats the wall clock)
     ph0 = dict(getattr(beng, "phase_seconds", {}) or {})
     t0 = time.perf_counter()
     btrees = beng.batch_expand(xroots, 5)
@@ -678,7 +757,7 @@ def _scale_10m_expand(out, state) -> None:
     out.update(
         expand_trees_per_sec_10m=round(len(btrees) / dt, 1),
         expand_fallback_rate_10m=round(
-            (beng.fallbacks - fb1) / max(len(xroots) + 64, 1), 4
+            (beng.fallbacks - fb1) / max(2 * len(xroots), 1), 4
         ),
         expand_p50_ms_10m=p50,
         expand_p99_ms_10m=p99,

@@ -1,0 +1,34 @@
+"""Hot-spot shield: snapshot-versioned result cache + singleflight dedup.
+
+Zanzibar (Pang et al., USENIX ATC '19 §3.2.5) survives skewed object
+popularity with two mechanisms this package reproduces for the TPU
+engine: evaluation results cached at a snapshot timestamp (here: a
+changelog cursor, the same coordinate snaptokens use) and a lock table
+that collapses concurrent identical subproblems onto one computation.
+
+* :mod:`ketotpu.cache.results` — the sharded, cursor-stamped LRU;
+* :mod:`ketotpu.cache.flight` — deadline-aware singleflight;
+* :mod:`ketotpu.cache.hotspot` — count-min sketch driving admission and
+  the hot-keys debug view;
+* :mod:`ketotpu.cache.context` — the per-request thread-local that tells
+  deeper layers which consistency mode (and the bypass escape hatch)
+  governs a probe.
+"""
+
+from ketotpu.cache.context import (  # noqa: F401
+    bypassed,
+    current,
+    request_scope,
+    scope,
+)
+from ketotpu.cache.flight import SingleFlight  # noqa: F401
+from ketotpu.cache.hotspot import HotSpotSketch  # noqa: F401
+from ketotpu.cache.results import (  # noqa: F401
+    CHECK,
+    EXPAND,
+    Hit,
+    ResultCache,
+    check_key,
+    expand_key,
+    pretty_key,
+)

@@ -1,0 +1,84 @@
+"""Thread-local request context for the hot-spot shield.
+
+The cache is consulted from layers that never see the RPC (the engine's
+dispatch path, the coalescer's probe), so the per-request facts that
+govern whether a hit may be served ride a thread-local, exactly like
+``ketotpu/deadline.py`` carries the budget:
+
+* ``bypass`` — the ``X-Keto-Cache: bypass`` escape hatch: neither serve
+  from nor insert into the cache for this request;
+* ``token`` — the decoded at-least-as-fresh snaptoken (entries must
+  satisfy it via the barrier's ``satisfies_cursor`` comparison);
+* ``floor`` — an explicit minimum changelog cursor (the ``latest`` mode
+  binds the store head read after its drain).
+
+No context bound (e.g. the coalescer's wave thread, or a direct
+library call) means the strictest cheap mode: entries serve only when
+their cursor has reached the cache's fence — sound for any consistency
+mode, because the fence is at least as fresh as any token a request
+already passed its barrier against.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Iterator, Optional
+
+_state = threading.local()
+
+
+class Ctx:
+    __slots__ = ("bypass", "token", "floor")
+
+    def __init__(self, bypass: bool = False, token=None,
+                 floor: Optional[int] = None):
+        self.bypass = bypass
+        self.token = token
+        self.floor = floor
+
+
+def current() -> Optional[Ctx]:
+    return getattr(_state, "ctx", None)
+
+
+def bypassed() -> bool:
+    ctx = getattr(_state, "ctx", None)
+    return ctx is not None and ctx.bypass
+
+
+@contextlib.contextmanager
+def scope(*, bypass: bool = False, token=None,
+          floor: Optional[int] = None) -> Iterator[None]:
+    """Bind the cache-consistency context to the current thread.
+
+    Nested scopes keep the OUTER bypass (an escape-hatched request stays
+    escape-hatched through every inner hop) but take the inner token /
+    floor, which describe the innermost read's consistency mode.
+    """
+    prev = getattr(_state, "ctx", None)
+    if prev is not None and prev.bypass:
+        bypass = True
+    _state.ctx = Ctx(bypass=bypass, token=token, floor=floor)
+    try:
+        yield
+    finally:
+        _state.ctx = prev
+
+
+def request_scope(r, headers=None, token=None, latest: bool = False):
+    """Build the serving-path scope from RPC facts.
+
+    ``headers`` are the lower-cased REST headers or gRPC metadata dict;
+    ``token`` is whatever ``consistency.ensure_fresh`` returned; ``latest``
+    binds the store head (read here, AFTER the barrier's drain) as a hard
+    floor so a full-consistency read can never be answered by an entry
+    from before the drain.
+    """
+    bypass = False
+    if headers:
+        bypass = str(headers.get("x-keto-cache", "")).strip().lower() == "bypass"
+    floor = None
+    if latest:
+        floor = r.store().log_head
+    return scope(bypass=bypass, token=token, floor=floor)

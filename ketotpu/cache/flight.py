@@ -1,0 +1,97 @@
+"""Singleflight: collapse concurrent identical computations onto one leader.
+
+Zanzibar's lock table (Pang et al. §3.2.5) exists because a hot object
+under a thundering herd turns into N identical subproblems in flight at
+once; computing one and fanning the answer out bounds the work at the
+cost of one computation.  This is the same shape as Go's
+``golang.org/x/sync/singleflight``, with one Zanzibar-specific twist:
+followers park on a **deadline-aware** wait (``ketotpu/deadline.py``).
+A follower whose budget expires detaches and raises
+``DeadlineExceededError`` WITHOUT cancelling the leader — the leader's
+result still lands in the cache for the next caller, so an impatient
+follower never wastes the herd's work.
+
+Results carry the changelog cursor they were computed at so followers
+can stamp cache entries / snaptokens exactly as if they had computed
+the verdict themselves.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional, Tuple
+
+from ketotpu import deadline
+from ketotpu.api.types import DeadlineExceededError
+
+
+class _Call:
+    __slots__ = ("event", "value", "error", "followers")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.value = None
+        self.error: Optional[BaseException] = None
+        self.followers = 0
+
+
+class SingleFlight:
+    """Per-key leader election for identical in-flight computations."""
+
+    def __init__(self, metrics=None):
+        self._lock = threading.Lock()
+        self._calls: Dict[object, _Call] = {}
+        self._metrics = metrics
+        self.collapsed = 0  # observability: follower joins
+
+    def do(self, key, fn: Callable[[], object],
+           default_timeout: Optional[float] = None) -> Tuple[object, bool]:
+        """Run ``fn`` once per concurrent ``key``; returns (value, led).
+
+        The leader executes ``fn`` on its own thread; followers block on
+        the leader's event bounded by their OWN deadline budget (falling
+        back to ``default_timeout``).  A leader's exception propagates to
+        every waiter (same object, matching the coalescer's convention).
+        """
+        with self._lock:
+            call = self._calls.get(key)
+            if call is None:
+                call = _Call()
+                self._calls[key] = call
+                leader = True
+            else:
+                call.followers += 1
+                leader = False
+        if leader:
+            try:
+                call.value = fn()
+            except BaseException as e:  # noqa: BLE001
+                call.error = e
+                raise
+            finally:
+                # unpublish BEFORE waking waiters: a caller arriving after
+                # completion must start a fresh flight, not read a settled
+                # one whose freshness it cannot judge
+                with self._lock:
+                    self._calls.pop(key, None)
+                    self.collapsed += call.followers
+                    if self._metrics is not None and call.followers:
+                        self._metrics.counter(
+                            "keto_singleflight_collapsed_total",
+                            call.followers,
+                            help="checks served by another caller's "
+                                 "in-flight computation",
+                        )
+                call.event.set()
+            return call.value, True
+        budget = deadline.remaining()
+        if budget is None:
+            budget = default_timeout
+        if not call.event.wait(budget):
+            # detach: the leader keeps computing for everyone else
+            raise DeadlineExceededError(
+                "deadline exceeded waiting on an identical in-flight check"
+            )
+        if call.error is not None:
+            raise call.error
+        return call.value, False

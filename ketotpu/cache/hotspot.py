@@ -1,0 +1,122 @@
+"""Hot-spot detection: a count-min sketch over recently served keys.
+
+Zanzibar's hot-spot mitigation (Pang et al. §3.2.5) exists because ACL
+graphs serve wildly skewed object popularity: a handful of (object,
+relation) pairs absorb most of the check traffic.  The shield only pays
+for itself on those keys — caching every one-off check just churns the
+LRU — so admission can be gated on observed popularity.
+
+The sketch is the classic count-min estimator: ``depth`` rows of
+``width`` counters, each key hashed into one counter per row, estimate =
+min over rows (one-sided error: never under-counts).  "Recent" comes
+from periodic decay — every ``decay_every`` observations all counters
+halve, so a key must keep earning its heat.  A tiny exact top-K table
+rides along for the flight-recorder debug view.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Tuple
+
+import numpy as np
+
+# distinct odd multipliers decorrelate the per-row hashes (Knuth-style
+# multiplicative mixing over Python's per-process string hash)
+_ROW_MIX = (0x9E3779B97F4A7C15, 0xC2B2AE3D27D4EB4F, 0x165667B19E3779F9,
+            0x27D4EB2F165667C5)
+
+
+class HotSpotSketch:
+    """Count-min sketch + exact top-K candidate table."""
+
+    def __init__(self, *, width: int = 4096, depth: int = 4,
+                 top_k: int = 16, decay_every: int = 65536):
+        self.width = int(width)
+        self.depth = min(int(depth), len(_ROW_MIX))
+        self.top_k = int(top_k)
+        self.decay_every = int(decay_every)
+        self._counts = np.zeros((self.depth, self.width), np.uint32)
+        self._lock = threading.Lock()
+        self._seen = 0
+        # key -> last estimate for the debug view; pruned to 4*top_k so a
+        # churning key stream cannot grow it without bound
+        self._top: dict = {}
+
+    def _rows(self, key) -> List[int]:
+        h = hash(key) & 0xFFFFFFFFFFFFFFFF
+        return [
+            ((h ^ _ROW_MIX[i]) * _ROW_MIX[(i + 1) % len(_ROW_MIX)]
+             & 0xFFFFFFFFFFFFFFFF) % self.width
+            for i in range(self.depth)
+        ]
+
+    def observe(self, key) -> int:
+        """Count one occurrence; returns the post-increment estimate."""
+        rows = self._rows(key)
+        with self._lock:
+            self._seen += 1
+            if self._seen % self.decay_every == 0:
+                # halve everything: heat decays, "recent" stays recent
+                self._counts >>= 1
+                for k in list(self._top):
+                    self._top[k] >>= 1
+            est = self.width  # upper bound placeholder
+            for i, c in enumerate(rows):
+                self._counts[i, c] += 1
+                est = min(est, int(self._counts[i, c]))
+            if est >= self._kth_locked() or key in self._top:
+                self._top[key] = est
+                if len(self._top) > 4 * self.top_k:
+                    for k, _ in sorted(
+                        self._top.items(), key=lambda kv: kv[1]
+                    )[: len(self._top) - 2 * self.top_k]:
+                        del self._top[k]
+            return est
+
+    def observe_many(self, keys) -> List[int]:
+        """Vectorized ``observe`` for engine-sized batches: one lock
+        acquisition and one scatter-add per row instead of per key."""
+        if not keys:
+            return []
+        idx = np.array([self._rows(k) for k in keys], np.int64)  # (n, depth)
+        with self._lock:
+            self._seen += len(keys)
+            if self._seen % self.decay_every < len(keys):
+                self._counts >>= 1
+                for k in list(self._top):
+                    self._top[k] >>= 1
+            for i in range(self.depth):
+                np.add.at(self._counts[i], idx[:, i], 1)
+            gathered = np.stack(
+                [self._counts[i, idx[:, i]] for i in range(self.depth)]
+            )
+            ests = gathered.min(axis=0).astype(np.int64)
+            kth = self._kth_locked()
+            for k, est in zip(keys, ests):
+                if est >= kth or k in self._top:
+                    self._top[k] = int(est)
+            if len(self._top) > 4 * self.top_k:
+                for k, _ in sorted(
+                    self._top.items(), key=lambda kv: kv[1]
+                )[: len(self._top) - 2 * self.top_k]:
+                    del self._top[k]
+            return [int(e) for e in ests]
+
+    def estimate(self, key) -> int:
+        rows = self._rows(key)
+        with self._lock:
+            return int(min(self._counts[i, c] for i, c in enumerate(rows)))
+
+    def _kth_locked(self) -> int:
+        if len(self._top) < self.top_k:
+            return 0
+        return sorted(self._top.values(), reverse=True)[self.top_k - 1]
+
+    def top(self) -> List[Tuple[object, int]]:
+        """The K hottest keys with their estimated recent counts,
+        hottest first (the /debug/flight-recorder hot-keys view)."""
+        with self._lock:
+            return sorted(
+                self._top.items(), key=lambda kv: kv[1], reverse=True
+            )[: self.top_k]

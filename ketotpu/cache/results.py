@@ -1,0 +1,301 @@
+"""Snapshot-versioned result cache (Zanzibar §3.2.5 hot-spot shield).
+
+Every entry carries the changelog cursor its verdict was computed at —
+stamped from the engine's drain position captured under the same lock as
+the snapshot it computed against, so an entry can never claim to be
+fresher than the state that produced it.  Whether a hit may be SERVED is
+a pure cursor comparison against the request's consistency mode:
+
+* at-least-as-fresh — ``barrier.satisfies_cursor(token, entry.cursor)``,
+  the same comparison the freshness barrier applies to the engine's own
+  drain cursor; a cached verdict is therefore never staler than an
+  uncached read would be;
+* latest — the request binds the store head (read after its drain) as a
+  hard floor; only entries at/after it serve;
+* default minimize-latency — ``entry.cursor >= fence``, where the fence
+  is the store head as of the last changelog sync.  In-process the sync
+  is driven synchronously by the store's change listener (the same hook
+  the WatchHub uses), so the fence is exact; across processes (sqlite
+  workers) the fence is re-synced at least every ``cache.max_staleness_ms``,
+  which is precisely the bounded-staleness contract.
+
+Invalidation is cursor-based, not key-based: the changelog sync advances
+a per-namespace fence to the position of the namespace's newest write,
+and an entry older than its namespace's fence is evicted lazily at probe
+time.  There is no write-path key enumeration — a Transact costs O(1)
+cache work regardless of how many entries it invalidates.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import List, NamedTuple, Optional, Sequence, Tuple
+
+from ketotpu.cache import context
+from ketotpu.cache.hotspot import HotSpotSketch
+from ketotpu.consistency.barrier import satisfies_cursor
+
+CHECK = "check"
+EXPAND = "expand"
+
+Key = Tuple[str, str, str, str, str, int]
+
+
+def check_key(t, depth: int) -> Key:
+    return (CHECK, t.namespace, t.object, t.relation,
+            t.subject.unique_id(), int(depth))
+
+
+def expand_key(subject, depth: int) -> Key:
+    return (EXPAND, subject.namespace, subject.object, subject.relation,
+            "", int(depth))
+
+
+def pretty_key(key: Key) -> str:
+    op, ns, obj, rel, subj, depth = key
+    return f"{op} {ns}:{obj}#{rel}@{subj or '*'} d{depth}"
+
+
+class Hit(NamedTuple):
+    value: object
+    cursor: int
+
+
+class _Entry:
+    __slots__ = ("value", "cursor", "t")
+
+    def __init__(self, value, cursor: int, t: float):
+        self.value = value
+        self.cursor = cursor
+        self.t = t
+
+
+class _Shard:
+    __slots__ = ("lock", "od")
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.od: "OrderedDict[Key, _Entry]" = OrderedDict()
+
+
+class ResultCache:
+    """Sharded bounded LRU over check/expand results, fence-invalidated."""
+
+    def __init__(self, *, max_entries: int = 65536, shards: int = 8,
+                 max_staleness_ms: int = 100, hot_threshold: int = 0,
+                 top_k: int = 16, metrics=None):
+        shards = max(1, int(shards))
+        self._shards = [_Shard() for _ in range(shards)]
+        self._per_shard_cap = max(1, int(max_entries) // shards)
+        self._staleness_s = max(0.0, float(max_staleness_ms) / 1000.0)
+        self.hot_threshold = int(hot_threshold)
+        self.sketch = HotSpotSketch(top_k=top_k)
+        self._metrics = metrics
+        # fence state: _fence is the store head as of the last sync;
+        # _ns_fence[ns] is the changelog position of ns's newest known
+        # write (_ns_default stands in after a changelog overflow, when
+        # the touched-namespace set is unknowable)
+        self._fence_lock = threading.Lock()
+        self._fence = 0
+        self._ns_fence: dict = {}
+        self._ns_default = 0
+        self._drain_cursor = 0
+        self._synced_at = 0.0
+        self._dirty = False
+        self._store = None
+        # plain-int counters double the metrics so ratio gauges and bench
+        # never depend on scraping
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    # -- store wiring --------------------------------------------------------
+
+    def attach_store(self, store) -> None:
+        """Follow ``store``'s changelog: same listener hook the WatchHub
+        uses.  The listener only flips a flag — draining happens lazily
+        at probe time, off the writer's lock."""
+        self._store = store
+        head = store.log_head
+        with self._fence_lock:
+            self._drain_cursor = head
+            self._fence = max(self._fence, head)
+            self._synced_at = time.monotonic()
+        store.on_change(self._on_store_change)
+
+    def _on_store_change(self, _version: int) -> None:
+        # may run under the store's write lock: must not take cache locks
+        self._dirty = True
+
+    def advance_fence(self, cursor: int) -> None:
+        """An authoritative observation that the store has reached
+        ``cursor`` (engine drain, or the owner's cursor piggybacked on a
+        worker wire response).  Marks the changelog dirty so the next
+        sync catches the per-namespace fences up."""
+        with self._fence_lock:
+            if cursor > self._fence:
+                self._fence = cursor
+                self._dirty = True
+
+    def sync(self, force: bool = False) -> None:
+        """Drain the changelog into the fences.  Cheap when clean: one
+        monotonic read.  Re-syncs unconditionally every
+        ``max_staleness_ms`` — with a multi-process store the listener
+        cannot see remote writes, and this cadence is what bounds how
+        stale a default-mode hit can be."""
+        store = self._store
+        if store is None:
+            return
+        now = time.monotonic()
+        if not (force or self._dirty or self._staleness_s <= 0
+                or now - self._synced_at >= self._staleness_s):
+            return
+        with self._fence_lock:
+            now = time.monotonic()
+            if not (force or self._dirty or self._staleness_s <= 0
+                    or now - self._synced_at >= self._staleness_s):
+                return
+            self._dirty = False
+            changes, head = store.changes_since(self._drain_cursor)
+            if changes is None:
+                # changelog overflow: every namespace must be presumed
+                # touched at the new head
+                self._ns_fence.clear()
+                self._ns_default = head
+            else:
+                pos = self._drain_cursor
+                for _op, t in changes:
+                    pos += 1
+                    self._ns_fence[t.namespace] = pos
+            self._drain_cursor = head
+            if head > self._fence:
+                self._fence = head
+            self._synced_at = now
+
+    # -- serve path ----------------------------------------------------------
+
+    def lookup(self, key: Key, *, sync: bool = True,
+               observe: bool = True) -> Optional[Hit]:
+        """Probe; returns a Hit only when the entry's cursor satisfies
+        the ambient consistency context (see ``cache/context.py``).  All
+        probes feed the hot-spot sketch, hits and misses alike."""
+        ctx = context.current()
+        if ctx is not None and ctx.bypass:
+            return None
+        if sync:
+            self.sync()
+        if observe:
+            self.sketch.observe(key)
+        shard = self._shards[hash(key) % len(self._shards)]
+        with shard.lock:
+            e = shard.od.get(key)
+            if e is None:
+                return self._miss()
+            ns_fence = self._ns_fence.get(key[1], self._ns_default)
+            if e.cursor < ns_fence:
+                # lazy cursor-based invalidation: this namespace has a
+                # newer write than the entry has seen
+                del shard.od[key]
+                self._evict("fence")
+                return self._miss()
+            if ctx is not None and ctx.token is not None:
+                ok = satisfies_cursor(ctx.token, e.cursor)
+            elif ctx is not None and ctx.floor is not None:
+                ok = e.cursor >= ctx.floor
+            else:
+                ok = e.cursor >= self._fence
+            if not ok:
+                # too stale for THIS request's mode; a laxer request may
+                # still serve it, so it stays
+                return self._miss()
+            shard.od.move_to_end(key)
+            self.hits += 1
+        if self._metrics is not None:
+            self._metrics.counter(
+                "keto_cache_hits_total", 1,
+                help="check/expand results served from the hot-spot shield",
+                op=key[0],
+            )
+        return Hit(e.value, e.cursor)
+
+    def lookup_many(self, keys: Sequence[Key]) -> List[Optional[Hit]]:
+        """Batch probe: one changelog sync + one vectorized sketch
+        observation for the whole batch (the engine probes thousands of
+        keys per dispatch)."""
+        if context.bypassed():
+            return [None] * len(keys)
+        self.sync()
+        self.sketch.observe_many(list(keys))
+        return [self.lookup(k, sync=False, observe=False) for k in keys]
+
+    def insert(self, key: Key, value, cursor: int) -> bool:
+        """Store a freshly computed result stamped at ``cursor``.
+
+        ``cursor`` MUST be a lower bound on the state the value was
+        computed from (captured before/with the computation snapshot) —
+        over-claiming freshness here is the one way this cache could lie.
+        Respects the bypass escape hatch and the hot-threshold admission
+        gate; never replaces a fresher entry with a staler one.
+        """
+        if context.bypassed():
+            return False
+        if self.hot_threshold > 0 and self.sketch.estimate(key) < self.hot_threshold:
+            return False
+        now = time.monotonic()
+        shard = self._shards[hash(key) % len(self._shards)]
+        with shard.lock:
+            prev = shard.od.get(key)
+            if prev is not None and prev.cursor > cursor:
+                return False
+            shard.od[key] = _Entry(value, int(cursor), now)
+            shard.od.move_to_end(key)
+            while len(shard.od) > self._per_shard_cap:
+                shard.od.popitem(last=False)
+                self._evict("lru")
+        return True
+
+    # -- bookkeeping ---------------------------------------------------------
+
+    def _miss(self) -> None:
+        self.misses += 1
+        if self._metrics is not None:
+            self._metrics.counter(
+                "keto_cache_misses_total", 1,
+                help="cache probes not served (cold, stale, or evicted)",
+            )
+        return None
+
+    def _evict(self, reason: str) -> None:
+        self.evictions += 1
+        if self._metrics is not None:
+            self._metrics.counter(
+                "keto_cache_evictions_total", 1,
+                help="entries dropped from the result cache",
+                reason=reason,
+            )
+
+    def __len__(self) -> int:
+        return sum(len(s.od) for s in self._shards)
+
+    def clear(self) -> None:
+        for s in self._shards:
+            with s.lock:
+                s.od.clear()
+
+    def hot_keys(self) -> List[dict]:
+        """Top-K hot keys for the flight-recorder debug view."""
+        return [{"key": pretty_key(k), "count": c}
+                for k, c in self.sketch.top()]
+
+    def stats(self) -> dict:
+        probes = self.hits + self.misses
+        return {
+            "entries": len(self),
+            "hits": self.hits,
+            "misses": self.misses,
+            "evictions": self.evictions,
+            "hit_ratio": (self.hits / probes) if probes else 0.0,
+            "fence": self._fence,
+        }

@@ -98,6 +98,26 @@ def ensure_fresh(
             drain()
 
 
+def satisfies_cursor(token: Snaptoken, cursor: int) -> bool:
+    """The token comparison applied to a single changelog cursor: True
+    when state drained to ``cursor`` is at least as fresh as ``token``.
+
+    This is the primitive behind ``_satisfied`` and the one the result
+    cache uses to judge whether an entry stamped at ``cursor`` may serve
+    an at-least-as-fresh request.  A single cursor stands in for a whole
+    shard vector (e.g. a cache entry stamped from one engine's drain
+    position), so a sharded token is satisfied only when the cursor
+    covers EVERY shard.  Legacy version-only tokens (cursor < 0) carry no
+    changelog position: a bare cursor can never prove freshness for
+    them, so they always fail here and fall to the live-store paths.
+    """
+    if token.shards:
+        return all(cursor >= s for s in token.shards)
+    if token.cursor >= 0:
+        return cursor >= token.cursor
+    return False
+
+
 def _satisfied(token: Snaptoken, engine, store) -> bool:
     if engine is not None:
         cursors = getattr(engine, "consistency_cursors", None)
@@ -106,14 +126,16 @@ def _satisfied(token: Snaptoken, engine, store) -> bool:
             if token.shards and len(token.shards) == len(cur):
                 # mesh path: elementwise per-shard comparison
                 return all(c >= s for c, s in zip(cur, token.shards))
-            if token.cursor >= 0:
-                return min(cur) >= token.cursor
+            if token.cursor >= 0 or token.shards:
+                # aggregate fallback: the slowest shard must cover the
+                # token (shard-count mismatch degrades conservatively)
+                return satisfies_cursor(token, min(cur))
             # legacy version-only token: a drained engine is exactly as
             # fresh as the store, so the store version answers for it
             return store.version >= token.version
         # engine without a drain cursor (oracle) reads the store live
-    if token.cursor >= 0:
-        return store.log_head >= token.cursor
+    if token.cursor >= 0 or token.shards:
+        return satisfies_cursor(token, store.log_head)
     return store.version >= token.version
 
 

@@ -150,6 +150,21 @@ def _defaults() -> Dict[str, Any]:
             "max_subscribers": 256,
             "heartbeat_ms": 15000,
         },
+        # hot-spot shield (ketotpu/cache/): snapshot-versioned result
+        # cache + singleflight.  max_staleness_ms bounds how long the
+        # default (minimize-latency) mode may serve without re-syncing
+        # the changelog fence — 0 forces a sync on every probe (exact
+        # serving even across processes).  hot_threshold > 0 restricts
+        # admission to keys the count-min sketch has seen at least that
+        # often recently; top_k sizes the hot-keys debug view.
+        "cache": {
+            "enabled": True,
+            "max_entries": 65536,
+            "shards": 8,
+            "max_staleness_ms": 100,
+            "hot_threshold": 0,
+            "top_k": 16,
+        },
         # request_log: per-request access lines (REST middleware + gRPC
         # interceptor) at INFO; benches disable it to keep stderr quiet
         "log": {"level": "info", "format": "text", "request_log": True},
@@ -244,7 +259,9 @@ class Provider:
                           "latency_ms", "latency_rate", "max_pairs",
                           "rebuild_delta_pairs", "rebuild_dirty_sets",
                           "barrier_timeout_ms", "barrier_poll_ms",
-                          "queue_cap", "max_subscribers", "heartbeat_ms"):
+                          "queue_cap", "max_subscribers", "heartbeat_ms",
+                          "max_entries", "max_staleness_ms",
+                          "hot_threshold", "top_k"):
                 suffix = known.split("_")
                 if len(joined) > len(suffix) and joined[-len(suffix):] == suffix:
                     joined = joined[: -len(suffix)] + [known]
@@ -454,4 +471,21 @@ class Provider:
             if not isinstance(val, int) or val < 1:
                 raise ConfigError(
                     key, f"must be a positive integer, got {val!r}"
+                )
+        if not isinstance(self.get("cache.enabled", True), bool):
+            raise ConfigError(
+                "cache.enabled",
+                f"must be a boolean, got {self.get('cache.enabled')!r}",
+            )
+        for key in ("cache.max_entries", "cache.shards", "cache.top_k"):
+            val = self.get(key)
+            if not isinstance(val, int) or val < 1:
+                raise ConfigError(
+                    key, f"must be a positive integer, got {val!r}"
+                )
+        for key in ("cache.max_staleness_ms", "cache.hot_threshold"):
+            val = self.get(key)
+            if not isinstance(val, int) or val < 0:
+                raise ConfigError(
+                    key, f"must be a non-negative integer, got {val!r}"
                 )

@@ -80,6 +80,7 @@ class Registry:
         self._list_engine = None
         self._oracle_engine = None
         self._watch_hub = None
+        self._result_cache = None
         self._flight_recorder = None
         self._admission = None
         self._mapper = None
@@ -276,6 +277,36 @@ class Registry:
                 )
             return self._watch_hub
 
+    def result_cache(self):
+        """Lazy hot-spot shield (ketotpu/cache/): the snapshot-versioned
+        result cache shared by the check engine, the coalescer, and the
+        expand handler of this registry.  None when ``cache.enabled`` is
+        off.  Follows this registry's store changelog via the same
+        listener hook the WatchHub uses."""
+        with self._lock:
+            if self._result_cache is None:
+                if not bool(self.config.get("cache.enabled", True)):
+                    return None
+                from ketotpu.cache import ResultCache
+
+                rc = ResultCache(
+                    max_entries=int(
+                        self.config.get("cache.max_entries", 65536) or 65536
+                    ),
+                    shards=int(self.config.get("cache.shards", 8) or 8),
+                    max_staleness_ms=int(
+                        self.config.get("cache.max_staleness_ms", 100)
+                    ),
+                    hot_threshold=int(
+                        self.config.get("cache.hot_threshold", 0) or 0
+                    ),
+                    top_k=int(self.config.get("cache.top_k", 16) or 16),
+                    metrics=self.metrics(),
+                )
+                rc.attach_store(self.store())
+                self._result_cache = rc
+            return self._result_cache
+
     def _build_store(self, nid: str):
         """One dsn-dispatch path for the default network and every tenant
         (a tenant must never silently land on a different backend)."""
@@ -374,6 +405,7 @@ class Registry:
                         )
                     self._check_engine = RemoteCheckEngine(
                         sock, rpc_timeout=self._request_timeout(),
+                        cache=self.result_cache(), metrics=self.metrics(),
                     )
                 elif kind == "tpu":
                     common = dict(
@@ -385,6 +417,7 @@ class Registry:
                         max_batch=int(self.config.get("engine.max_batch")),
                         retry_scale=int(self.config.get("engine.retry_scale")),
                         metrics=self.metrics(),
+                        result_cache=self.result_cache(),
                         leopard={
                             "enabled": bool(
                                 self.config.get("leopard.enabled", True)
@@ -431,6 +464,8 @@ class Registry:
                         CoalescingEngine(
                             dev, window=ms / 1000.0,
                             default_timeout=self._request_timeout(),
+                            cache=self.result_cache(),
+                            metrics=self.metrics(),
                         )
                         if ms > 0 else dev
                     )
@@ -603,6 +638,14 @@ class Registry:
         rebuilds, overlay applies, checkpoint errors."""
         with self._lock:
             outer = self._check_engine
+            rc = self._result_cache
+        if rc is not None:
+            cs = rc.stats()
+            m = self.metrics()
+            m.gauge("keto_cache_entries", cs["entries"],
+                    help="result-cache entries resident")
+            m.gauge("keto_cache_hit_ratio", cs["hit_ratio"],
+                    help="lifetime cache hit ratio (hits / probes)")
         eng = getattr(outer, "inner", outer)
         if not isinstance(eng, DeviceCheckEngine):
             return
@@ -612,6 +655,10 @@ class Registry:
                     help="coalesced check dispatch waves")
             m.gauge("keto_engine_coalesced_checks", outer.coalesced,
                     help="single checks served via coalesced waves")
+            m.gauge("keto_singleflight_collapsed", outer.singleflight_collapsed,
+                    help="checks collapsed onto an identical pending slot")
+            m.gauge("keto_coalescer_cache_hits", outer.cache_hits,
+                    help="checks served from the cache before admission")
         m.gauge("keto_engine_oracle_fallbacks", eng.fallbacks,
                 help="queries answered by the host oracle")
         m.gauge("keto_engine_device_retries", eng.retries,

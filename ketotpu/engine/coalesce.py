@@ -19,6 +19,7 @@ surface.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 from typing import List, Optional, Sequence
@@ -30,15 +31,18 @@ from ketotpu.api.types import (
     RelationTuple,
     TooManyRequestsError,
 )
+from ketotpu.cache import check_key as cache_check_key
+from ketotpu.cache import context as cache_context
 
 
 class _Slot:
-    __slots__ = ("tuple", "depth", "event", "result", "error",
+    __slots__ = ("tuple", "depth", "bypass", "event", "result", "error",
                  "t_enq", "t_dispatch", "wave")
 
-    def __init__(self, t: RelationTuple, depth: int):
+    def __init__(self, t: RelationTuple, depth: int, bypass: bool = False):
         self.tuple = t
         self.depth = depth
+        self.bypass = bypass
         self.event = threading.Event()
         self.result: Optional[bool] = None
         self.error: Optional[BaseException] = None
@@ -52,10 +56,17 @@ class CoalescingEngine:
 
     def __init__(self, inner, *, window: float = 0.002,
                  max_pending: int = 4096,
-                 default_timeout: float = 30.0):
+                 default_timeout: float = 30.0,
+                 cache=None, metrics=None):
         self.inner = inner
         self.window = window
         self.max_pending = max_pending
+        # hot-spot shield: probe before admission (a hit skips the wave
+        # window entirely), and collapse identical pending checks onto one
+        # slot — the Zanzibar lock-table dedup at the batching seam
+        self.cache = cache
+        self.metrics = metrics
+        self._inflight: dict = {}  # (tuple-str, depth) -> pending _Slot
         # budget for callers with no explicit deadline: no slot may wait
         # forever — a wedged dispatch must surface as DEADLINE_EXCEEDED,
         # not as every serving thread hanging (<= 0 disables the bound)
@@ -68,6 +79,8 @@ class CoalescingEngine:
         self.coalesced = 0  # observability: queries served via waves
         self.shed = 0  # observability: queries refused on backlog
         self.deadline_exceeded = 0  # observability: slot waits timed out
+        self.singleflight_collapsed = 0  # observability: follower joins
+        self.cache_hits = 0  # observability: checks served pre-admission
         self._worker = threading.Thread(
             target=self._run, name="keto-coalescer", daemon=True
         )
@@ -79,6 +92,23 @@ class CoalescingEngine:
         return self.check_is_member(r, rest_depth)
 
     def check_is_member(self, r: RelationTuple, rest_depth: int = 0) -> bool:
+        # X-Keto-Cache: bypass rides a thread-local that would not survive
+        # the hop onto the wave thread; the slot carries the flag and the
+        # wave worker re-binds the scope around the dispatch, so a bypassed
+        # check still gets the deadline-bounded slot wait (a wedged device
+        # must answer DEADLINE_EXCEEDED, not block the calling thread)
+        bypass = cache_context.bypassed()
+        if self.cache is not None and not bypass:
+            # pre-admission probe: a hit skips the wave window (the whole
+            # point of the shield — hot keys should not pay the coalesce
+            # latency, let alone a device dispatch).  The request context
+            # is still bound on this thread, so token/latest floors apply.
+            t_probe = time.perf_counter()
+            hit = self.cache.lookup(cache_check_key(r, rest_depth))
+            flightrec.note_stage("cache", time.perf_counter() - t_probe)
+            if hit is not None:
+                self.cache_hits += 1
+                return bool(hit.value)
         budget = deadline.remaining()
         if budget is None:
             budget = self.default_timeout if self.default_timeout > 0 else None
@@ -88,22 +118,43 @@ class CoalescingEngine:
             raise DeadlineExceededError(
                 "deadline exceeded before check was enqueued"
             )
+        flight_key = (str(r), rest_depth)
+        collapsed = False
         with self._wake:
             if self._closed:
                 # the worker is gone; never strand the caller on a dead
                 # queue — answer directly on the wrapped engine
                 return bool(self.inner.check_is_member(r, rest_depth))
-            if len(self._pending) >= self.max_pending:
-                # backlog saturated: shed NOW rather than queue behind a
-                # wave the device may never drain in time
-                self.shed += 1
-                flightrec.note_stage("shed", 0.0)
-                raise TooManyRequestsError(
-                    f"check backlog full ({self.max_pending} pending)"
-                )
-            slot = _Slot(r, rest_depth)
-            self._pending.append(slot)
-            self._wake.notify()
+            slot = None if bypass else self._inflight.get(flight_key)
+            if slot is not None:
+                # singleflight: an identical check is already pending —
+                # park on ITS slot instead of occupying a second batch
+                # slot; the wave worker's verdict fans out to everyone
+                collapsed = True
+                self.singleflight_collapsed += 1
+            else:
+                if len(self._pending) >= self.max_pending:
+                    # backlog saturated: shed NOW rather than queue behind
+                    # a wave the device may never drain in time
+                    self.shed += 1
+                    flightrec.note_stage("shed", 0.0)
+                    raise TooManyRequestsError(
+                        f"check backlog full ({self.max_pending} pending)"
+                    )
+                slot = _Slot(r, rest_depth, bypass=bypass)
+                self._pending.append(slot)
+                if not bypass:
+                    # bypass slots never publish into the flight table: a
+                    # bypassed check must be recomputed, and later twins
+                    # must not read its slot as a cache substitute
+                    self._inflight[flight_key] = slot
+                self._wake.notify()
+        if collapsed and self.metrics is not None:
+            self.metrics.counter(
+                "keto_singleflight_collapsed_total", 1,
+                help="checks served by another caller's in-flight "
+                     "computation",
+            )
         if not slot.event.wait(budget):
             waited = time.perf_counter() - slot.t_enq
             self.deadline_exceeded += 1
@@ -161,49 +212,61 @@ class CoalescingEngine:
                         break
                     self._wake.wait(remaining)
                 wave, self._pending = self._pending, []
+                # the wave owns its slots now: identical checks arriving
+                # from here on start a fresh flight (the cache, refilled
+                # by this wave's dispatch, catches them instead)
+                self._inflight.clear()
             self._serve(wave)
 
     def _serve(self, wave: List[_Slot]) -> None:
         self.waves += 1
         wave_id = self.waves
         self.coalesced += len(wave)
-        by_depth = {}
+        groups = {}
         for s in wave:
-            by_depth.setdefault(s.depth, []).append(s)
-        for depth, slots in by_depth.items():
+            groups.setdefault((s.depth, s.bypass), []).append(s)
+        for (depth, byp), slots in groups.items():
             t_dispatch = time.perf_counter()
             for s in slots:
                 s.t_dispatch = t_dispatch
                 s.wave = wave_id
+            # re-bind the escape hatch on THIS thread for bypass slots so
+            # the inner engine's own cache probe/insert honor it (fresh
+            # scope per entry — generator context managers are one-shot)
+            def _ctx(byp=byp):
+                return (cache_context.scope(bypass=True) if byp
+                        else contextlib.nullcontext())
             try:
-                # one bounded whole-batch retry: a transient device /
-                # runtime hiccup should not error up to max_pending
-                # concurrent callers when a second dispatch would have
-                # succeeded (per-query degradation is still avoided —
-                # it would serialize the wave on this one thread)
-                for attempt in range(2):
-                    try:
-                        verdicts = self.inner.batch_check(
-                            [s.tuple for s in slots], depth
-                        )
-                        break
-                    except KetoAPIError:
-                        raise
-                    except Exception:  # noqa: BLE001
-                        if attempt:
+                with _ctx():
+                    # one bounded whole-batch retry: a transient device /
+                    # runtime hiccup should not error up to max_pending
+                    # concurrent callers when a second dispatch would have
+                    # succeeded (per-query degradation is still avoided —
+                    # it would serialize the wave on this one thread)
+                    for attempt in range(2):
+                        try:
+                            verdicts = self.inner.batch_check(
+                                [s.tuple for s in slots], depth
+                            )
+                            break
+                        except KetoAPIError:
                             raise
-                for s, v in zip(slots, verdicts):
-                    s.result = bool(v)
+                        except Exception:  # noqa: BLE001
+                            if attempt:
+                                raise
+                    for s, v in zip(slots, verdicts):
+                        s.result = bool(v)
             except KetoAPIError:
                 # a typed client error aborted the batch: answer each query
                 # individually so only the erroring ones raise
-                for s in slots:
-                    try:
-                        s.result = bool(
-                            self.inner.batch_check([s.tuple], depth)[0]
-                        )
-                    except Exception as e:  # noqa: BLE001
-                        s.error = e
+                with _ctx():
+                    for s in slots:
+                        try:
+                            s.result = bool(
+                                self.inner.batch_check([s.tuple], depth)[0]
+                            )
+                        except Exception as e:  # noqa: BLE001
+                            s.error = e
             except Exception as e:  # noqa: BLE001
                 # retry also failed: raise to every caller and let them
                 # retry against a (hopefully) recovered engine

@@ -63,6 +63,7 @@ if _plat:
 
 from ketotpu import deadline, faults, flightrec
 from ketotpu.api.types import KetoAPIError, RelationTuple
+from ketotpu.cache import check_key as cache_check_key
 from ketotpu.engine import algebra as alg
 from ketotpu.engine import delta as dl
 from ketotpu.engine import fastpath as fp
@@ -157,6 +158,7 @@ class DeviceCheckEngine:
         gen_levels_max: int = 24,
         metrics=None,
         leopard: Optional[dict] = None,
+        result_cache=None,
     ):
         self.store = store
         self.namespace_manager = namespace_manager
@@ -255,6 +257,11 @@ class DeviceCheckEngine:
             ),
             "rebuild_dirty_sets": int(lcfg.get("rebuild_dirty_sets", 512)),
         }
+        # hot-spot shield (ketotpu/cache/): probed after the Leopard index
+        # in _dispatch, refilled in _finish_chunk.  Entries are stamped
+        # with the drain cursor captured under the sync lock together with
+        # the snapshot they were computed against.
+        self.result_cache = result_cache
         self._leopard: Optional[leo.ClosureIndex] = None
         self._leo_device = None
         self.leopard_answered = 0  # checks answered from the index
@@ -496,14 +503,18 @@ class DeviceCheckEngine:
         return True
 
     def _sync_view(self):
-        """Atomic (snapshot, device_arrays, overlay_active) triple.  Writers
-        mutate all three together under ``_sync_lock``, so a dispatching
-        thread must capture them together — reading ``_device_arrays`` after
-        releasing the lock could pair a new snapshot's encodings with an
-        older projection (or vice versa)."""
+        """Atomic (snapshot, device_arrays, overlay_active, cursor) view.
+        Writers mutate all of these together under ``_sync_lock``, so a
+        dispatching thread must capture them together — reading
+        ``_device_arrays`` after releasing the lock could pair a new
+        snapshot's encodings with an older projection (or vice versa).
+        The drain cursor rides along as the freshness stamp for cache
+        entries computed against this view: captured under the same lock,
+        it is exactly the state the verdicts will describe, never newer."""
         with self._sync_lock:
             snap = self._snapshot_locked()
-            return snap, self._device_arrays, self._overlay_active
+            return (snap, self._device_arrays, self._overlay_active,
+                    self._log_cursor)
 
     def refresh(self) -> None:
         """Force a full rebuild (the CheckRequest.latest consistency knob —
@@ -768,7 +779,7 @@ class DeviceCheckEngine:
         faults.inject("device_dispatch")
         self.dispatches += 1
         t_enc = time.perf_counter()
-        snap, dev_arrays, overlay_active = self._sync_view()
+        snap, dev_arrays, overlay_active, cursor = self._sync_view()
         enc = self._encode(snap, queries, rest_depth)
         err, general = self._classify(snap, enc[0], enc[2])
         # Leopard first: closure-eligible fast queries resolve as one
@@ -778,6 +789,13 @@ class DeviceCheckEngine:
         active = ~(err | general)
         if leo_res is not None:
             active &= ~leo_res[1]
+        # hot-spot shield after Leopard: cached verdicts drop their
+        # queries from the device walk AND the algebra dispatch
+        cache_res = self._cache_consult(queries, rest_depth, err, general,
+                                        leo_res, cursor)
+        if cache_res is not None:
+            active &= ~cache_res[0]
+            general = general & ~cache_res[0]
         # pad for compile-cache reuse, but never beyond the frontier cap
         # (max_batch <= frontier guarantees n fits)
         qpad = min(_bucket(n), self.frontier)
@@ -813,7 +831,71 @@ class DeviceCheckEngine:
         if general.any():
             gi = np.flatnonzero(general)
             gres = self._run_general(dev_arrays, enc, gi)
-        return (enc, err, general, res, gi, gres, dev_arrays, occ, leo_res)
+        return (enc, err, general, res, gi, gres, dev_arrays, occ, leo_res,
+                cache_res, cursor)
+
+    def _cache_consult(self, queries, rest_depth, err, general, leo_res,
+                       cursor):
+        """Probe the hot-spot shield for every query not already answered
+        (encode errors fall to the oracle for their typed error; Leopard
+        answers are cheaper than a probe would be).  Returns
+        ``(cached, verdicts)`` bool arrays, or None when the cache is off
+        or nothing hit.  How fresh an entry must be to serve is decided
+        by the cache from the ambient request context (cache/context.py);
+        with no context bound it serves exact-at-fence only, which is
+        sound for every consistency mode."""
+        rc = self.result_cache
+        if rc is None:
+            return None
+        eligible = ~err
+        if leo_res is not None:
+            eligible &= ~leo_res[1]
+        idx = np.flatnonzero(eligible)
+        if len(idx) == 0:
+            return None
+        t0 = time.perf_counter()
+        hits = rc.lookup_many(
+            [cache_check_key(queries[i], rest_depth) for i in idx]
+        )
+        cached = np.zeros(err.shape[0], bool)
+        vals = np.zeros(err.shape[0], bool)
+        for i, h in zip(idx, hits):
+            if h is not None:
+                cached[i] = True
+                vals[i] = bool(h.value)
+        self._phase("check_cache", time.perf_counter() - t0)
+        if not cached.any():
+            return None
+        return cached, vals
+
+    def _cache_fill(self, queries, handle, rest_depth, allowed) -> None:
+        """Insert this chunk's freshly computed verdicts, stamped with the
+        drain cursor captured with the dispatch's sync view.  Oracle-
+        fallback verdicts are included — they were computed from the live
+        store, which is at least as fresh as the stamp (the stamp is a
+        lower bound, never an over-claim).  Leopard-answered queries are
+        skipped: the index answers them cheaper than a probe would."""
+        rc = self.result_cache
+        if rc is None:
+            return
+        err, leo_res, cache_res, cursor = (
+            handle[1], handle[8], handle[9], handle[10]
+        )
+        fresh = ~err
+        if leo_res is not None:
+            fresh &= ~leo_res[1]
+        if cache_res is not None:
+            fresh &= ~cache_res[0]
+        idx = np.flatnonzero(fresh)
+        if len(idx) == 0:
+            return
+        t0 = time.perf_counter()
+        for i in idx:
+            rc.insert(
+                cache_check_key(queries[i], rest_depth),
+                bool(allowed[i]), cursor,
+            )
+        self._phase("check_cache_fill", time.perf_counter() - t0)
 
     def _gen_schedule(self, q: int, boost: int):
         """Static shapes for one fused algebra dispatch (engine/algebra.py).
@@ -963,7 +1045,8 @@ class DeviceCheckEngine:
         The retry runs against the handle's own device arrays — a write
         landing between dispatch and retry must not pair these encodings
         with a newer projection."""
-        enc, err, general, res, gi, gres, dev_arrays, occ, leo_res = handle
+        (enc, err, general, res, gi, gres, dev_arrays, occ, leo_res,
+         cache_res, _cursor) = handle
         n = err.shape[0]
         allowed = np.zeros(n, bool)
         fallback = err.copy()
@@ -1022,6 +1105,10 @@ class DeviceCheckEngine:
             # slots for the answered queries; their over/dirty bits are
             # zero by construction, so no fallback/retry can claim them
             allowed[leo_res[1]] = leo_res[0][leo_res[1]]
+        if cache_res is not None:
+            # cached verdicts likewise ride inactive all-zero slots
+            allowed[cache_res[0]] = cache_res[1][cache_res[0]]
+            fallback &= ~cache_res[0]
         # dirty queries touched a CSR row with pending writes: the oracle
         # (live store) must answer *unless* membership was already
         # established — found-bits are overlay-exact and monotone, so a
@@ -1081,6 +1168,7 @@ class DeviceCheckEngine:
             dt = time.perf_counter() - t_fb
             self._phase("check_oracle_fallback", dt)
             self._rpc_fallback_stage("check", dt)
+        self._cache_fill(queries, handle, rest_depth, allowed)
         return allowed.tolist()
 
     def batch_expand(

@@ -302,6 +302,9 @@ class MeshCheckEngine(DeviceCheckEngine):
         with self._sync_lock:
             snap = self._snapshot_locked()
             stacked = self._stacked
+            # cache-entry freshness stamp: captured under the same lock as
+            # the snapshot the verdicts will be computed against
+            cursor = self._log_cursor
         enc = self._encode(snap, queries, rest_depth)
         err, general = self._classify(snap, enc[0], enc[2])
         # Leopard first: checks the closure index answers drop out of the
@@ -310,6 +313,13 @@ class MeshCheckEngine(DeviceCheckEngine):
         act = ~(err | general)
         if leo_res is not None:
             act &= ~leo_res[1]
+        # hot-spot shield after Leopard (shared _cache_consult): cached
+        # queries leave both the sharded BFS and the algebra dispatch
+        cache_res = self._cache_consult(queries, rest_depth, err, general,
+                                        leo_res, cursor)
+        if cache_res is not None:
+            act &= ~cache_res[0]
+            general = general & ~cache_res[0]
         qpad = min(_bucket(n), self.frontier)
         padded = self._pad(enc, n, qpad)
         active = np.pad(act, (0, qpad - n))
@@ -321,11 +331,12 @@ class MeshCheckEngine(DeviceCheckEngine):
             gi = np.flatnonzero(general)
             gres = self._run_general_mesh(stacked, enc, gi)
         self._phase("check_mesh_dispatch", time.perf_counter() - t0)
-        return (enc, err, general, res, gi, gres, stacked, None, leo_res)
+        return (enc, err, general, res, gi, gres, stacked, None, leo_res,
+                cache_res, cursor)
 
     def _collect(self, handle, retry: bool = True):
         (enc, fallback_mask, general, res, gi, gres, stacked, replica,
-         leo_res) = handle
+         leo_res, cache_res, _cursor) = handle
         n = fallback_mask.shape[0]
         allowed = np.zeros(n, bool)
         fallback = fallback_mask.copy()
@@ -405,6 +416,10 @@ class MeshCheckEngine(DeviceCheckEngine):
             ans = leo_res[1]
             allowed[ans] = leo_res[0][ans]
             fallback &= ~ans
+        if cache_res is not None:
+            # cached verdicts likewise rode inactive all-zero BFS slots
+            allowed[cache_res[0]] = cache_res[1][cache_res[0]]
+            fallback &= ~cache_res[0]
         fb = np.flatnonzero(fallback)
         if len(fb):
             # attribute each oracle fallback to the query's owner shard

@@ -28,6 +28,8 @@ import time
 import grpc
 
 from ketotpu import consistency, flightrec
+from ketotpu.cache import context as cache_context
+from ketotpu.cache import expand_key as cache_expand_key
 from ketotpu.api.proto_codec import (
     query_from_proto,
     tree_to_proto,
@@ -120,11 +122,15 @@ class CheckHandler:
         *, snaptoken=None, latest=False,
     ) -> bool:
         r = self.r.resolve(headers)
-        consistency.ensure_fresh(r, snaptoken, latest, op="check")
-        try:
-            return self.check_core(tuple_, max_depth, r)
-        except NotFoundError:
-            return False  # check/handler.go:169-171
+        token = consistency.ensure_fresh(r, snaptoken, latest, op="check")
+        # bind the request's consistency mode + the X-Keto-Cache escape
+        # hatch for the hot-spot shield probes further down the stack
+        with cache_context.request_scope(r, headers, token=token,
+                                         latest=latest):
+            try:
+                return self.check_core(tuple_, max_depth, r)
+            except NotFoundError:
+                return False  # check/handler.go:169-171
 
     def batch_check_core(self, tuples, max_depth: int, r=None):
         """Batched checks through the engine's batch surface (the TPU
@@ -182,6 +188,7 @@ class CheckHandler:
                 src = request.tuple if request.HasField("tuple") else request
                 tuple_ = tuple_from_proto(src)
                 flightrec.note_stage("parse", time.perf_counter() - t0)
+                token = None
                 if request.snaptoken or request.latest:
                     # the consistency modes (check_service.proto:51-66):
                     # `latest` forces a changelog drain into the engine's
@@ -192,7 +199,7 @@ class CheckHandler:
                     # engine is at-least-as-fresh, refusing with
                     # FAILED_PRECONDITION on budget expiry.
                     tb = time.perf_counter()
-                    consistency.ensure_fresh(
+                    token = consistency.ensure_fresh(
                         r, request.snaptoken or None, bool(request.latest),
                         op="check",
                     )
@@ -200,7 +207,12 @@ class CheckHandler:
                         "barrier", time.perf_counter() - tb
                     )
                 t1 = time.perf_counter()
-                allowed = self.check_core(tuple_, int(request.max_depth), r)
+                with cache_context.request_scope(
+                    r, md, token=token, latest=bool(request.latest)
+                ):
+                    allowed = self.check_core(
+                        tuple_, int(request.max_depth), r
+                    )
                 flightrec.note_stage("compute", time.perf_counter() - t1)
                 flightrec.note(verdict=allowed)
                 t2 = time.perf_counter()
@@ -221,10 +233,28 @@ class ExpandHandler:
 
     def expand_core(self, subject, max_depth: int, r=None):
         r = r if r is not None else self.r
+        cache = r.result_cache()
+        key = None
+        cursor = 0
+        if isinstance(subject, SubjectSet):
+            r.read_only_mapper().from_subject_set(subject)  # ns check
+            if cache is not None:
+                # hot-spot shield for expansion trees: same snapshot-
+                # versioned cache as checks, keyed on the expanded node
+                key = cache_expand_key(subject, max_depth)
+                t0 = time.perf_counter()
+                hit = cache.lookup(key)
+                flightrec.note_stage("cache", time.perf_counter() - t0)
+                if hit is not None:
+                    r.tracer().event(PERMISSIONS_EXPANDED)
+                    return hit.value
+                # stamp read BEFORE the build: a lower bound on the
+                # changelog state the tree is computed from
+                cursor = r.store().log_head
         with r.tracer().span("expand.Engine.BuildTree"):
-            if isinstance(subject, SubjectSet):
-                r.read_only_mapper().from_subject_set(subject)  # ns check
             tree = r.expand_engine().build_tree(subject, max_depth)
+        if key is not None:
+            cache.insert(key, tree, cursor)
         r.tracer().event(PERMISSIONS_EXPANDED)
         return tree
 
@@ -253,18 +283,22 @@ class ExpandHandler:
                 s = request.subject.set
                 subject = SubjectSet(s.namespace, s.object, s.relation)
                 flightrec.note_stage("parse", time.perf_counter() - t0)
+                token = None
                 if request.snaptoken:
                     # ExpandRequest.snaptoken (expand_service.proto): the
                     # tree must be at-least-as-fresh as the token
                     tb = time.perf_counter()
-                    consistency.ensure_fresh(
+                    token = consistency.ensure_fresh(
                         r, request.snaptoken, op="expand"
                     )
                     flightrec.note_stage(
                         "barrier", time.perf_counter() - tb
                     )
                 t1 = time.perf_counter()
-                tree = self.expand_core(subject, int(request.max_depth), r)
+                with cache_context.request_scope(r, md, token=token):
+                    tree = self.expand_core(
+                        subject, int(request.max_depth), r
+                    )
                 flightrec.note_stage("compute", time.perf_counter() - t1)
                 t2 = time.perf_counter()
                 if tree is None:

@@ -31,6 +31,7 @@ from typing import Callable, Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlencode, urlparse
 
 from ketotpu import consistency, deadline, flightrec
+from ketotpu.cache import context as cache_context
 from ketotpu.api.types import (
     BadRequestError,
     KetoAPIError,
@@ -351,11 +352,14 @@ def read_router(registry) -> Router:
         tuples_in = [RelationTuple.from_json(d or {}) for d in body["tuples"]]
         r = registry.resolve(req.headers)
         token, latest = _consistency_params(req.query)
+        decoded = None
         if token or latest:
-            consistency.ensure_fresh(r, token, latest, op="check")
-        results = check.batch_check_core(
-            tuples_in, _max_depth(req.query), r
-        )
+            decoded = consistency.ensure_fresh(r, token, latest, op="check")
+        with cache_context.request_scope(r, req.headers, token=decoded,
+                                         latest=latest):
+            results = check.batch_check_core(
+                tuples_in, _max_depth(req.query), r
+            )
         return 200, {
             "results": [{"allowed": a} for a in results],
             "snaptoken": check.snaptoken(r),
@@ -371,9 +375,12 @@ def read_router(registry) -> Router:
         )
         r = registry.resolve(req.headers)
         token, latest = _consistency_params(req.query)
+        decoded = None
         if token or latest:
-            consistency.ensure_fresh(r, token, latest, op="expand")
-        tree = expand.expand_core(subject, _max_depth(req.query), r)
+            decoded = consistency.ensure_fresh(r, token, latest, op="expand")
+        with cache_context.request_scope(r, req.headers, token=decoded,
+                                         latest=latest):
+            tree = expand.expand_core(subject, _max_depth(req.query), r)
         if tree is None:
             return 404, _error_body(404, "no relation tuple found")
         return 200, tree.to_json()
@@ -589,9 +596,14 @@ def metrics_router(registry) -> Router:
 
     def get_flight_recorder(req):
         # debug surface on the metrics port only (admin-port hygiene):
-        # the N slowest recent requests with their stage vectors
+        # the N slowest recent requests with their stage vectors, plus
+        # the hot-spot shield's top-K hottest keys (count-min estimates)
         rec = registry.flight_recorder()
-        return 200, {"slowest": rec.snapshot()}
+        rc = registry.result_cache()
+        return 200, {
+            "slowest": rec.snapshot(),
+            "hot_keys": rc.hot_keys() if rc is not None else [],
+        }
 
     rt.add("GET", "/debug/flight-recorder", get_flight_recorder)
     return rt

@@ -39,6 +39,9 @@ import time
 from typing import Callable, List, Optional, Sequence
 
 from ketotpu import deadline, faults, flightrec
+from ketotpu.cache import SingleFlight
+from ketotpu.cache import check_key as cache_check_key
+from ketotpu.cache import context as cache_context
 from ketotpu.api.types import (
     DeadlineExceededError,
     KetoAPIError,
@@ -127,7 +130,14 @@ class EngineHostServer:
         # slot wait and oracle-fallback loop on the owner side stay inside
         # what the worker's client granted
         ms = req.pop("deadline_ms", None)
+        # a worker serving X-Keto-Cache: bypass forwards the flag so the
+        # owner-side probe/insert (engine pre-dispatch, coalescer) see the
+        # bypass too — the escape hatch must hold across the process hop
+        bypass = bool(req.pop("cache_bypass", False))
         with deadline.scope(None if ms is None else ms / 1000.0):
+            if bypass:
+                with cache_context.scope(bypass=True):
+                    return self._serve_op(req, op, tp)
             return self._serve_op(req, op, tp)
 
     def _serve_op(self, req, op, tp):
@@ -141,6 +151,14 @@ class EngineHostServer:
                 flightrec.note_stage("parse", time.perf_counter() - t0)
                 eng = r.check_engine()
                 depth = int(req.get("depth", 0))
+                # cursor piggyback for the workers' local caches: the store
+                # head read BEFORE the compute is a lower bound on the state
+                # every verdict in this response is computed from — the
+                # engine's dispatch drains the changelog to at least this
+                # position (oracle engines read the live store outright).
+                # Workers stamp their cache entries with it and advance
+                # their staleness fence.
+                cur = r.store().log_head
                 if len(tuples) == 1:
                     # single-check RPCs from the workers MUST go through
                     # check_is_member: that is the coalescer's enqueue point,
@@ -150,13 +168,13 @@ class EngineHostServer:
                     # singles there made each RPC its own device dispatch.
                     ok = [bool(eng.check_is_member(tuples[0], depth))]
                     flightrec.note(verdict=ok[0])
-                    return {"ok": ok}
+                    return {"ok": ok, "cursor": int(cur)}
                 batch = getattr(eng, "batch_check", None)
                 if batch is not None:
                     ok = batch(tuples, depth)
                 else:  # oracle engine: sequential surface only
                     ok = [eng.check_is_member(t, depth) for t in tuples]
-                return {"ok": [bool(v) for v in ok]}
+                return {"ok": [bool(v) for v in ok], "cursor": int(cur)}
         if op == "expand":
             with flightrec.rpc_recording(
                 r, "expand", traceparent=tp, detail="worker->owner expand"
@@ -294,11 +312,20 @@ class RemoteCheckEngine:
     backoff_base = 0.025
     backoff_cap = 0.25
 
-    def __init__(self, path: str, *, rpc_timeout: float = 30.0):
+    def __init__(self, path: str, *, rpc_timeout: float = 30.0,
+                 cache=None, metrics=None):
         self.path = path
         # budget for calls with no request deadline: a wedged owner must
         # surface as an error, not hang every worker thread (<=0 disables)
         self.rpc_timeout = rpc_timeout
+        # hot-spot shield, worker side: this process's own ResultCache over
+        # the shared store — a hot key answered here never crosses the
+        # socket at all.  Verdicts coming back from the owner are stamped
+        # with the owner's piggybacked changelog cursor, and that cursor
+        # also advances the local staleness fence (the owner broadcasting
+        # its drain position to every worker that talks to it).
+        self.cache = cache
+        self._flight = SingleFlight(metrics=metrics)
         self.reconnects = 0  # observability: retried transport failures
         self._local = threading.local()
 
@@ -378,18 +405,55 @@ class RemoteCheckEngine:
     ) -> List[bool]:
         if not queries:
             return []
-        resp = self._call({
+        bypass = cache_context.bypassed()
+        cache = None if bypass else self.cache
+        results: List[Optional[bool]] = [None] * len(queries)
+        miss = list(range(len(queries)))
+        if cache is not None:
+            hits = cache.lookup_many(
+                [cache_check_key(q, rest_depth) for q in queries]
+            )
+            miss = [i for i, h in enumerate(hits) if h is None]
+            for i, h in enumerate(hits):
+                if h is not None:
+                    results[i] = bool(h.value)
+            if not miss:
+                return [bool(v) for v in results]
+        req = {
             "op": "check",
-            "tuples": [str(q) for q in queries],
+            "tuples": [str(queries[i]) for i in miss],
             "depth": rest_depth,
-        })
-        return [bool(v) for v in resp["ok"]]
+        }
+        if bypass:
+            req["cache_bypass"] = True
+        resp = self._call(req)
+        cur = resp.get("cursor")
+        if cache is not None and cur is not None:
+            cache.advance_fence(int(cur))
+            for i, v in zip(miss, resp["ok"]):
+                cache.insert(
+                    cache_check_key(queries[i], rest_depth), bool(v), int(cur)
+                )
+        for i, v in zip(miss, resp["ok"]):
+            results[i] = bool(v)
+        return [bool(v) for v in results]
 
     def check(self, r: RelationTuple, rest_depth: int = 0) -> bool:
         return self.batch_check([r], rest_depth)[0]
 
     def check_is_member(self, r: RelationTuple, rest_depth: int = 0) -> bool:
-        return self.check(r, rest_depth)
+        if cache_context.bypassed():
+            return self.check(r, rest_depth)
+        # worker-side singleflight: a thundering herd on one hot key in
+        # THIS process collapses to one owner RPC; followers park
+        # deadline-aware and share the leader's verdict (the leader's
+        # batch_check also lands it in the local cache for the next wave)
+        value, _led = self._flight.do(
+            (str(r), int(rest_depth)),
+            lambda: self.check(r, rest_depth),
+            default_timeout=self.rpc_timeout if self.rpc_timeout > 0 else None,
+        )
+        return bool(value)
 
     def consistency_barrier(
         self, snaptoken: Optional[str] = None, latest: bool = False,

@@ -1,0 +1,568 @@
+"""Hot-spot shield tests (ketotpu/cache/): snapshot-versioned result
+cache, singleflight dedup, count-min hot-key detection, and the
+randomized write-storm parity suite.
+
+The contract under test is Zanzibar §3.2.5 translated to snaptokens: a
+cached verdict served under ANY consistency mode must be bit-identical
+to what a cache-bypassed check would answer at the same snaptoken — the
+cache may only trade latency, never freshness beyond the mode's own
+contract.  The storm legs interleave Transact writes/deletes with cached
+checks across all three modes (default / at-least-as-fresh / latest) and
+compare every cached verdict against the ``X-Keto-Cache: bypass`` path;
+the slow leg replays the storm against a real ``serve --workers 2``
+topology where the worker caches are fed by owner cursor piggybacks.
+"""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from ketotpu import deadline
+from ketotpu.api.types import (
+    DeadlineExceededError,
+    RelationTuple,
+)
+from ketotpu.cache import (
+    HotSpotSketch,
+    ResultCache,
+    SingleFlight,
+    check_key,
+    expand_key,
+    pretty_key,
+)
+from ketotpu.cache import context as cache_context
+from ketotpu.consistency.tokens import Snaptoken
+from ketotpu.driver import Provider, Registry
+from ketotpu.utils.synth import build_synth, synth_queries
+
+FIXTURES = pathlib.Path(__file__).parent / "fixtures"
+
+T = RelationTuple.from_string
+K = check_key(T("Doc:d1#view@u1"), 0)
+
+
+# -- hot-spot sketch ----------------------------------------------------------
+
+
+class TestHotSpotSketch:
+    def test_counts_rise_and_estimate_does_not_increment(self):
+        s = HotSpotSketch()
+        for _ in range(5):
+            s.observe(K)
+        assert s.estimate(K) >= 5
+        before = s.estimate(K)
+        s.estimate(K)
+        assert s.estimate(K) == before
+
+    def test_top_orders_hottest_first(self):
+        s = HotSpotSketch(top_k=4)
+        keys = [check_key(T(f"Doc:d{i}#view@u1"), 0) for i in range(6)]
+        for i, k in enumerate(keys):
+            for _ in range(i + 1):
+                s.observe(k)
+        top = s.top()
+        assert len(top) <= 4
+        counts = [c for _, c in top]
+        assert counts == sorted(counts, reverse=True)
+        assert top[0][0] == keys[-1]
+
+    def test_observe_many_matches_sequential(self):
+        a, b = HotSpotSketch(), HotSpotSketch()
+        keys = [check_key(T(f"Doc:d{i % 7}#view@u{i % 3}"), 0)
+                for i in range(100)]
+        for k in keys:
+            a.observe(k)
+        b.observe_many(keys)
+        for k in set(keys):
+            assert a.estimate(k) == b.estimate(k)
+
+    def test_decay_halves_counts(self):
+        s = HotSpotSketch(decay_every=64)
+        for _ in range(63):
+            s.observe(K)
+        high = s.estimate(K)
+        s.observe(K)  # crosses the decay boundary
+        assert s.estimate(K) <= high // 2 + 1
+
+
+# -- singleflight -------------------------------------------------------------
+
+
+class TestSingleFlight:
+    def test_concurrent_identical_calls_collapse(self):
+        sf = SingleFlight()
+        gate = threading.Event()
+        calls = []
+
+        def fn():
+            gate.wait(5.0)
+            calls.append(1)
+            return 42
+
+        results = []
+        threads = [
+            threading.Thread(
+                target=lambda: results.append(sf.do("k", fn)))
+            for _ in range(8)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(0.1)  # let every follower park
+        gate.set()
+        for t in threads:
+            t.join()
+        assert [v for v, _ in results] == [42] * 8
+        assert len(calls) == 1
+        assert sum(1 for _, led in results if led) == 1
+        assert sf.collapsed == 7
+
+    def test_leader_exception_propagates_to_followers(self):
+        sf = SingleFlight()
+        gate = threading.Event()
+
+        def fn():
+            gate.wait(5.0)
+            raise ValueError("boom")
+
+        errors = []
+
+        def run():
+            try:
+                sf.do("k", fn)
+            except ValueError as e:
+                errors.append(e)
+
+        threads = [threading.Thread(target=run) for _ in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.05)
+        gate.set()
+        for t in threads:
+            t.join()
+        assert len(errors) == 4
+        assert len({id(e) for e in errors}) == 1  # same exception object
+
+    def test_follower_deadline_detaches_without_cancelling_leader(self):
+        sf = SingleFlight()
+        release = threading.Event()
+        leader_done = threading.Event()
+
+        def fn():
+            release.wait(5.0)
+            leader_done.set()
+            return "late"
+
+        leader = threading.Thread(target=lambda: sf.do("k", fn))
+        leader.start()
+        time.sleep(0.05)
+        with pytest.raises(DeadlineExceededError):
+            with deadline.scope(0.05):
+                sf.do("k", lambda: "never")
+        assert not leader_done.is_set()  # follower left, leader still going
+        release.set()
+        leader.join()
+        assert leader_done.is_set()
+
+    def test_sequential_calls_do_not_collapse(self):
+        sf = SingleFlight()
+        v1, led1 = sf.do("k", lambda: 1)
+        v2, led2 = sf.do("k", lambda: 2)
+        assert (v1, led1) == (1, True)
+        assert (v2, led2) == (2, True)  # fresh flight, not a stale read
+        assert sf.collapsed == 0
+
+
+# -- result cache -------------------------------------------------------------
+
+
+class TestResultCache:
+    def test_roundtrip_and_hit_stats(self):
+        rc = ResultCache()
+        assert rc.lookup(K) is None
+        rc.insert(K, True, 5)
+        hit = rc.lookup(K)
+        assert hit is not None and hit.value is True and hit.cursor == 5
+        assert rc.stats()["hits"] == 1 and rc.stats()["misses"] == 1
+
+    def test_default_mode_respects_fence(self):
+        rc = ResultCache()
+        rc.insert(K, True, 5)
+        rc.advance_fence(9)
+        assert rc.lookup(K) is None  # entry predates the fence
+        rc.insert(K, True, 9)
+        assert rc.lookup(K) is not None
+
+    def test_namespace_fence_evicts_lazily(self):
+        g = build_synth(n_users=4, n_groups=2, n_folders=2, n_docs=4)
+        rc = ResultCache()
+        rc.attach_store(g.store)
+        head = g.store.log_head
+        doc_key = check_key(T("Doc:d1#view@u1"), 0)
+        grp_key = check_key(T("Group:g0#members@u1"), 0)
+        rc.insert(doc_key, True, head)
+        rc.insert(grp_key, True, head)
+        g.store.transact_relation_tuples(
+            [T("Doc:d1#viewers@u3")], []
+        )
+        # both miss default mode (the global fence advanced), but only
+        # the Doc entry is EVICTED — the Group entry survives and still
+        # serves an at-least-as-fresh request with an older token
+        assert rc.lookup(doc_key) is None
+        assert rc.lookup(grp_key) is None
+        assert rc.evictions == 1
+        with cache_context.scope(token=Snaptoken(version=1, cursor=head)):
+            assert rc.lookup(grp_key) is not None
+        with cache_context.scope(token=Snaptoken(version=1, cursor=head)):
+            assert rc.lookup(doc_key) is None  # fenced out for good
+
+    def test_lru_bounded(self):
+        rc = ResultCache(max_entries=8, shards=1)
+        for i in range(20):
+            rc.insert(check_key(T(f"Doc:d{i}#view@u1"), 0), True, 1)
+        assert len(rc) <= 8
+        assert rc.evictions >= 12
+
+    def test_never_replaces_fresher_with_staler(self):
+        rc = ResultCache()
+        rc.insert(K, True, 9)
+        assert rc.insert(K, False, 5) is False
+        assert rc.lookup(K).value is True
+
+    def test_token_mode_uses_satisfies_cursor(self):
+        rc = ResultCache()
+        rc.insert(K, True, 5)
+        with cache_context.scope(token=Snaptoken(version=1, cursor=5)):
+            assert rc.lookup(K) is not None
+        with cache_context.scope(token=Snaptoken(version=1, cursor=6)):
+            assert rc.lookup(K) is None  # entry is staler than the token
+        # legacy version-only tokens can never be proven fresh by a cursor
+        with cache_context.scope(token=Snaptoken(version=1, cursor=-1)):
+            assert rc.lookup(K) is None
+
+    def test_latest_mode_floor(self):
+        rc = ResultCache()
+        rc.insert(K, True, 5)
+        with cache_context.scope(floor=5):
+            assert rc.lookup(K) is not None
+        with cache_context.scope(floor=6):
+            assert rc.lookup(K) is None
+
+    def test_bypass_blocks_lookup_and_insert(self):
+        rc = ResultCache()
+        with cache_context.scope(bypass=True):
+            assert rc.insert(K, True, 5) is False
+            assert rc.lookup(K) is None
+        assert len(rc) == 0
+        rc.insert(K, True, 5)
+        with cache_context.scope(bypass=True):
+            assert rc.lookup(K) is None
+        assert rc.hits == 0
+
+    def test_nested_scope_keeps_outer_bypass(self):
+        with cache_context.scope(bypass=True):
+            with cache_context.scope(token=Snaptoken(version=1, cursor=1)):
+                assert cache_context.bypassed()
+        assert not cache_context.bypassed()
+
+    def test_hot_threshold_gates_admission(self):
+        rc = ResultCache(hot_threshold=3)
+        assert rc.insert(K, True, 1) is False  # cold key: not admitted
+        for _ in range(4):
+            rc.lookup(K)
+        assert rc.insert(K, True, 1) is True  # probes made it hot
+
+    def test_changelog_overflow_fences_everything(self):
+        g = build_synth(n_users=4, n_groups=2, n_folders=2, n_docs=4)
+        rc = ResultCache()
+        rc.attach_store(g.store)
+        rc.insert(K, True, g.store.log_head)
+        if not hasattr(g.store, "_log_cap"):
+            pytest.skip("store exposes no changelog capacity")
+        g.store._log_cap = 4  # force an overflow cheaply
+        for i in range(8):
+            g.store.transact_relation_tuples(
+                [T(f"Doc:d0#viewers@burst{i}")], []
+            )
+        assert rc.lookup(K) is None
+
+    def test_hot_keys_view(self):
+        rc = ResultCache()
+        for _ in range(5):
+            rc.lookup(K)
+        hot = rc.hot_keys()
+        assert hot and hot[0]["key"] == pretty_key(K)
+        assert hot[0]["count"] >= 5
+
+    def test_expand_key_distinct_from_check_key(self):
+        from ketotpu.api.types import SubjectSet
+
+        s = SubjectSet("Doc", "d1", "view")
+        assert expand_key(s, 0) != check_key(T("Doc:d1#view@u1"), 0)
+
+
+# -- write-storm parity (fast legs) -------------------------------------------
+
+
+def _storm_registry(**cache_overrides):
+    g = build_synth(n_users=40, n_groups=8, n_folders=16, n_docs=60)
+    cfg = {
+        "engine": {"kind": "tpu", "frontier": 1024, "arena": 4096,
+                   "max_batch": 256, "coalesce_ms": 1},
+        "cache": dict({"enabled": True}, **cache_overrides),
+        "log": {"request_log": False},
+    }
+    r = Registry(Provider(cfg), store=g.store, namespace_manager=g.manager)
+    return g, r
+
+
+def _run_storm(g, r, *, rounds=6, sample=8, seed=7):
+    """Interleave random viewer grants/revokes with checks in all three
+    consistency modes; every cached verdict must equal the bypassed one
+    asked back-to-back (no write lands between the pair)."""
+    import numpy as np
+
+    from ketotpu.server.handlers import CheckHandler, RelationTupleHandler
+
+    rng = np.random.default_rng(seed)
+    check = CheckHandler(r)
+    tuples = RelationTupleHandler(r)
+    granted = []
+    # a small query pool revisited every round so the cache actually
+    # serves (the whole point of the shield is repeat traffic)
+    pool = synth_queries(g, 24, seed=seed)
+
+    for rnd in range(rounds):
+        writes, deletes = [], []
+        for _ in range(int(rng.integers(1, 4))):
+            q = pool[int(rng.integers(len(pool)))]
+            t = RelationTuple("Doc", q.object, "viewers", q.subject)
+            writes.append(t)
+            granted.append(t)
+        if granted and rng.random() < 0.5:
+            deletes.append(granted.pop(int(rng.integers(len(granted)))))
+        tuples.transact_core(writes, deletes)
+        token = check.snaptoken()
+
+        idx = rng.choice(len(pool), size=sample, replace=False)
+        for i in idx:
+            q = pool[int(i)]
+            for mode in ("default", "token", "latest"):
+                kw = {}
+                if mode == "token":
+                    kw["snaptoken"] = token
+                elif mode == "latest":
+                    kw["latest"] = True
+                cached = check.check_rest(q, 0, {}, **kw)
+                bypass = check.check_rest(
+                    q, 0, {"x-keto-cache": "bypass"}, **kw
+                )
+                assert cached == bypass, (
+                    f"round {rnd} mode {mode}: cached={cached} "
+                    f"bypass={bypass} for {q}"
+                )
+
+
+def test_write_storm_parity_all_modes():
+    g, r = _storm_registry()
+    _run_storm(g, r)
+    rc = r.result_cache()
+    assert rc is not None
+    # ISSUE acceptance: the shield observably served traffic
+    assert rc.hits > 0, rc.stats()
+    assert r.metrics().get_counter(
+        "keto_cache_hits_total", op="check"
+    ) > 0
+
+
+def test_write_storm_parity_strict_staleness():
+    # max_staleness_ms=0: every probe re-syncs the fence from the
+    # changelog — the tightest default-mode contract
+    g, r = _storm_registry(max_staleness_ms=0)
+    _run_storm(g, r, rounds=4, seed=11)
+    assert r.result_cache().hits > 0
+
+
+def test_cache_disabled_still_correct():
+    g, r = _storm_registry(enabled=False)
+    assert r.result_cache() is None
+    _run_storm(g, r, rounds=2, seed=13)
+
+
+def test_concurrent_identical_checks_collapse_through_handler():
+    # acceptance: keto_singleflight_collapsed_total observably nonzero —
+    # a cold-key herd through the full handler path collapses onto one
+    # batch slot in the coalescer
+    g, r = _storm_registry()
+    from ketotpu.server.handlers import CheckHandler
+
+    check = CheckHandler(r)
+    q = synth_queries(g, 1, seed=17)[0]
+    want = []
+
+    def run():
+        want.append(check.check_rest(q, 0, {}))
+
+    threads = [threading.Thread(target=run) for _ in range(12)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(set(want)) == 1
+    collapsed = r.metrics().get_counter("keto_singleflight_collapsed_total")
+    engine = r.check_engine()
+    assert engine.singleflight_collapsed > 0
+    assert collapsed and collapsed > 0
+
+
+def test_bypass_header_skips_cache_end_to_end():
+    g, r = _storm_registry()
+    from ketotpu.server.handlers import CheckHandler
+
+    check = CheckHandler(r)
+    q = synth_queries(g, 1, seed=19)[0]
+    check.check_rest(q, 0, {})  # warm
+    rc = r.result_cache()
+    hits_before = rc.hits
+    for _ in range(3):
+        check.check_rest(q, 0, {"x-keto-cache": "bypass"})
+    assert rc.hits == hits_before
+
+
+# -- write-storm parity (slow leg: serve --workers 2) -------------------------
+
+
+def _free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _http(method, url, body=None, headers=None, timeout=30.0):
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(
+        url, data=body, method=method, headers=headers or {}
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read().decode(), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode(), dict(e.headers)
+
+
+@pytest.mark.slow
+def test_write_storm_parity_worker_topology(tmp_path):
+    """The storm against a real ``serve --workers 2`` boot: worker-local
+    caches fed by owner cursor piggybacks must stay bit-identical to the
+    bypassed path in every mode, and the shield's counters must be
+    observably nonzero on the metrics surface."""
+    db = tmp_path / "cache.db"
+    seed = Registry(Provider({"dsn": f"sqlite://{db}"}))
+    seed.store().migrate_up()
+
+    ports = {n: _free_port() for n in ("read", "write", "metrics", "opl")}
+    config = {
+        "dsn": f"sqlite://{db}",
+        "serve": {
+            n: {"host": "127.0.0.1", "port": p} for n, p in ports.items()
+        },
+        "namespaces": {
+            "location": str(FIXTURES / "rewrites_namespaces.keto.ts")
+        },
+        "engine": {"kind": "tpu", "frontier": 512, "arena": 2048,
+                   "max_batch": 128, "mesh_devices": 0,
+                   "mesh_axis": "shard"},
+        "consistency": {"barrier_timeout_ms": 5000},
+        "cache": {"enabled": True, "max_staleness_ms": 50},
+        "log": {"request_log": False},
+    }
+    cfg_path = tmp_path / "cache.json"
+    cfg_path.write_text(json.dumps(config))
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ketotpu.cli", "serve",
+         "-c", str(cfg_path), "--workers", "2"],
+        env=env, cwd=str(pathlib.Path(__file__).parent.parent),
+    )
+    read = f"http://127.0.0.1:{ports['read']}"
+    write = f"http://127.0.0.1:{ports['write']}"
+    metrics = f"http://127.0.0.1:{ports['metrics']}"
+    try:
+        ready_by = time.monotonic() + 180.0
+        while True:
+            assert proc.poll() is None, "serve --workers died during boot"
+            try:
+                if _http("GET", f"{metrics}/health/ready",
+                         timeout=2.0)[0] == 200:
+                    break
+            except OSError:
+                pass
+            assert time.monotonic() < ready_by, "topology never became ready"
+            time.sleep(0.5)
+
+        def check_url(i, mode, token=None):
+            url = (f"{read}/relation-tuples/check/openapi?namespace=File"
+                   f"&object=s{i}&relation=owners&subject_id=user{i}")
+            if mode == "token":
+                url += f"&snaptoken={token}"
+            elif mode == "latest":
+                url += "&latest=true"
+            return url
+
+        for rnd in range(6):
+            t = RelationTuple.from_string(f"File:s{rnd}#owners@user{rnd}")
+            status, _, headers = _http(
+                "PUT", f"{write}/admin/relation-tuples",
+                json.dumps(t.to_json()).encode(),
+                {"Content-Type": "application/json"},
+            )
+            assert status == 201, f"write {rnd} failed"
+            token = headers.get("X-Keto-Snaptoken")
+            assert token
+            # revisit every object written so far, all three modes,
+            # cached vs bypassed back-to-back (twice, so repeat traffic
+            # actually lands in and serves from the worker caches)
+            for i in range(rnd + 1):
+                for mode in ("default", "token", "latest"):
+                    for _ in range(2):
+                        s1, b1, _ = _http(
+                            "GET", check_url(i, mode, token))
+                        s2, b2, _ = _http(
+                            "GET", check_url(i, mode, token),
+                            headers={"X-Keto-Cache": "bypass"},
+                        )
+                        assert s1 == 200 and s2 == 200, (s1, b1, s2, b2)
+                        a1 = json.loads(b1)["allowed"]
+                        a2 = json.loads(b2)["allowed"]
+                        assert a1 == a2 == True, (  # noqa: E712
+                            f"round {rnd} obj {i} mode {mode}: "
+                            f"cached={a1} bypass={a2}"
+                        )
+
+        _, prom, _ = _http("GET", f"{metrics}/metrics/prometheus")
+        hits = [
+            line for line in prom.splitlines()
+            if line.startswith("keto_cache_hits_total")
+        ]
+        assert hits, "keto_cache_hits_total absent from metrics"
+        assert any(float(line.rsplit(" ", 1)[1]) > 0 for line in hits), hits
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=20)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=10)

@@ -799,10 +799,13 @@ def test_wedged_engine_answers_deadline_exceeded_fast():
         assert status == 200, body
         faults.configure(device_stall_ms=5000.0)
 
+        # the warm pass cached this exact verdict — bypass the hot-spot
+        # shield so the check actually reaches the wedged device
         t0 = time.monotonic()
         status, body, _ = _http(
             "GET", _check_url(read, CASES[0][0]),
-            headers={"X-Request-Timeout": "50ms"},
+            headers={"X-Request-Timeout": "50ms",
+                     "X-Keto-Cache": "bypass"},
         )
         rest_elapsed = time.monotonic() - t0
         assert status == 504, body
@@ -818,7 +821,8 @@ def test_wedged_engine_answers_deadline_exceeded_fast():
             )
             t0 = time.monotonic()
             with pytest.raises(grpc.RpcError) as ei:
-                stub.Check(req, timeout=0.05)
+                stub.Check(req, timeout=0.05,
+                           metadata=(("x-keto-cache", "bypass"),))
             grpc_elapsed = time.monotonic() - t0
             assert ei.value.code() == grpc.StatusCode.DEADLINE_EXCEEDED
             assert grpc_elapsed < 1.0, f"took {grpc_elapsed:.3f}s"

@@ -112,6 +112,60 @@ def test_check_after_close_answers_directly(setup):
     assert eng.check_is_member(q) == dev.oracle.check_is_member(q)
 
 
+def test_identical_concurrent_checks_share_one_slot():
+    # hot-spot shield: N identical concurrent checks must occupy ONE batch
+    # slot (the Zanzibar lock-table dedup) — the wave dispatches a batch of
+    # length 1 and every caller gets the shared verdict
+    class Recorder:
+        def __init__(self):
+            self.batches = []
+
+        def batch_check(self, queries, depth=0):
+            self.batches.append(list(queries))
+            return [True] * len(queries)
+
+    inner = Recorder()
+    eng = CoalescingEngine(inner, window=0.1)
+    q = T("Doc:d0#view@u1")
+    n = 16
+    got = []
+    lock = threading.Lock()
+
+    def worker():
+        v = eng.check_is_member(q)
+        with lock:
+            got.append(v)
+
+    threads = [threading.Thread(target=worker) for _ in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert got == [True] * n
+    # every dispatched batch is deduped: the identical checks never
+    # occupy more than one slot per wave (thread-start timing may split
+    # the herd over a couple of waves, but within a wave there is one)
+    for batch in inner.batches:
+        assert len(batch) == 1, batch
+    total_slots = sum(len(b) for b in inner.batches)
+    assert eng.singleflight_collapsed == n - total_slots
+    assert eng.singleflight_collapsed > 0
+    eng.close()
+
+
+def test_followers_start_fresh_flight_after_wave(setup):
+    # a check arriving AFTER its twin's wave was cut must not read a
+    # settled slot: it starts a fresh flight and still answers correctly
+    graph, dev = setup
+    eng = CoalescingEngine(dev, window=0.001)
+    q = synth_queries(graph, 1, seed=23)[0]
+    want = dev.oracle.check_is_member(q)
+    assert eng.check_is_member(q) == want
+    assert eng.check_is_member(q) == want
+    assert eng.singleflight_collapsed == 0
+    eng.close()
+
+
 def test_unexpected_error_raises_wave_without_serial_fallback():
     # advisor r2: a transient device failure must NOT degrade the wave to
     # per-query serial dispatches on the lone worker thread — it re-raises
