@@ -1,7 +1,11 @@
 """Benchmark: end-to-end batched permission checks per second.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...} —
-ALWAYS, exit code 0, even when the device backend is down.  Round 4's
+ALWAYS, even when the device backend is down.  Exit code is 0 except for
+one deliberate signal: 3 when the steady-state compile gate trips (an
+XLA compile fired inside a timed pass that had been warmed at the exact
+shape — a shape-discipline regression; see `_steady`).  The JSON line is
+printed BEFORE the nonzero exit so the evidence always lands.  Round 4's
 lesson (VERDICT r4 #1): the TPU tunnel failed to initialize, bench.py
 died at its first device call with rc=1, and a whole round of perf work
 produced zero driver-verified numbers.  Now every section runs under its
@@ -36,6 +40,7 @@ driver; set JAX_PLATFORMS=cpu to try it without one).
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import subprocess
@@ -129,6 +134,26 @@ def _cpu_codegen_guard() -> None:
         ).strip()
 
 
+@contextlib.contextmanager
+def _steady(out, section):
+    """Steady-state compile gate: every timed pass wrapped in this context
+    has already been warmed at its EXACT shape, so any XLA compile firing
+    inside it is a shape-discipline regression (an adaptive schedule or
+    bucket decision changed between the warm and timed passes) AND it
+    poisons the number being measured — a ~3s CPU compile inside a 20ms
+    pass was the whole BENCH_r05 "anomaly".  Trips the section into
+    `steady_state_compiles` and the process into exit code 3."""
+    from ketotpu import compilewatch
+
+    w = compilewatch.get()
+    before = w.compiles_total
+    yield
+    delta = w.compiles_total - before
+    if delta:
+        gate = out.setdefault("steady_state_compiles", {})
+        gate[section] = gate.get(section, 0) + delta
+
+
 class _Sections:
     """Run each bench section under its own guard; a failure records an
     error entry and the remaining sections still run (device-section
@@ -150,7 +175,7 @@ class _Sections:
             return False
 
 
-def main() -> None:
+def main() -> int:
     out: dict = {}
     baseline = 1e9 / BASELINE_NS_PER_OP
     state: dict = {}
@@ -224,7 +249,17 @@ def main() -> None:
             return
         if name in in_process:
             state["backend_touched"] = True
+        # per-section compile accounting (subprocess sections like
+        # serving_workers legitimately read 0: their compiles happen in
+        # the worker process).  Imported here — after the probe/fallback
+        # has settled JAX_PLATFORMS — never before.
+        from ketotpu import compilewatch
+
+        before = compilewatch.get().compiles_total
         sec.run(name, fn, *a)
+        delta = compilewatch.get().compiles_total - before
+        if delta:
+            out.setdefault("compile_counts", {})[name] = delta
         _reprobe_original(out, state, name)
 
     run("host_build", _host_build, out, state)
@@ -248,7 +283,16 @@ def main() -> None:
         run("leopard_10m", _leopard_10m, out, state)
 
     _publish_phases(out, state)
+    try:
+        from ketotpu import compilewatch
+
+        out["xla_compiles_total"] = compilewatch.get().compiles_total
+    except Exception:  # noqa: BLE001 — diagnostics never void the JSON
+        pass
+    tripped = bool(out.get("steady_state_compiles"))
+    out["compile_gate"] = "fail" if tripped else "pass"
     print(json.dumps(out))
+    return 3 if tripped else 0
 
 
 REPROBE_TIMEOUT_S = float(os.environ.get("KETO_BENCH_REPROBE_TIMEOUT", 30.0))
@@ -366,14 +410,15 @@ def _fast_path(out, state, baseline) -> None:
     _, fallback = eng.batch_check_device_only(batches[0])
     eng.batch_check(batches[0])
     eng.batch_check(batches[0])  # second pass compiles the adaptive schedule
-    t0 = time.perf_counter()
-    done = 0
-    times = []
-    for b in batches:
-        bt = time.perf_counter()
-        done += len(eng.batch_check(b))
-        times.append(time.perf_counter() - bt)
-    dt = time.perf_counter() - t0
+    with _steady(out, "fast_path"):
+        t0 = time.perf_counter()
+        done = 0
+        times = []
+        for b in batches:
+            bt = time.perf_counter()
+            done += len(eng.batch_check(b))
+            times.append(time.perf_counter() - bt)
+        dt = time.perf_counter() - t0
     checks_per_sec = done / dt
     out.update(
         metric="check_throughput",
@@ -399,16 +444,18 @@ def _mixed_general(out, state) -> None:
     # compiles the demand-adapted variant the timed run will execute
     eng.batch_check(mixed)
     eng.batch_check(mixed)
-    t0 = time.perf_counter()
-    got = eng.batch_check(mixed)
-    mixed_cps = len(got) / (time.perf_counter() - t0)
+    with _steady(out, "mixed_general"):
+        t0 = time.perf_counter()
+        got = eng.batch_check(mixed)
+        mixed_cps = len(got) / (time.perf_counter() - t0)
     n_general = sum(q.relation == "edit" for q in mixed)
     pure_general = [q for q in mixed if q.relation == "edit"]
     eng.batch_check(pure_general)  # warm: its chunk shape differs from 10k's
     eng.batch_check(pure_general)
-    t0 = time.perf_counter()
-    eng.batch_check(pure_general)
-    general_cps = len(pure_general) / (time.perf_counter() - t0)
+    with _steady(out, "mixed_general"):
+        t0 = time.perf_counter()
+        eng.batch_check(pure_general)
+        general_cps = len(pure_general) / (time.perf_counter() - t0)
     out.update(
         mixed_10k_checks_per_sec=round(mixed_cps, 1),
         mixed_general_frac=round(n_general / len(mixed), 3),
@@ -430,10 +477,11 @@ def _wave_latency(out, state) -> None:
         eng.batch_check_device_only(wq, retry=False)
         eng.batch_check_device_only(wq, retry=False)  # adaptive-shape warm
         lats = []
-        for _ in range(20):
-            t0 = time.perf_counter()
-            eng.batch_check_device_only(wq, retry=False)
-            lats.append(time.perf_counter() - t0)
+        with _steady(out, "wave_latency"):
+            for _ in range(20):
+                t0 = time.perf_counter()
+                eng.batch_check_device_only(wq, retry=False)
+                lats.append(time.perf_counter() - t0)
         lats.sort()
         p50 = lats[len(lats) // 2]
         p99 = lats[min(len(lats) - 1, int(len(lats) * 0.99))]
@@ -454,12 +502,13 @@ def _expand(out, state) -> None:
     ]
     eng.batch_expand(roots, 5)  # compile at the measured batch shape
     fb0 = eng.fallbacks
-    t0 = time.perf_counter()
-    trees = eng.batch_expand(roots, 5)
-    expand_tps = len(trees) / (time.perf_counter() - t0)
+    with _steady(out, "expand"):
+        t0 = time.perf_counter()
+        trees = eng.batch_expand(roots, 5)
+        expand_tps = len(trees) / (time.perf_counter() - t0)
     # per-call latency (the metric's p50/p99 half for Expand): single-root
     # expands, the interactive shape a UI permission tree fetch hits
-    p50, p99 = _expand_latency(eng, roots[:1], samples=40)
+    p50, p99 = _expand_latency(eng, roots[:1], samples=40, gate=(out, "expand"))
     out.update(
         expand_trees_per_sec=round(expand_tps, 1),
         expand_depth=5,
@@ -469,14 +518,18 @@ def _expand(out, state) -> None:
     )
 
 
-def _expand_latency(eng, roots, *, samples: int, depth: int = 5):
-    """(p50_ms, p99_ms) over repeated single-root batch_expand calls."""
+def _expand_latency(eng, roots, *, samples: int, depth: int = 5, gate=None):
+    """(p50_ms, p99_ms) over repeated single-root batch_expand calls.
+    `gate=(out, section)` arms the steady-state compile gate around the
+    timed loop (the 1-root warm call stays outside it)."""
     eng.batch_expand(roots, depth)  # compile the 1-root shape
     lats = []
-    for _ in range(samples):
-        t0 = time.perf_counter()
-        eng.batch_expand(roots, depth)
-        lats.append(time.perf_counter() - t0)
+    ctx = _steady(*gate) if gate else contextlib.nullcontext()
+    with ctx:
+        for _ in range(samples):
+            t0 = time.perf_counter()
+            eng.batch_expand(roots, depth)
+            lats.append(time.perf_counter() - t0)
     lats.sort()
     p50 = lats[len(lats) // 2]
     p99 = lats[min(len(lats) - 1, int(len(lats) * 0.99))]
@@ -619,7 +672,8 @@ def _cache_shield(out, state) -> None:
 
     off = CoalescingEngine(eng, window=0.001)
     drive(off)  # warm compile shapes
-    uncached_per_sec = drive(off)
+    with _steady(out, "cache_shield"):
+        uncached_per_sec = drive(off)
     off.close()
 
     rc = ResultCache(max_entries=65536, shards=8)
@@ -628,7 +682,8 @@ def _cache_shield(out, state) -> None:
     try:
         on = CoalescingEngine(eng, window=0.001, cache=rc)
         drive(on)  # warm the cache
-        cached_per_sec = drive(on)
+        with _steady(out, "cache_shield"):
+            cached_per_sec = drive(on)
         hit_ratio = rc.stats()["hit_ratio"]
         on.close()
     finally:
@@ -692,9 +747,10 @@ def _scale_10m(out, state, baseline) -> None:
     _, bfb = beng.batch_check_device_only(bqs[:BATCH])
     beng.batch_check(bqs[:BATCH])
     beng.batch_check(bqs[:BATCH])
-    t0 = time.perf_counter()
-    bdone = len(beng.batch_check(bqs[BATCH:]))
-    big_cps = bdone / (time.perf_counter() - t0)
+    with _steady(out, "scale_10m"):
+        t0 = time.perf_counter()
+        bdone = len(beng.batch_check(bqs[BATCH:]))
+        big_cps = bdone / (time.perf_counter() - t0)
     out.update(
         tuples_10m=len(big.store),
         build_10m_s=round(build_s, 1),
@@ -717,11 +773,12 @@ def _scale_10m_mixed(out, state) -> None:
     bmixed = synth_queries_mixed(state["big"], 10_000, seed=9, general_frac=0.3)
     beng.batch_check(bmixed)
     beng.batch_check(bmixed)
-    t0 = time.perf_counter()
-    bgot = beng.batch_check(bmixed)
-    out["mixed_10k_checks_per_sec_10m"] = round(
-        len(bgot) / (time.perf_counter() - t0), 1
-    )
+    with _steady(out, "scale_10m_mixed"):
+        t0 = time.perf_counter()
+        bgot = beng.batch_check(bmixed)
+        out["mixed_10k_checks_per_sec_10m"] = round(
+            len(bgot) / (time.perf_counter() - t0), 1
+        )
 
 
 def _scale_10m_expand(out, state) -> None:
@@ -745,15 +802,18 @@ def _scale_10m_expand(out, state) -> None:
     # snapshot the engine's cumulative phase counters around the timed
     # pass so the throughput number decomposes into host vs device time
     ph0 = dict(getattr(beng, "phase_seconds", {}) or {})
-    t0 = time.perf_counter()
-    btrees = beng.batch_expand(xroots, 5)
-    dt = time.perf_counter() - t0
+    with _steady(out, "scale_10m_expand"):
+        t0 = time.perf_counter()
+        btrees = beng.batch_expand(xroots, 5)
+        dt = time.perf_counter() - t0
     ph1 = dict(getattr(beng, "phase_seconds", {}) or {})
 
     def _delta(*keys):
         return round(sum(ph1.get(k, 0.0) - ph0.get(k, 0.0) for k in keys), 3)
 
-    p50, p99 = _expand_latency(beng, xroots[:1], samples=20)
+    p50, p99 = _expand_latency(
+        beng, xroots[:1], samples=20, gate=(out, "scale_10m_expand")
+    )
     out.update(
         expand_trees_per_sec_10m=round(len(btrees) / dt, 1),
         expand_fallback_rate_10m=round(
@@ -770,9 +830,10 @@ def _scale_10m_expand(out, state) -> None:
 
 if __name__ == "__main__":
     try:
-        main()
+        rc = main()
     except BaseException as e:  # noqa: BLE001 — ALWAYS emit the JSON line
         if isinstance(e, (KeyboardInterrupt, SystemExit)):
             raise
         print(json.dumps({"error": f"{type(e).__name__}: {e}"}))
-    sys.exit(0)
+        rc = 0
+    sys.exit(rc)

@@ -153,7 +153,15 @@ def run_serving_bench(
             for r in requests[:4]:
                 stub.Check(r)
 
+        from ketotpu import compilewatch
+
+        compiles_before = compilewatch.get().compiles_total
         h = _hammer(target, requests, concurrency=concurrency, duration=duration)
+        # wave-occupancy picture next to the RPS number: how full the
+        # coalescing windows ran and how long admitted requests waited —
+        # the wave ledger (ketotpu/waveledger.py) records this per wave,
+        # stats() aggregates the ring
+        wstats = reg.wave_ledger().stats()
         return {
             "serve_rps": h["rps"],
             "serve_p50_ms": h["p50_ms"],
@@ -163,6 +171,13 @@ def run_serving_bench(
             "serve_errors": h["errors"],
             "serve_coalesced_waves": getattr(
                 reg.check_engine(), "waves", 0
+            ),
+            "serve_wave_size_mean": wstats.get("wave_size_mean", 0),
+            "serve_wave_size_p50": wstats.get("wave_size_p50", 0),
+            "serve_wave_size_p95": wstats.get("wave_size_p95", 0),
+            "serve_window_wait_ms_p50": wstats.get("window_wait_ms_p50", 0),
+            "serve_hammer_compiles": (
+                compilewatch.get().compiles_total - compiles_before
             ),
             "serve_stage_ms": _scrape_means(
                 reg.metrics(), "keto_rpc_stage_seconds", ("op", "stage")

@@ -624,6 +624,83 @@ def _dump_flight_recorder(metrics_remote: str) -> int:
     return 0
 
 
+def _fetch_debug(metrics_remote: str, path: str):
+    import urllib.request
+
+    url = f"http://{metrics_remote}{path}"
+    try:
+        with urllib.request.urlopen(url, timeout=5.0) as resp:
+            return json.loads(resp.read().decode("utf-8"))
+    except (OSError, ValueError) as e:
+        print(f"{path}: unreachable ({url}: {e})", file=sys.stderr)
+        return None
+
+
+def _dump_waves(metrics_remote: str) -> int:
+    """Pretty-print the wave ledger (server/rest.py /debug/waves): one
+    line per recent wave, joinable to flight-recorder entries on wave=
+    and to OTLP traces via the slowest members' traceparents."""
+    payload = _fetch_debug(metrics_remote, "/debug/waves?n=16")
+    if payload is None:
+        return 1
+    stats = payload.get("stats", {})
+    waves = payload.get("waves", [])
+    print(
+        f"wave ledger: {stats.get('waves_recorded', 0)} wave(s) recorded, "
+        f"size mean={stats.get('wave_size_mean', 0)} "
+        f"p95={stats.get('wave_size_p95', 0)}, "
+        f"window wait p50={stats.get('window_wait_ms_p50', 0)}ms, "
+        f"device p50={stats.get('device_ms_p50', 0)}ms"
+    )
+    for w in waves:
+        phases = " ".join(
+            f"{k}={v:.2f}ms"
+            for k, v in sorted((w.get("phase_ms") or {}).items())
+        )
+        slow = " ".join(
+            f"{s.get('traceparent')}@{s.get('wait_ms', 0)}ms"
+            for s in w.get("slowest", [])
+        )
+        print(
+            f"  wave={w.get('wave'):<6} size={w.get('size'):<5}"
+            f" wait_p50={w.get('window_wait_ms_p50', 0):.2f}ms"
+            f" device={w.get('device_ms', 0):.2f}ms"
+            f" collapsed={w.get('singleflight_collapsed', 0)}"
+            f" cache_hits={w.get('cache_hits_since_prev', 0)}"
+            f" leopard={w.get('leopard_answered', 0)}"
+            f" fallbacks={w.get('fallbacks', 0)}"
+            f" errors={w.get('errors', 0)}"
+            + (f" {phases}" if phases else "")
+            + (f" slowest: {slow}" if slow else "")
+        )
+    return 0
+
+
+def _dump_compiles(metrics_remote: str) -> int:
+    """Pretty-print the compile observatory (/debug/compiles): per-entry-
+    point compile totals plus the recent compile event log."""
+    payload = _fetch_debug(metrics_remote, "/debug/compiles")
+    if payload is None:
+        return 1
+    per_fn = " ".join(
+        f"{k}={v}" for k, v in sorted(payload.get("per_fn", {}).items())
+    )
+    print(
+        f"xla compiles: {payload.get('compiles_total', 0)} total "
+        f"({payload.get('compile_seconds_total', 0.0):.2f}s), "
+        f"warm={payload.get('warm', False)}, "
+        f"after_warm={payload.get('compiles_after_warm', 0)}"
+        + (f" [{per_fn}]" if per_fn else "")
+    )
+    for ev in payload.get("log", [])[-16:]:
+        flag = " AFTER-WARM" if ev.get("after_warm") else ""
+        print(
+            f"  {ev.get('fn', '?'):16s} {ev.get('duration_ms', 0.0):9.1f}ms"
+            f" {ev.get('signature', '')}{flag}"
+        )
+    return 0
+
+
 def cmd_status(args) -> int:
     import grpc
 
@@ -631,7 +708,12 @@ def cmd_status(args) -> int:
     from ketotpu.proto.services import _stub_class
 
     if getattr(args, "debug", False):
-        return _dump_flight_recorder(args.metrics_remote)
+        rcs = [
+            _dump_flight_recorder(args.metrics_remote),
+            _dump_waves(args.metrics_remote),
+            _dump_compiles(args.metrics_remote),
+        ]
+        return max(rcs)
 
     deadline = time.monotonic() + args.timeout
     while True:

@@ -1,0 +1,211 @@
+"""XLA compile observatory: every backend compile counted, labelled, logged.
+
+The BENCH_r05 10M-expand cliff (a 350x throughput collapse) was a stray
+XLA recompile of a static-shape schedule landing inside a timed pass —
+and nothing in the system noticed.  This module turns that incident
+class into an alarm: a process-global listener on ``jax.monitoring``'s
+``/jax/core/compile/backend_compile_duration`` event counts every
+backend compile, attributes it to the engine entry point that triggered
+it (host wrappers open a :func:`scope` around their dispatch), emits
+``keto_xla_compiles_total{fn}`` / ``keto_xla_compile_seconds``, keeps a
+bounded log of compile events (fn, arg-shape signature, duration, wall
+time) for ``/debug/compiles``, and logs a LOUD warning when a compile
+fires after the engine has declared itself warm.
+
+Design constraints the shape of this module falls out of:
+
+* ``jax.monitoring`` listeners are global and cannot be scoped per
+  engine, so the watch is a process singleton (:func:`get`) and engine
+  attribution rides a thread-local label stack — the compile event
+  fires synchronously on the thread that called the jitted function,
+  inside the scope the host wrapper opened.
+* Scopes are entered on every dispatch (hot path), so they must cost a
+  thread-local append/pop and nothing else: the signature is a lazy
+  callable evaluated only when a compile actually fires.
+* Unit tests construct engines without a registry; the watch only
+  emits metrics/warnings after :meth:`CompileWatch.bind` wires it to a
+  live registry (last bind wins — one process, one serving registry).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Callable, Dict, List, Optional, Union
+
+# the monitoring event that IS "an XLA compile" (jaxpr trace / MLIR
+# lowering events also exist but fire for cache hits on some paths;
+# backend_compile only fires when XLA actually builds an executable)
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+COMPILES_METRIC = "keto_xla_compiles_total"
+COMPILE_SECONDS_METRIC = "keto_xla_compile_seconds"
+
+_tls = threading.local()
+
+
+def _stack() -> List:
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+class CompileWatch:
+    """Process-wide compile counter + bounded compile log + warm alarm."""
+
+    def __init__(self, log_size: int = 128):
+        self._lock = threading.Lock()
+        self.compiles_total = 0
+        self.compile_seconds_total = 0.0
+        self.per_fn: Dict[str, int] = {}
+        self.compiles_after_warm = 0
+        self._warm = False
+        self._log: deque = deque(maxlen=int(log_size))
+        # bound lazily by the serving registry; None in unit tests/bench
+        self._metrics = None
+        self._logger = None
+        self._warn_after_warm = True
+
+    # -- registry seam -------------------------------------------------------
+
+    def bind(self, metrics=None, logger=None, *, warn_after_warm: bool = True,
+             log_size: Optional[int] = None) -> None:
+        """Wire the watch to a registry's metrics/logger (last bind wins)."""
+        with self._lock:
+            self._metrics = metrics
+            self._logger = logger
+            self._warn_after_warm = bool(warn_after_warm)
+            if log_size is not None and int(log_size) != self._log.maxlen:
+                self._log = deque(self._log, maxlen=int(log_size))
+
+    # -- warm/cold protocol --------------------------------------------------
+
+    @property
+    def warm(self) -> bool:
+        return self._warm
+
+    def declare_warm(self) -> None:
+        """The engine believes every steady-state shape is compiled."""
+        self._warm = True
+
+    def declare_cold(self, reason: str = "") -> None:
+        """New compiles are legitimate again (snapshot rebuild, resize)."""
+        if self._warm and self._logger is not None:
+            self._logger.info(
+                "compilewatch: engine cold again (%s)", reason or "unspecified"
+            )
+        self._warm = False
+
+    # -- attribution scope (hot path) ----------------------------------------
+
+    @contextmanager
+    def scope(self, fn: str,
+              signature: Optional[Union[str, Callable[[], str]]] = None):
+        """Attribute compiles fired inside the block to entry point ``fn``.
+
+        ``signature`` describes the arg shapes; pass a zero-arg callable
+        to defer formatting until a compile actually fires.
+        """
+        st = _stack()
+        st.append((fn, signature))
+        try:
+            yield
+        finally:
+            st.pop()
+
+    # -- listener ------------------------------------------------------------
+
+    def _on_event(self, event: str, duration: float, **kwargs) -> None:
+        if event != _COMPILE_EVENT:
+            return
+        st = _stack()
+        fn, signature = st[-1] if st else ("other", None)
+        if callable(signature):
+            try:
+                signature = signature()
+            except Exception:  # noqa: BLE001 - diagnostics never raise
+                signature = "?"
+        entry = {
+            "fn": fn,
+            "signature": signature or "",
+            "duration_ms": round(float(duration) * 1000.0, 3),
+            "ts": round(time.time(), 3),
+            "after_warm": self._warm,
+        }
+        with self._lock:
+            self.compiles_total += 1
+            self.compile_seconds_total += float(duration)
+            self.per_fn[fn] = self.per_fn.get(fn, 0) + 1
+            if self._warm:
+                self.compiles_after_warm += 1
+            self._log.append(entry)
+            metrics, logger = self._metrics, self._logger
+            warn = self._warm and self._warn_after_warm
+        if metrics is not None:
+            metrics.counter(
+                COMPILES_METRIC, 1,
+                help="XLA backend compiles by engine entry point", fn=fn,
+            )
+            metrics.observe(
+                COMPILE_SECONDS_METRIC, float(duration),
+                help="XLA backend compile wall seconds", fn=fn,
+            )
+            if warn:
+                metrics.counter(
+                    "keto_xla_compiles_after_warm_total", 1,
+                    help="compiles after the engine declared itself warm",
+                    fn=fn,
+                )
+        if warn and logger is not None:
+            logger.warning(
+                "XLA COMPILE AFTER WARM: fn=%s sig=%s duration_ms=%.1f — a "
+                "steady-state dispatch hit an uncompiled shape (the "
+                "BENCH_r05 cliff class); audit the static jit args feeding "
+                "this entry point",
+                fn, entry["signature"], entry["duration_ms"],
+            )
+
+    # -- introspection -------------------------------------------------------
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            return {
+                "compiles_total": self.compiles_total,
+                "compile_seconds_total": round(self.compile_seconds_total, 6),
+                "per_fn": dict(self.per_fn),
+                "warm": self._warm,
+                "compiles_after_warm": self.compiles_after_warm,
+                "log": [dict(e) for e in self._log],
+            }
+
+
+_watch: Optional[CompileWatch] = None
+_watch_lock = threading.Lock()
+
+
+def get() -> CompileWatch:
+    """The process singleton, listener registered on first use."""
+    global _watch
+    if _watch is None:
+        with _watch_lock:
+            if _watch is None:
+                w = CompileWatch()
+                try:  # pragma: no cover - exercised wherever jax is present
+                    from jax import monitoring as _mon
+
+                    _mon.register_event_duration_secs_listener(w._on_event)
+                except Exception:  # noqa: BLE001 - jax absent: counters stay 0
+                    pass
+                _watch = w
+    return _watch
+
+
+@contextmanager
+def scope(fn: str,
+          signature: Optional[Union[str, Callable[[], str]]] = None):
+    """Module-level convenience: ``with compilewatch.scope("expand", sig):``"""
+    with get().scope(fn, signature):
+        yield

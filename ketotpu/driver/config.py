@@ -181,6 +181,22 @@ def _defaults() -> Dict[str, Any]:
             "server_url": "",
             "interval_ms": 21_600_000,
         },
+        # introspection surfaces (flight recorder, wave ledger, compile
+        # observatory, on-demand profiler).  The profiler block arms
+        # POST /debug/profile — disabled by default so an unarmed
+        # production box answers 403 instead of writing trace files.
+        "observability": {
+            "wave_ledger_size": 256,
+            "flight_recorder_size": 32,
+            "flight_recorder_max_age_s": 600,
+            "compile_log_size": 128,
+            "warm_compile_warning": True,
+            "profiler": {
+                "enabled": False,
+                "dir": "",
+                "max_seconds": 60,
+            },
+        },
         # fault injection (ketotpu/faults.py): all-zero = inactive.  The
         # KETO_FAULT_* environment knobs override this block entirely —
         # that is how the chaos CI job drives subprocesses.
@@ -261,7 +277,10 @@ class Provider:
                           "barrier_timeout_ms", "barrier_poll_ms",
                           "queue_cap", "max_subscribers", "heartbeat_ms",
                           "max_entries", "max_staleness_ms",
-                          "hot_threshold", "top_k"):
+                          "hot_threshold", "top_k", "wave_ledger_size",
+                          "flight_recorder_size",
+                          "flight_recorder_max_age_s", "compile_log_size",
+                          "warm_compile_warning", "max_seconds"):
                 suffix = known.split("_")
                 if len(joined) > len(suffix) and joined[-len(suffix):] == suffix:
                     joined = joined[: -len(suffix)] + [known]
@@ -489,3 +508,27 @@ class Provider:
                 raise ConfigError(
                     key, f"must be a non-negative integer, got {val!r}"
                 )
+        for key in ("observability.wave_ledger_size",
+                    "observability.flight_recorder_size",
+                    "observability.compile_log_size"):
+            val = self.get(key)
+            if not isinstance(val, int) or val < 1:
+                raise ConfigError(
+                    key, f"must be a positive integer, got {val!r}"
+                )
+        for key in ("observability.flight_recorder_max_age_s",
+                    "observability.profiler.max_seconds"):
+            val = self.get(key)
+            if not isinstance(val, (int, float)) or val <= 0:
+                raise ConfigError(
+                    key, f"must be a positive number, got {val!r}"
+                )
+        for key in ("observability.warm_compile_warning",
+                    "observability.profiler.enabled"):
+            val = self.get(key)
+            if not isinstance(val, bool):
+                raise ConfigError(key, f"must be a boolean, got {val!r}")
+        if not isinstance(self.get("observability.profiler.dir", ""), str):
+            raise ConfigError(
+                "observability.profiler.dir", "must be a string path"
+            )

@@ -82,6 +82,9 @@ class Registry:
         self._watch_hub = None
         self._result_cache = None
         self._flight_recorder = None
+        self._wave_ledger = None
+        self._profiler = None
+        self._compile_watch = None
         self._admission = None
         self._mapper = None
         self._ro_mapper = None
@@ -154,12 +157,91 @@ class Registry:
             if self._flight_recorder is None:
                 from ketotpu.flightrec import FlightRecorder
 
+                # observability.* is the schema'd home; the legacy
+                # log.flight_recorder_size key still wins when set so
+                # existing deployments keep their sizing
+                cap = self.config.get("log.flight_recorder_size")
+                if cap is None:
+                    cap = self.config.get(
+                        "observability.flight_recorder_size", 32
+                    )
                 self._flight_recorder = FlightRecorder(
-                    capacity=int(
-                        self.config.get("log.flight_recorder_size", 32) or 32
+                    capacity=int(cap or 32),
+                    max_age_s=float(
+                        self.config.get(
+                            "observability.flight_recorder_max_age_s", 600
+                        ) or 600
                     ),
                 )
             return self._flight_recorder
+
+    def wave_ledger(self):
+        """Lazy ring of the last N dispatched waves (ketotpu/waveledger.py):
+        the coalescer files one entry per wave; served by /debug/waves and
+        ``keto-tpu status --debug``."""
+        with self._lock:
+            if self._wave_ledger is None:
+                from ketotpu.waveledger import WaveLedger
+
+                self._wave_ledger = WaveLedger(
+                    capacity=int(
+                        self.config.get("observability.wave_ledger_size", 256)
+                        or 256
+                    ),
+                )
+            return self._wave_ledger
+
+    def compile_watch(self):
+        """The process-global XLA compile observatory
+        (ketotpu/compilewatch.py), bound to THIS registry's metrics/logger
+        so compile events land in keto_xla_compiles_total{fn} and
+        after-warm compiles warn loudly (last bind wins — one serving
+        registry per process)."""
+        with self._lock:
+            if self._compile_watch is None:
+                from ketotpu import compilewatch
+
+                w = compilewatch.get()
+                w.bind(
+                    self.metrics(), self.logger(),
+                    warn_after_warm=bool(
+                        self.config.get(
+                            "observability.warm_compile_warning", True
+                        )
+                    ),
+                    log_size=int(
+                        self.config.get("observability.compile_log_size", 128)
+                        or 128
+                    ),
+                )
+                self._compile_watch = w
+            return self._compile_watch
+
+    def profiler(self):
+        """Lazy on-demand device profiler (ketotpu/profiler.py) behind
+        POST /debug/profile; disabled unless observability.profiler.enabled
+        arms it."""
+        with self._lock:
+            if self._profiler is None:
+                from ketotpu.profiler import DeviceProfiler
+
+                self._profiler = DeviceProfiler(
+                    enabled=bool(
+                        self.config.get(
+                            "observability.profiler.enabled", False
+                        )
+                    ),
+                    out_dir=str(
+                        self.config.get("observability.profiler.dir", "")
+                        or ""
+                    ),
+                    max_seconds=float(
+                        self.config.get(
+                            "observability.profiler.max_seconds", 60
+                        ) or 60
+                    ),
+                )
+            return self._profiler
 
     # -- multi-tenancy (ketoctx Contextualizer seam) ------------------------
 
@@ -466,6 +548,7 @@ class Registry:
                             default_timeout=self._request_timeout(),
                             cache=self.result_cache(),
                             metrics=self.metrics(),
+                            ledger=self.wave_ledger(),
                         )
                         if ms > 0 else dev
                     )
@@ -618,6 +701,9 @@ class Registry:
         refreshing it after the warm build otherwise."""
         self.namespace_manager()
         self.store()
+        # bind the compile observatory before the first jit fires so the
+        # warm-boot compiles are already attributed and counted
+        self.compile_watch()
         eng = self._device_engine()
         if eng is not None:
             ckpt_path = str(self.config.get("engine.checkpoint") or "")
@@ -646,6 +732,19 @@ class Registry:
                     help="result-cache entries resident")
             m.gauge("keto_cache_hit_ratio", cs["hit_ratio"],
                     help="lifetime cache hit ratio (hits / probes)")
+        with self._lock:
+            ledger = self._wave_ledger
+        if ledger is not None:
+            ws = ledger.stats()
+            m = self.metrics()
+            m.gauge("keto_wave_size_mean", ws["wave_size_mean"],
+                    help="mean coalesced wave size over the ledger ring")
+            m.gauge("keto_wave_size_p95", ws["wave_size_p95"],
+                    help="p95 coalesced wave size over the ledger ring")
+            m.gauge("keto_wave_window_wait_ms_p50", ws["window_wait_ms_p50"],
+                    help="p50 per-wave median window wait (ms)")
+            m.gauge("keto_wave_device_ms_p50", ws["device_ms_p50"],
+                    help="p50 per-wave device dispatch time (ms)")
         eng = getattr(outer, "inner", outer)
         if not isinstance(eng, DeviceCheckEngine):
             return

@@ -76,6 +76,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ketotpu import compilewatch
 from ketotpu.engine import fastpath as fp
 from ketotpu.engine import hashtab
 from ketotpu.engine.optable import (
@@ -653,7 +654,12 @@ def run_general_packed_timed(g, qpack, *, timer=None, **kw):
     after).  run_general_packed itself is jitted with static argnames and
     cannot carry host-side instrumentation."""
     t0 = time.perf_counter()
-    out = run_general_packed(g, qpack, **kw)
+    with compilewatch.scope(
+        "general_packed",
+        lambda: f"Q={qpack.shape[1]} sizes={kw.get('sizes')} "
+                f"fast_b={kw.get('fast_b')}",
+    ):
+        out = run_general_packed(g, qpack, **kw)
     if timer is not None:
         timer(time.perf_counter() - t0)
     return out

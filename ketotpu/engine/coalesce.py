@@ -37,7 +37,7 @@ from ketotpu.cache import context as cache_context
 
 class _Slot:
     __slots__ = ("tuple", "depth", "bypass", "event", "result", "error",
-                 "t_enq", "t_dispatch", "wave")
+                 "t_enq", "t_dispatch", "wave", "traceparent", "followers")
 
     def __init__(self, t: RelationTuple, depth: int, bypass: bool = False):
         self.tuple = t
@@ -49,6 +49,10 @@ class _Slot:
         self.t_enq = time.perf_counter()
         self.t_dispatch: Optional[float] = None  # set by the wave worker
         self.wave: Optional[int] = None
+        # wave-ledger cross-link: the enqueuing RPC's trace id, and how
+        # many identical pending checks singleflight-parked on this slot
+        self.traceparent: Optional[str] = None
+        self.followers = 0
 
 
 class CoalescingEngine:
@@ -57,10 +61,14 @@ class CoalescingEngine:
     def __init__(self, inner, *, window: float = 0.002,
                  max_pending: int = 4096,
                  default_timeout: float = 30.0,
-                 cache=None, metrics=None):
+                 cache=None, metrics=None, ledger=None):
         self.inner = inner
         self.window = window
         self.max_pending = max_pending
+        # wave ledger (ketotpu/waveledger.py): one record per dispatched
+        # wave, filed on the worker thread; None = no ledger (direct use)
+        self.ledger = ledger
+        self._last_cache_hits = 0
         # hot-spot shield: probe before admission (a hit skips the wave
         # window entirely), and collapse identical pending checks onto one
         # slot — the Zanzibar lock-table dedup at the batching seam
@@ -132,6 +140,7 @@ class CoalescingEngine:
                 # slot; the wave worker's verdict fans out to everyone
                 collapsed = True
                 self.singleflight_collapsed += 1
+                slot.followers += 1
             else:
                 if len(self._pending) >= self.max_pending:
                     # backlog saturated: shed NOW rather than queue behind
@@ -142,6 +151,7 @@ class CoalescingEngine:
                         f"check backlog full ({self.max_pending} pending)"
                     )
                 slot = _Slot(r, rest_depth, bypass=bypass)
+                slot.traceparent = flightrec.current_traceparent()
                 self._pending.append(slot)
                 if not bypass:
                     # bypass slots never publish into the flight table: a
@@ -220,8 +230,20 @@ class CoalescingEngine:
 
     def _serve(self, wave: List[_Slot]) -> None:
         self.waves += 1
-        wave_id = self.waves
+        # the ledger is the wave-id authority when present so flight
+        # recorder entries (wave=) and /debug/waves join on the same id
+        wave_id = (
+            self.ledger.next_wave_id() if self.ledger is not None
+            else self.waves
+        )
         self.coalesced += len(wave)
+        # engine counter/phase deltas around the dispatches: only this
+        # worker thread dispatches waves, so the deltas attribute cleanly
+        inner = self.inner
+        leo_before = int(getattr(inner, "leopard_answered", 0) or 0)
+        fb_before = int(getattr(inner, "fallbacks", 0) or 0)
+        phase_before = dict(getattr(inner, "phase_seconds", None) or {})
+        device_s = 0.0
         groups = {}
         for s in wave:
             groups.setdefault((s.depth, s.bypass), []).append(s)
@@ -273,5 +295,73 @@ class CoalescingEngine:
                 for s in slots:
                     s.error = e
             finally:
+                device_s += time.perf_counter() - t_dispatch
                 for s in slots:
                     s.event.set()
+        if self.ledger is not None:
+            try:
+                self._file_wave(
+                    wave_id, wave, len(groups), device_s,
+                    leo_before, fb_before, phase_before,
+                )
+            except Exception:  # noqa: BLE001 - diagnostics must never
+                pass  # take down the wave worker
+
+    def _file_wave(self, wave_id: int, wave: List[_Slot], n_groups: int,
+                   device_s: float, leo_before: int, fb_before: int,
+                   phase_before: dict) -> None:
+        """One ledger record per wave: occupancy, waits, device time,
+        short-circuit counts, engine phase deltas, slowest traceparents."""
+        inner = self.inner
+        waits = sorted(
+            (s.t_dispatch - s.t_enq) for s in wave
+            if s.t_dispatch is not None
+        )
+        phase_after = dict(getattr(inner, "phase_seconds", None) or {})
+        phase_ms = {
+            k: round((phase_after[k] - phase_before.get(k, 0.0)) * 1000.0, 3)
+            for k in phase_after
+            if phase_after[k] - phase_before.get(k, 0.0) > 0
+        }
+        # cache hits answer BEFORE admission (they never occupy a slot);
+        # the delta since the previous wave is the short-circuit traffic
+        # this wave's window interval absorbed
+        hits_now = self.cache_hits
+        hits_delta = hits_now - self._last_cache_hits
+        self._last_cache_hits = hits_now
+        slow = sorted(
+            (s for s in wave
+             if s.t_dispatch is not None and s.traceparent is not None),
+            key=lambda s: s.t_dispatch - s.t_enq, reverse=True,
+        )[:3]
+        self.ledger.record({
+            "wave": wave_id,
+            "size": len(wave),
+            "groups": n_groups,
+            "window_wait_ms_p50": round(
+                waits[len(waits) // 2] * 1000.0, 3
+            ) if waits else 0.0,
+            "window_wait_ms_max": round(
+                waits[-1] * 1000.0, 3
+            ) if waits else 0.0,
+            "device_ms": round(device_s * 1000.0, 3),
+            "singleflight_collapsed": sum(s.followers for s in wave),
+            "cache_hits_since_prev": max(0, hits_delta),
+            "leopard_answered": max(
+                0, int(getattr(inner, "leopard_answered", 0) or 0)
+                - leo_before
+            ),
+            "fallbacks": max(
+                0, int(getattr(inner, "fallbacks", 0) or 0) - fb_before
+            ),
+            "errors": sum(1 for s in wave if s.error is not None),
+            "phase_ms": phase_ms,
+            "slowest": [
+                {
+                    "traceparent": s.traceparent,
+                    "wait_ms": round((s.t_dispatch - s.t_enq) * 1000.0, 3),
+                }
+                for s in slow
+            ],
+            "ts": round(time.time(), 3),
+        })

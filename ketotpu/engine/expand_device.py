@@ -39,6 +39,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ketotpu import compilewatch
 from ketotpu.api.types import (
     RelationTuple,
     Subject,
@@ -373,9 +374,10 @@ def run_expand(
     r_subj = np.fromiter((vocab.subject_key(s) for s in roots), np.int32, R)
     r_depth = np.full(R, rest_depth, np.int32)
     sched = expand_schedule(R, fanout, rest_depth, cap)
-    levels, over = _run_expand(
-        g, r_ns, r_obj, r_rel, r_subj, r_depth, schedule=sched
-    )
+    with compilewatch.scope("expand", lambda: f"R={R} sched={sched}"):
+        levels, over = _run_expand(
+            g, r_ns, r_obj, r_rel, r_subj, r_depth, schedule=sched
+        )
     t1 = time.perf_counter()
     levels = [{k: np.asarray(v) for k, v in lvl.items()} for lvl in levels]
     over = np.asarray(over)

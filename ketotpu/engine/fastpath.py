@@ -93,6 +93,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ketotpu import compilewatch
 from ketotpu.engine import hashtab
 from ketotpu.engine.delta import OV_ADDED, OV_DELETED
 from ketotpu.engine.xutil import arena_assign
@@ -755,7 +756,10 @@ def run_fast_packed(
         raise ValueError(f"batch {Q} exceeds frontier capacity {frontier}")
     sched = level_schedule(Q, frontier, arena, max_depth, boost, mults)
     t0 = time.perf_counter()
-    out = _run_fused_packed(g, qpack, schedule=sched, max_width=max_width)
+    with compilewatch.scope(
+        "fast_packed", lambda: f"Q={Q} sched={sched} width={max_width}"
+    ):
+        out = _run_fused_packed(g, qpack, schedule=sched, max_width=max_width)
     if timer is not None:
         timer(time.perf_counter() - t0)
     return out
@@ -787,8 +791,11 @@ def run_fast(
         raise ValueError(f"batch {Q} exceeds frontier capacity {frontier}")
     act = np.ones((Q,), bool) if active is None else np.asarray(active, bool)
     sched = level_schedule(Q, frontier, arena, max_depth, boost)
-    res, _occ = _run_fused(
-        g, q_ns, q_obj, q_rel, q_subj, q_depth, act,
-        schedule=sched, max_width=max_width,
-    )
+    with compilewatch.scope(
+        "fast", lambda: f"Q={Q} sched={sched} width={max_width}"
+    ):
+        res, _occ = _run_fused(
+            g, q_ns, q_obj, q_rel, q_subj, q_depth, act,
+            schedule=sched, max_width=max_width,
+        )
     return res

@@ -61,7 +61,7 @@ if _plat:
     except Exception:  # noqa: BLE001 — never block engine import on this
         pass
 
-from ketotpu import deadline, faults, flightrec
+from ketotpu import compilewatch, deadline, faults, flightrec
 from ketotpu.api.types import KetoAPIError, RelationTuple
 from ketotpu.cache import check_key as cache_check_key
 from ketotpu.engine import algebra as alg
@@ -267,6 +267,12 @@ class DeviceCheckEngine:
         self.leopard_answered = 0  # checks answered from the index
         self.leopard_hits = 0  # of those, answered allowed
         self.leopard_list_fallbacks = 0  # listings served by the host oracle
+        # warm heuristic for the compile observatory: after this many
+        # consecutive check dispatches that triggered zero XLA compiles,
+        # the engine declares itself warm — any later compile is the
+        # BENCH_r05 cliff class and warns loudly (ketotpu/compilewatch.py)
+        self._clean_dispatches = 0
+        self.warm_after_clean = 2
 
     def _phase(self, name: str, dt: float) -> None:
         self.phase_seconds[name] = self.phase_seconds.get(name, 0.0) + dt
@@ -374,6 +380,10 @@ class DeviceCheckEngine:
         self.projection_upload_s = time.perf_counter() - t0
         self.rebuilds += 1
         self._gen_sched_cache.clear()  # new graph, re-adapt once
+        # new shapes may legitimately compile after a rebuild — the warm
+        # alarm re-arms once dispatches run clean again
+        self._clean_dispatches = 0
+        compilewatch.get().declare_cold("snapshot rebuild")
         self._install_leopard()
         if self.checkpoint_path:
             from ketotpu.engine import checkpoint as ckpt
@@ -689,6 +699,8 @@ class DeviceCheckEngine:
             queries[lo : lo + self.max_batch]
             for lo in range(0, len(queries), self.max_batch)
         ]
+        watch = compilewatch.get()
+        compiles_before = watch.compiles_total
         try:
             # dispatch everything before syncing on anything: device
             # executions queue back-to-back while the host reads earlier
@@ -706,6 +718,15 @@ class DeviceCheckEngine:
             # Health reports ``degraded`` until dispatches stay clean.
             self._device_failure()
             out = self._serve_batch_on_oracle(queries, rest_depth)
+        # warm heuristic: consecutive compile-free dispatches mean the
+        # steady-state shape set is fully compiled; declare warm so any
+        # later compile fires the observatory's after-warm alarm
+        if watch.compiles_total == compiles_before:
+            self._clean_dispatches += 1
+            if self._clean_dispatches >= self.warm_after_clean and not watch.warm:
+                watch.declare_warm()
+        else:
+            self._clean_dispatches = 0
         # RPCs that reach the engine without the coalescer (batch routes)
         # still get a device_compute stage; no-op outside a request context
         flightrec.note_stage("device_compute", time.perf_counter() - t0)

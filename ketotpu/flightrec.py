@@ -113,11 +113,19 @@ def note(**info) -> None:
 
 
 def current_traceparent() -> Optional[str]:
-    """traceparent of the current RPC's span, for wire propagation."""
+    """traceparent of the current RPC's span, for wire propagation.
+
+    An exporting tracer answers with the innermost open span's id; the
+    base tracer keeps no ids, so fall back to the traceparent captured at
+    RPC entry — the worker wire and the wave ledger then still carry the
+    caller's trace id instead of nothing."""
     ctx = getattr(_local, "ctx", None)
-    if ctx is None or ctx.tracer is None:
+    if ctx is None:
         return None
-    return ctx.tracer.current_traceparent()
+    tp = (
+        ctx.tracer.current_traceparent() if ctx.tracer is not None else None
+    )
+    return tp or ctx.info.get("traceparent")
 
 
 @contextmanager
@@ -143,6 +151,14 @@ def rpc_recording(registry, op: str, *, traceparent: Optional[str] = None,
     _local.ctx = ctx
     try:
         with tracer.span(f"rpc.{op}", _parent=traceparent, detail=detail):
+            # capture the trace id while the span is OPEN (the recorder
+            # files the entry after it closes, when an exporting tracer
+            # no longer answers): the span's own id when the tracer mints
+            # one, else the caller's incoming header — either joins the
+            # flight-recorder entry to its OTLP trace and wave record
+            tp = tracer.current_traceparent() or traceparent
+            if tp:
+                ctx.info.setdefault("traceparent", tp)
             yield ctx
     finally:
         _local.ctx = None

@@ -21,6 +21,8 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from ketotpu import compilewatch
+
 try:  # pragma: no cover - exercised wherever jax is present
     import jax
     import jax.numpy as jnp
@@ -69,7 +71,11 @@ def probe_pairs(
     try:
         padded = np.full(pad_to, -1, np.int64)
         padded[: len(keys)] = keys
-        hit, hop = _probe(dev["pairs"], dev["hops"], padded)
+        with compilewatch.scope(
+            "leopard_probe",
+            lambda: f"pairs={dev['pairs'].shape[0]} pad={pad_to}",
+        ):
+            hit, hop = _probe(dev["pairs"], dev["hops"], padded)
         hit = np.asarray(hit)[: len(keys)]
         hop = np.asarray(hop)[: len(keys)]
         return hit, hop

@@ -41,6 +41,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ketotpu import compilewatch
 from ketotpu.engine import fastpath as fp
 from ketotpu.engine import hashtab
 from ketotpu.engine.snapshot import Snapshot
@@ -217,12 +218,17 @@ def sharded_general_check(
     skeleton lives on every shard).  Returns (codes uint8[Q], occ
     int32[n, L]) with codes replicated-identical across shards.
     """
-    return _sharded_general_run(
-        stacked_g, jnp.asarray(qpack, jnp.int32),
-        mesh=mesh, axis=axis,
-        sizes=tuple(sizes), fast_b=int(fast_b),
-        fast_sched=tuple(fast_sched), max_width=max_width, vcap=vcap,
-    )
+    with compilewatch.scope(
+        "sharded_general",
+        lambda: f"Q={qpack.shape[1]} n={mesh.devices.size} "
+                f"sizes={tuple(sizes)}",
+    ):
+        return _sharded_general_run(
+            stacked_g, jnp.asarray(qpack, jnp.int32),
+            mesh=mesh, axis=axis,
+            sizes=tuple(sizes), fast_b=int(fast_b),
+            fast_sched=tuple(fast_sched), max_width=max_width, vcap=vcap,
+        )
 
 
 @functools.partial(
@@ -336,8 +342,13 @@ def sharded_check(
             check_vma=False,
         )(g, q_ns, q_obj, q_rel, q_subj, q_depth, act)
 
-    found, over, dirty = run(
-        stacked_g, q_ns, q_obj, q_rel, q_subj, q_depth, act,
-        frontier=frontier, arena=arena, max_width=max_width, max_depth=max_depth,
-    )
+    with compilewatch.scope(
+        "sharded_check",
+        lambda: f"Q={Q} n={n} frontier={frontier} arena={arena}",
+    ):
+        found, over, dirty = run(
+            stacked_g, q_ns, q_obj, q_rel, q_subj, q_depth, act,
+            frontier=frontier, arena=arena, max_width=max_width,
+            max_depth=max_depth,
+        )
     return fp.FastResult(found=found, over=over, dirty=dirty)

@@ -1,0 +1,84 @@
+"""On-demand device profiling: ``POST /debug/profile?seconds=N``.
+
+Once the flight recorder has named the slow request, the wave ledger has
+named its wave, and the compile log has ruled recompiles out, the last
+step of the runbook is a real device trace.  This module wraps
+``jax.profiler`` trace capture behind a config gate so an operator can
+pull an N-second trace from a LIVE serving process without restarting it
+with profiling flags.
+
+Safety properties the REST handler relies on:
+
+* **Config-gated** — disabled by default (``observability.profiler
+  .enabled``); a probe against a production box that nobody armed
+  returns 403, it does not start writing trace files.
+* **One capture at a time** — ``jax.profiler`` keeps global state; a
+  second concurrent start would corrupt the first capture.  The lock is
+  non-blocking: a busy profiler answers 409 immediately.
+* **Bounded** — ``seconds`` is clamped to ``max_seconds``; a typo'd
+  ``seconds=3600`` cannot pin the capture thread for an hour.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+import time
+from typing import Optional
+
+
+class ProfilerDisabled(RuntimeError):
+    """Profiling is not armed in config (`observability.profiler.enabled`)."""
+
+
+class ProfilerBusy(RuntimeError):
+    """A capture is already in progress (jax.profiler state is global)."""
+
+
+class DeviceProfiler:
+    """Config-gated, single-flight jax.profiler trace capture."""
+
+    def __init__(self, enabled: bool = False, out_dir: str = "",
+                 max_seconds: float = 60.0):
+        self.enabled = bool(enabled)
+        self.out_dir = out_dir or ""
+        self.max_seconds = float(max_seconds)
+        self._lock = threading.Lock()
+        self.captures = 0
+        self.last_artifact: Optional[str] = None
+
+    def capture(self, seconds: float) -> dict:
+        """Block for ``seconds`` (clamped) of trace capture; returns the
+        artifact metadata ``{path, seconds, started_ts}``."""
+        if not self.enabled:
+            raise ProfilerDisabled(
+                "device profiling is disabled; set "
+                "observability.profiler.enabled=true to arm it"
+            )
+        seconds = max(0.1, min(float(seconds), self.max_seconds))
+        if not self._lock.acquire(blocking=False):
+            raise ProfilerBusy("a profile capture is already in progress")
+        try:
+            import jax
+
+            base = self.out_dir or os.path.join(
+                tempfile.gettempdir(), "keto-tpu-profiles"
+            )
+            os.makedirs(base, exist_ok=True)
+            started = time.time()
+            path = os.path.join(base, f"profile-{int(started)}")
+            jax.profiler.start_trace(path)
+            try:
+                time.sleep(seconds)
+            finally:
+                jax.profiler.stop_trace()
+            self.captures += 1
+            self.last_artifact = path
+            return {
+                "path": path,
+                "seconds": seconds,
+                "started_ts": round(started, 3),
+            }
+        finally:
+            self._lock.release()

@@ -62,6 +62,11 @@ _STATUS_TEXT = {
 _ADMISSION_EXEMPT = {
     "/health/alive", "/health/ready", "/version", "/metrics/prometheus",
     "/relation-tuples/watch",
+    # the introspection probes exist to diagnose overload — shedding them
+    # while shedding traffic would blind the operator exactly when the
+    # surfaces matter most
+    "/debug/flight-recorder", "/debug/waves", "/debug/compiles",
+    "/debug/profile",
 }
 
 # REST paths that get the full stage decomposition (flightrec context);
@@ -606,6 +611,53 @@ def metrics_router(registry) -> Router:
         }
 
     rt.add("GET", "/debug/flight-recorder", get_flight_recorder)
+
+    def get_waves(req):
+        # wave ledger (ketotpu/waveledger.py): the last N dispatched
+        # waves.  ?wave=<id> joins from a flight-recorder entry's wave=
+        # field back to its wave; ?n= bounds the listing.  Each entry's
+        # slowest[] traceparents join the other direction.
+        ledger = registry.wave_ledger()
+        wave = req.query.get("wave")
+        n = req.query.get("n")
+        try:
+            wave = int(wave) if wave is not None else None
+            n = int(n) if n is not None else None
+        except ValueError:
+            raise BadRequestError("wave and n must be integers")
+        return 200, {
+            "stats": ledger.stats(),
+            "waves": ledger.snapshot(n=n, wave=wave),
+        }
+
+    rt.add("GET", "/debug/waves", get_waves)
+
+    def get_compiles(req):
+        # XLA compile observatory (ketotpu/compilewatch.py): totals per
+        # entry point + the bounded compile event log; `warm` tells
+        # whether the next compile would fire the after-warm alarm
+        return 200, registry.compile_watch().snapshot()
+
+    rt.add("GET", "/debug/compiles", get_compiles)
+
+    def post_profile(req):
+        # on-demand jax.profiler capture: config-gated (403 unarmed),
+        # single-flight (409 while a capture runs), seconds clamped
+        from ketotpu.profiler import ProfilerBusy, ProfilerDisabled
+
+        try:
+            seconds = float(req.query.get("seconds", "5"))
+        except ValueError:
+            raise BadRequestError("seconds must be a number")
+        try:
+            artifact = registry.profiler().capture(seconds)
+        except ProfilerDisabled as e:
+            return 403, {"error": {"code": 403, "message": str(e)}}
+        except ProfilerBusy as e:
+            return 409, {"error": {"code": 409, "message": str(e)}}
+        return 200, artifact
+
+    rt.add("POST", "/debug/profile", post_profile)
     return rt
 
 
