@@ -239,7 +239,8 @@ def main() -> int:
     # not adopted (JAX pins its backend at first init)
     in_process = {
         "link_calibration", "fast_path", "mixed_general", "wave_latency",
-        "expand", "leopard", "serving", "cache_shield", "scale_10m",
+        "expand", "leopard", "serving", "serve_batch", "cache_shield",
+        "scale_10m",
         "scale_10m_mixed", "scale_10m_expand", "leopard_10m",
     }
 
@@ -276,6 +277,7 @@ def main() -> int:
         run("expand", _expand, out, state)
         run("leopard", _leopard, out, state)
         run("serving", _serving, out, state)
+        run("serve_batch", _serve_batch, out, state)
         run("cache_shield", _cache_shield, out, state)
         run("scale_10m", _scale_10m, out, state, baseline)
         run("scale_10m_mixed", _scale_10m_mixed, out, state)
@@ -639,6 +641,16 @@ def _serving(out, state) -> None:
     from bench_serve import run_serving_bench
 
     out.update(run_serving_bench(state["graph"], concurrency=32, duration=10.0))
+
+
+def _serve_batch(out, state) -> None:
+    # batch front door (ISSUE 7): /relation-tuples/batch/check hammered
+    # at high concurrency over the async REST server — the acceptance
+    # bar is >=20k checks/s at concurrency 512 / batch 512 with ZERO
+    # verdict divergence against the single-check endpoint
+    from bench_serve import run_batch_bench
+
+    out.update(run_batch_bench(state["graph"], concurrency=512, duration=6.0))
 
 
 def _cache_shield(out, state) -> None:

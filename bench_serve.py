@@ -190,6 +190,201 @@ def run_serving_bench(
         srv.stop(grace=2.0)
 
 
+def _hammer_rest_batch(
+    host: str, port: int, bodies: List[bytes], *,
+    concurrency: int, duration: float, batch_size: int,
+) -> Dict[str, float]:
+    """Closed-loop clients POSTing pre-encoded batch bodies over
+    keep-alive REST connections; returns rps / checks_per_sec / p50 /
+    p99 / errors."""
+    import http.client
+
+    lat: List[List[float]] = [[] for _ in range(concurrency)]
+    stop = threading.Event()
+    errors = [0]
+
+    def client(idx: int) -> None:
+        rng = np.random.default_rng(idx)
+        conn = http.client.HTTPConnection(host, port, timeout=120.0)
+        my = lat[idx]
+        n_bodies = len(bodies)
+        try:
+            while not stop.is_set():
+                body = bodies[int(rng.integers(n_bodies))]
+                t0 = time.perf_counter()
+                try:
+                    conn.request(
+                        "POST", "/relation-tuples/batch/check", body,
+                        {"Content-Type": "application/json"},
+                    )
+                    resp = conn.getresponse()
+                    resp.read()
+                    if resp.status != 200:
+                        errors[0] += 1
+                        continue
+                except (OSError, http.client.HTTPException):
+                    errors[0] += 1
+                    conn.close()
+                    conn = http.client.HTTPConnection(
+                        host, port, timeout=120.0
+                    )
+                    continue
+                my.append(time.perf_counter() - t0)
+        finally:
+            conn.close()
+
+    threads = [
+        threading.Thread(target=client, args=(i,), daemon=True)
+        for i in range(concurrency)
+    ]
+    t_start = time.perf_counter()
+    for t in threads:
+        t.start()
+    time.sleep(duration)
+    stop.set()
+    for t in threads:
+        t.join(timeout=15.0)
+    elapsed = time.perf_counter() - t_start
+    all_lat = np.array([x for sub in lat for x in sub])
+    done = len(all_lat)
+    return {
+        "rps": round(done / elapsed, 1),
+        "checks_per_sec": round(done * batch_size / elapsed, 1),
+        "p50_ms": round(float(np.percentile(all_lat, 50)) * 1000, 2)
+        if done else -1.0,
+        "p99_ms": round(float(np.percentile(all_lat, 99)) * 1000, 2)
+        if done else -1.0,
+        "errors": errors[0],
+    }
+
+
+def run_batch_bench(
+    graph=None,
+    *,
+    concurrency: int = 512,
+    duration: float = 6.0,
+    batch_sizes=(64, 512, 4096),
+    coalesce_ms: float = 2.0,
+    frontier: int = 16384,
+    arena: int = 65536,
+) -> Dict[str, float]:
+    """Batch front door (ISSUE 7): closed-loop clients POSTing
+    /relation-tuples/batch/check at high concurrency — the async event
+    loop holds the sockets, so 512 connections cost file descriptors,
+    not threads.  Publishes per-batch-size RPS + checks/sec + latency,
+    a verdict-divergence count against the single-check endpoint, and
+    the wave-occupancy picture."""
+    import urllib.request
+
+    from ketotpu.driver import Provider, Registry
+    from ketotpu.server import serve_all
+    from ketotpu.utils.synth import build_synth, synth_queries
+
+    if graph is None:
+        graph = build_synth(
+            n_users=2000, n_groups=100, n_folders=2000, n_docs=20000, seed=0
+        )
+    cfg = Provider(
+        {
+            "serve": {
+                n: {"host": "127.0.0.1", "port": 0}
+                for n in ("read", "write", "metrics", "opl")
+            },
+            "engine": {
+                "kind": "tpu",
+                "frontier": frontier,
+                "arena": arena,
+                "max_batch": frontier,
+                "coalesce_ms": coalesce_ms,
+            },
+            # the bench measures throughput, not shedding: admission off
+            # (the admission interplay has its own tests)
+            "limit": {"max_inflight": 0},
+            "log": {"request_log": False},
+        }
+    )
+    reg = Registry(
+        cfg, store=graph.store, namespace_manager=graph.manager
+    ).init()
+    srv = serve_all(reg)
+    try:
+        host, port = srv.addresses["read"]
+        queries = synth_queries(graph, 4096, seed=5)
+        tuple_jsons = [q.to_json() for q in queries]
+
+        def body_for(offset: int, size: int) -> bytes:
+            sel = [
+                tuple_jsons[(offset + j) % len(tuple_jsons)]
+                for j in range(size)
+            ]
+            return json.dumps({"tuples": sel}).encode()
+
+        # verdict divergence: the batch endpoint must answer EXACTLY like
+        # the single-check endpoint for the same queries at the same state
+        def post(path: str, body: bytes) -> dict:
+            req = urllib.request.Request(
+                f"http://{host}:{port}{path}", data=body,
+                headers={"Content-Type": "application/json"}, method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=120.0) as resp:
+                return json.loads(resp.read())
+
+        probe = post(
+            "/relation-tuples/batch/check", body_for(0, 512)
+        )["results"]
+        singles = post(
+            "/relation-tuples/check/batch", body_for(0, 512)
+        )["results"]
+        divergence = sum(
+            1 for b, s in zip(probe, singles)
+            if b.get("allowed") != s.get("allowed")
+        )
+
+        # warmup OUTSIDE the clock: compile every wave shape each batch
+        # size will hit (the batch-64 leg otherwise absorbs the compile)
+        for bs in batch_sizes:
+            post("/relation-tuples/batch/check", body_for(31, bs))
+
+        from ketotpu import compilewatch
+
+        compiles_before = compilewatch.get().compiles_total
+        per_size: Dict[str, Dict[str, float]] = {}
+        for bs in batch_sizes:
+            # a handful of rotating pre-encoded bodies per size: client
+            # JSON encode stays out of the measured loop, the server
+            # still parses every request in full
+            bodies = [body_for(o * 97, bs) for o in range(8)]
+            per_size[str(bs)] = _hammer_rest_batch(
+                host, port, bodies,
+                concurrency=concurrency, duration=duration, batch_size=bs,
+            )
+        wstats = reg.wave_ledger().stats()
+        eng = reg.check_engine()
+        mid = per_size.get("512") or per_size[str(batch_sizes[0])]
+        return {
+            "serve_batch": per_size,
+            "serve_batch_checks_per_sec": mid["checks_per_sec"],
+            "serve_batch_rps": mid["rps"],
+            "serve_batch_p99_ms": mid["p99_ms"],
+            "serve_batch_concurrency": concurrency,
+            "serve_batch_verdict_divergence": divergence,
+            "serve_batch_errors": sum(
+                v["errors"] for v in per_size.values()
+            ),
+            "serve_batch_ingested": int(getattr(eng, "batch_ingested", 0)),
+            "serve_batch_wave_size_mean": wstats.get("wave_size_mean", 0),
+            "serve_batch_wave_size_p95": wstats.get("wave_size_p95", 0),
+            "serve_batch_window_wait_ms_p50": wstats.get(
+                "window_wait_ms_p50", 0
+            ),
+            "serve_batch_hammer_compiles": (
+                compilewatch.get().compiles_total - compiles_before
+            ),
+        }
+    finally:
+        srv.stop(grace=2.0)
+
+
 def _scrape_means(metrics, name: str, label_keys) -> Dict[str, float]:
     """Mean milliseconds per histogram series, keyed by the joined label
     values ("check.coalesce_wait") — the per-stage RPC breakdown the bench
@@ -404,5 +599,7 @@ if __name__ == "__main__":
     secs = float(sys.argv[2]) if len(sys.argv) > 2 else 10.0
     if len(sys.argv) > 3 and sys.argv[3] == "workers":
         print(json.dumps(run_workers_bench(concurrency=conc, duration=secs)))
+    elif len(sys.argv) > 3 and sys.argv[3] == "batch":
+        print(json.dumps(run_batch_bench(concurrency=conc, duration=secs)))
     else:
         print(json.dumps(run_serving_bench(concurrency=conc, duration=secs)))

@@ -263,12 +263,72 @@ def cmd_serve_worker(args) -> int:
     return 0
 
 
+def _batch_check_lines(path: str):
+    """Relation tuples from a .jsonl file: each line is either a
+    relation-tuple JSON object or a canonical string form
+    ("File:doc#view@alice")."""
+    tuples = []
+    with (sys.stdin if path == "-" else open(path)) as fh:
+        for lineno, raw in enumerate(fh, 1):
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            try:
+                try:
+                    data = json.loads(line)
+                except json.JSONDecodeError:
+                    data = line
+                if isinstance(data, dict):
+                    tuples.append(RelationTuple.from_json(data))
+                else:
+                    tuples.append(RelationTuple.from_string(str(data)))
+            except KetoAPIError as e:
+                raise KetoAPIError(f"{path}:{lineno}: {e}") from None
+    return tuples
+
+
 def cmd_check(args) -> int:
-    from ketotpu.api.proto_codec import subject_to_proto
+    from ketotpu.api.proto_codec import subject_to_proto, tuple_to_proto
     from ketotpu.proto import check_service_pb2 as cs
     from ketotpu.proto import relation_tuples_pb2 as rts
     from ketotpu.proto.services import CheckServiceStub
 
+    if args.batch:
+        # one BatchCheck RPC for the whole file: per-item verdicts come
+        # back in request order, a bad line only fails its own item
+        from ketotpu.proto import batch_service_pb2 as bs
+
+        try:
+            tuples = _batch_check_lines(args.batch)
+        except (OSError, KetoAPIError) as e:
+            print(f"Could not read batch file: {e}", file=sys.stderr)
+            return 1
+        if not tuples:
+            print("batch file holds no tuples", file=sys.stderr)
+            return 1
+        req = bs.BatchCheckRequest(
+            tuples=[tuple_to_proto(t) for t in tuples],
+            max_depth=args.max_depth,
+            snaptoken=args.snaptoken or "",
+            latest=bool(args.latest),
+        )
+        with _channel(args.read_remote, args) as ch:
+            resp = CheckServiceStub(ch).BatchCheck(req)
+        all_ok = True
+        for t, item in zip(tuples, resp.results):
+            if item.error:
+                all_ok = False
+                print(f"Error({item.status or 500})\t{t}\t{item.error}")
+            else:
+                all_ok = all_ok and item.allowed
+                print(("Allowed" if item.allowed else "Denied") + f"\t{t}")
+        return 0 if all_ok else 1
+    if not all((args.subject, args.relation, args.namespace, args.object)):
+        print(
+            "check needs SUBJECT RELATION NAMESPACE OBJECT "
+            "(or --batch FILE.jsonl)", file=sys.stderr,
+        )
+        return 1
     try:
         subject = _parse_subject(args.subject)
     except KetoAPIError as e:
@@ -284,6 +344,8 @@ def cmd_check(args) -> int:
                     subject=subject_to_proto(subject),
                 ),
                 max_depth=args.max_depth,
+                snaptoken=args.snaptoken or "",
+                latest=bool(args.latest),
             )
         )
     print("Allowed" if resp.allowed else "Denied")
@@ -878,11 +940,25 @@ def build_parser() -> argparse.ArgumentParser:
     serve.set_defaults(fn=cmd_serve)
 
     check = sub.add_parser("check", help="check a permission")
-    check.add_argument("subject")
-    check.add_argument("relation")
-    check.add_argument("namespace")
-    check.add_argument("object")
+    check.add_argument("subject", nargs="?", default="")
+    check.add_argument("relation", nargs="?", default="")
+    check.add_argument("namespace", nargs="?", default="")
+    check.add_argument("object", nargs="?", default="")
     check.add_argument("--max-depth", type=int, default=0)
+    check.add_argument(
+        "--batch", default="",
+        help="check every relation tuple in FILE.jsonl (JSON object or "
+             "'Ns:obj#rel@subject' string per line; '-' = stdin) in ONE "
+             "BatchCheck RPC; prints one verdict line per tuple",
+    )
+    check.add_argument(
+        "--snaptoken", default="",
+        help="at-least-as-fresh consistency floor for the whole batch",
+    )
+    check.add_argument(
+        "--latest", action="store_true",
+        help="force a fully fresh read",
+    )
     _add_client_flags(check)
     check.set_defaults(fn=cmd_check)
 

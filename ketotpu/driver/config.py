@@ -97,6 +97,12 @@ def _defaults() -> Dict[str, Any]:
             "max_inflight": 1024,
             "request_timeout_ms": 30000,
             "sniff_timeout_ms": 10000,
+            # async REST front end (server/aio.py): listen backlog for the
+            # pre-created socket, and the size of the thread pool that runs
+            # parse+dispatch off the event loop.  Concurrency beyond the
+            # pool costs file descriptors, not threads.
+            "accept_backlog": 512,
+            "http_workers": 8,
         },
         "namespaces": [],
         "engine": {
@@ -113,6 +119,15 @@ def _defaults() -> Dict[str, Any]:
             # window (ms) for coalescing concurrent single checks into one
             # device dispatch; 0 disables (engine/coalesce.py)
             "coalesce_ms": 2,
+            # batches up to this size join the coalescer's wave machinery
+            # alongside concurrent singles (sharing one device dispatch);
+            # larger batches go straight to the device engine.  0 disables
+            # batch ingestion (batches always pass through).
+            "coalesce_batch_max": 256,
+            # worker-wire payloads at or above this many bytes ride a
+            # shared-memory segment instead of the unix socket
+            # (server/wire.py); 0 keeps everything on the socket
+            "wire_shm_threshold": 262144,
             # multi-chip: 0 = single device; n>0 = shard over an n-device mesh
             "mesh_devices": 0,
             "mesh_axis": "shard",
@@ -268,9 +283,11 @@ class Provider:
             # rejoin known multi-word leaf keys (env has one separator only)
             for known in ("max_read_depth", "max_read_width", "mesh_devices",
                           "mesh_axis", "max_batch", "retry_scale",
-                          "coalesce_ms", "experimental_strict_mode",
+                          "coalesce_ms", "coalesce_batch_max",
+                          "wire_shm_threshold", "experimental_strict_mode",
                           "max_inflight", "request_timeout_ms",
-                          "sniff_timeout_ms", "device_error_rate",
+                          "sniff_timeout_ms", "accept_backlog",
+                          "http_workers", "device_error_rate",
                           "device_stall_ms", "socket_drop_rate",
                           "latency_ms", "latency_rate", "max_pairs",
                           "rebuild_delta_pairs", "rebuild_dirty_sets",
@@ -430,7 +447,9 @@ class Provider:
             if not isinstance(val, int) or val < lo:
                 raise ConfigError(key, f"must be an integer >= {lo}, got {val!r}")
         for key in ("limit.max_inflight", "limit.request_timeout_ms",
-                    "limit.sniff_timeout_ms"):
+                    "limit.sniff_timeout_ms", "limit.accept_backlog",
+                    "limit.http_workers", "engine.coalesce_batch_max",
+                    "engine.wire_shm_threshold"):
             val = self.get(key)
             if not isinstance(val, int) or val < 0:
                 raise ConfigError(

@@ -488,6 +488,10 @@ class Registry:
                     self._check_engine = RemoteCheckEngine(
                         sock, rpc_timeout=self._request_timeout(),
                         cache=self.result_cache(), metrics=self.metrics(),
+                        shm_threshold=int(
+                            self.config.get("engine.wire_shm_threshold")
+                            or 262144
+                        ),
                     )
                 elif kind == "tpu":
                     common = dict(
@@ -545,6 +549,10 @@ class Registry:
                     self._check_engine = (
                         CoalescingEngine(
                             dev, window=ms / 1000.0,
+                            batch_max=int(
+                                self.config.get("engine.coalesce_batch_max")
+                                or 0
+                            ),
                             default_timeout=self._request_timeout(),
                             cache=self.result_cache(),
                             metrics=self.metrics(),
@@ -758,6 +766,8 @@ class Registry:
                     help="checks collapsed onto an identical pending slot")
             m.gauge("keto_coalescer_cache_hits", outer.cache_hits,
                     help="checks served from the cache before admission")
+            m.gauge("keto_engine_batch_ingested", outer.batch_ingested,
+                    help="batch items ridden on coalesced waves")
         m.gauge("keto_engine_oracle_fallbacks", eng.fallbacks,
                 help="queries answered by the host oracle")
         m.gauge("keto_engine_device_retries", eng.retries,

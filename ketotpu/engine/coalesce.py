@@ -60,11 +60,16 @@ class CoalescingEngine:
 
     def __init__(self, inner, *, window: float = 0.002,
                  max_pending: int = 4096,
+                 batch_max: int = 0,
                  default_timeout: float = 30.0,
                  cache=None, metrics=None, ledger=None):
         self.inner = inner
         self.window = window
         self.max_pending = max_pending
+        # batches up to this size join the wave machinery alongside
+        # concurrent singles (one shared device dispatch); larger batches
+        # — already device-sized — pass straight through.  0 disables.
+        self.batch_max = batch_max
         # wave ledger (ketotpu/waveledger.py): one record per dispatched
         # wave, filed on the worker thread; None = no ledger (direct use)
         self.ledger = ledger
@@ -89,6 +94,7 @@ class CoalescingEngine:
         self.deadline_exceeded = 0  # observability: slot waits timed out
         self.singleflight_collapsed = 0  # observability: follower joins
         self.cache_hits = 0  # observability: checks served pre-admission
+        self.batch_ingested = 0  # observability: batch items ridden on waves
         self._worker = threading.Thread(
             target=self._run, name="keto-coalescer", daemon=True
         )
@@ -190,7 +196,106 @@ class CoalescingEngine:
     def batch_check(
         self, queries: Sequence[RelationTuple], rest_depth: int = 0
     ) -> List[bool]:
-        return self.inner.batch_check(queries, rest_depth)
+        n = len(queries)
+        if n == 0 or self.batch_max <= 0 or n > self.batch_max:
+            # device-sized batches are already amortized — pass through
+            return self.inner.batch_check(queries, rest_depth)
+        bypass = cache_context.bypassed()
+        results: List[Optional[bool]] = [None] * n
+        todo = list(range(n))
+        if self.cache is not None and not bypass:
+            t_probe = time.perf_counter()
+            hits = self.cache.lookup_many(
+                [cache_check_key(q, rest_depth) for q in queries]
+            )
+            flightrec.note_stage("cache", time.perf_counter() - t_probe)
+            todo = []
+            for i, hit in enumerate(hits):
+                if hit is not None:
+                    self.cache_hits += 1
+                    results[i] = bool(hit.value)
+                else:
+                    todo.append(i)
+            if not todo:
+                return [bool(v) for v in results]
+        # ONE budget shared by every item in the batch: read once here,
+        # burned down across the slot waits — items never re-arm timers
+        budget = deadline.remaining()
+        if budget is None:
+            budget = self.default_timeout if self.default_timeout > 0 else None
+        if budget is not None and budget <= 0:
+            self.deadline_exceeded += 1
+            flightrec.note_stage("deadline", 0.0)
+            raise DeadlineExceededError(
+                "deadline exceeded before batch was enqueued"
+            )
+        t0 = time.perf_counter()
+        entries: List[tuple] = []  # (result index, slot)
+        tp = flightrec.current_traceparent()
+        with self._wake:
+            if self._closed or len(self._pending) + len(todo) > self.max_pending:
+                # worker gone, or no room to coalesce — the batch is
+                # already a batch, dispatch it directly (the front-door
+                # AdmissionController is the shedding authority here)
+                entries = None
+            else:
+                for i in todo:
+                    q = queries[i]
+                    flight_key = (str(q), rest_depth)
+                    slot = None if bypass else self._inflight.get(flight_key)
+                    if slot is not None:
+                        # singleflight across AND within the batch: twins
+                        # park on the pending slot's verdict
+                        self.singleflight_collapsed += 1
+                        slot.followers += 1
+                    else:
+                        slot = _Slot(q, rest_depth, bypass=bypass)
+                        slot.traceparent = tp
+                        self._pending.append(slot)
+                        if not bypass:
+                            self._inflight[flight_key] = slot
+                    entries.append((i, slot))
+                self.batch_ingested += len(todo)
+                self._wake.notify()
+        if entries is None:
+            verdicts = self.inner.batch_check(
+                [queries[i] for i in todo], rest_depth
+            )
+            for i, v in zip(todo, verdicts):
+                results[i] = bool(v)
+            return [bool(v) for v in results]
+        waited: set = set()
+        last_dispatch = None
+        wave_id = None
+        for i, slot in entries:
+            if id(slot) not in waited:
+                waited.add(id(slot))
+                left = None
+                if budget is not None:
+                    left = budget - (time.perf_counter() - t0)
+                    if left <= 0 or not slot.event.wait(left):
+                        self.deadline_exceeded += 1
+                        flightrec.note_stage(
+                            "deadline", time.perf_counter() - t0
+                        )
+                        raise DeadlineExceededError(
+                            f"batch did not complete within {budget:.3f}s"
+                        )
+                else:
+                    slot.event.wait()
+                if slot.t_dispatch is not None:
+                    last_dispatch = slot.t_dispatch
+                    wave_id = slot.wave
+            if slot.error is not None:
+                # typed per-query error: raise like the inner engine would
+                raise slot.error
+            results[i] = bool(slot.result)
+        done = time.perf_counter()
+        if last_dispatch is not None:
+            flightrec.note_stage("coalesce_wait", last_dispatch - t0)
+            flightrec.note_stage("device_compute", done - last_dispatch)
+            flightrec.note(wave=wave_id)
+        return [bool(v) for v in results]
 
     def __getattr__(self, name):
         return getattr(self.inner, name)

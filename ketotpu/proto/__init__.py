@@ -18,6 +18,7 @@ if _HERE not in sys.path:
 from health.v1 import health_pb2  # noqa: E402,F401
 from ory.keto.opl.v1alpha1 import syntax_service_pb2  # noqa: E402,F401
 from ory.keto.relation_tuples.v1alpha2 import (  # noqa: E402,F401
+    batch_service_pb2,
     check_service_pb2,
     expand_service_pb2,
     namespaces_service_pb2,

@@ -1,0 +1,110 @@
+# -*- coding: utf-8 -*-
+# source: ory/keto/relation_tuples/v1alpha2/batch_service.proto
+"""Protobuf bindings for the BatchCheck/BatchExpand wire messages.
+
+These RPCs are EXTENSIONS over the vendored reference contract — Keto at
+this version has no batch RPCs — so there is no upstream generated module
+to vendor.  `protoc` is unavailable in this environment; like
+watch_service_pb2, the module assembles the FileDescriptorProto
+programmatically and feeds it through the exact AddSerializedFile +
+builder path protoc output uses.  The human-readable source lives at
+proto/ory/keto/relation_tuples/v1alpha2/batch_service.proto.
+
+Only messages are declared here: the RPCs themselves ride on the EXISTING
+CheckService/ExpandService (as BatchCheck/BatchExpand methods), and those
+service descriptors are already registered by their own modules — the
+method registration authority is ketotpu.proto.services.SERVICES, which
+gRPC consults instead of the descriptor pool.
+"""
+from google.protobuf import descriptor_pb2 as _dpb
+from google.protobuf import descriptor_pool as _descriptor_pool
+from google.protobuf import symbol_database as _symbol_database
+from google.protobuf.internal import builder as _builder
+
+_sym_db = _symbol_database.Default()
+
+# dependencies must be registered in the pool before this file is added
+from ory.keto.relation_tuples.v1alpha2 import relation_tuples_pb2 as ory_dot_keto_dot_relation__tuples_dot_v1alpha2_dot_relation__tuples__pb2  # noqa: E501,F401
+from ory.keto.relation_tuples.v1alpha2 import expand_service_pb2 as ory_dot_keto_dot_relation__tuples_dot_v1alpha2_dot_expand__service__pb2  # noqa: E501,F401
+
+_PKG = "ory.keto.relation_tuples.v1alpha2"
+_F = _dpb.FieldDescriptorProto
+
+
+def _file_descriptor() -> bytes:
+    fd = _dpb.FileDescriptorProto()
+    fd.name = "ory/keto/relation_tuples/v1alpha2/batch_service.proto"
+    fd.package = _PKG
+    fd.syntax = "proto3"
+    fd.dependency.append(
+        "ory/keto/relation_tuples/v1alpha2/relation_tuples.proto"
+    )
+    fd.dependency.append(
+        "ory/keto/relation_tuples/v1alpha2/expand_service.proto"
+    )
+
+    def field(msg, name, number, ftype, type_name="", repeated=False):
+        f = msg.field.add()
+        f.name = name
+        f.number = number
+        f.label = _F.LABEL_REPEATED if repeated else _F.LABEL_OPTIONAL
+        f.type = ftype
+        if type_name:
+            f.type_name = type_name
+        f.json_name = name
+        return f
+
+    req = fd.message_type.add()
+    req.name = "BatchCheckRequest"
+    field(req, "tuples", 1, _F.TYPE_MESSAGE, f".{_PKG}.RelationTuple",
+          repeated=True)
+    # ONE consistency mode for the whole batch: every verdict is computed
+    # against the same snapshot
+    field(req, "snaptoken", 2, _F.TYPE_STRING)
+    field(req, "latest", 3, _F.TYPE_BOOL)
+    field(req, "max_depth", 4, _F.TYPE_INT32)
+
+    item = fd.message_type.add()
+    item.name = "BatchCheckResponseItem"
+    field(item, "allowed", 1, _F.TYPE_BOOL)
+    # per-item error isolation: status!=0 carries the item's HTTP-shaped
+    # status code (400 bad tuple, 504 deadline, ...) without failing the
+    # batch; allowed is meaningless for such items
+    field(item, "error", 2, _F.TYPE_STRING)
+    field(item, "status", 3, _F.TYPE_INT32)
+
+    resp = fd.message_type.add()
+    resp.name = "BatchCheckResponse"
+    field(resp, "results", 1, _F.TYPE_MESSAGE,
+          f".{_PKG}.BatchCheckResponseItem", repeated=True)
+    field(resp, "snaptoken", 2, _F.TYPE_STRING)
+
+    ereq = fd.message_type.add()
+    ereq.name = "BatchExpandRequest"
+    field(ereq, "subjects", 1, _F.TYPE_MESSAGE, f".{_PKG}.SubjectSet",
+          repeated=True)
+    field(ereq, "snaptoken", 2, _F.TYPE_STRING)
+    field(ereq, "latest", 3, _F.TYPE_BOOL)
+    field(ereq, "max_depth", 4, _F.TYPE_INT32)
+
+    eitem = fd.message_type.add()
+    eitem.name = "BatchExpandResponseItem"
+    field(eitem, "tree", 1, _F.TYPE_MESSAGE, f".{_PKG}.SubjectTree")
+    field(eitem, "error", 2, _F.TYPE_STRING)
+    field(eitem, "status", 3, _F.TYPE_INT32)
+
+    eresp = fd.message_type.add()
+    eresp.name = "BatchExpandResponse"
+    field(eresp, "results", 1, _F.TYPE_MESSAGE,
+          f".{_PKG}.BatchExpandResponseItem", repeated=True)
+    field(eresp, "snaptoken", 2, _F.TYPE_STRING)
+    return fd.SerializeToString()
+
+
+DESCRIPTOR = _descriptor_pool.Default().AddSerializedFile(_file_descriptor())
+
+_builder.BuildMessageAndEnumDescriptors(DESCRIPTOR, globals())
+_builder.BuildTopDescriptorsAndMessages(
+    DESCRIPTOR, "ory.keto.relation_tuples.v1alpha2.batch_service_pb2",
+    globals(),
+)

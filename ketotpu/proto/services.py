@@ -24,6 +24,7 @@ from typing import Callable, Dict, Tuple, Type
 import grpc
 
 from ketotpu.proto import (
+    batch_service_pb2,
     check_service_pb2,
     expand_service_pb2,
     health_pb2,
@@ -42,9 +43,21 @@ _OPL = "ory.keto.opl.v1alpha1"
 SERVICES: Dict[str, Dict[str, Tuple[Type, Type]]] = {
     f"{_RTS}.CheckService": {
         "Check": (check_service_pb2.CheckRequest, check_service_pb2.CheckResponse),
+        # EXTENSION: first-class batched checks — one RPC, many verdicts,
+        # one shared consistency mode + snaptoken for the whole batch
+        # (proto/ory/keto/relation_tuples/v1alpha2/batch_service.proto)
+        "BatchCheck": (
+            batch_service_pb2.BatchCheckRequest,
+            batch_service_pb2.BatchCheckResponse,
+        ),
     },
     f"{_RTS}.ExpandService": {
         "Expand": (expand_service_pb2.ExpandRequest, expand_service_pb2.ExpandResponse),
+        # EXTENSION: batched expansion trees, same batch semantics
+        "BatchExpand": (
+            batch_service_pb2.BatchExpandRequest,
+            batch_service_pb2.BatchExpandResponse,
+        ),
     },
     f"{_RTS}.ReadService": {
         "ListRelationTuples": (

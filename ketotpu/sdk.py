@@ -148,33 +148,88 @@ class KetoClient:
             max_depth=max_depth, snaptoken=snaptoken, latest=latest,
         )
 
+    @staticmethod
+    def _consistency_fields(
+        consistency: Optional[str], snaptoken: Optional[str], latest: bool,
+    ) -> dict:
+        """One consistency mode for a whole batch: ``consistency`` is
+        either the string ``"latest"`` or a snaptoken (explicit
+        ``snaptoken=``/``latest=`` kwargs still work)."""
+        out: dict = {}
+        if consistency == "latest" or latest:
+            out["latest"] = True
+        elif consistency:
+            out["snaptoken"] = consistency
+        if snaptoken and "snaptoken" not in out:
+            out["snaptoken"] = snaptoken
+        return out
+
+    def batch_check_results(
+        self,
+        tuples: Sequence[RelationTuple],
+        *,
+        max_depth: int = 0,
+        consistency: Optional[str] = None,
+        snaptoken: Optional[str] = None,
+        latest: bool = False,
+    ) -> List[dict]:
+        """Per-item verdicts for many checks in ONE request (batch front
+        door, POST /relation-tuples/batch/check).  Each result is either
+        ``{"allowed": bool}`` or ``{"error": str, "status": int}`` — a bad
+        item never poisons its neighbours.  The whole batch shares one
+        consistency mode and one deadline budget.  Items may be
+        ``RelationTuple`` objects, already-encoded JSON dicts, or
+        canonical ``"Ns:obj#rel@subject"`` strings (the same forms the
+        CLI's ``check --batch`` jsonl accepts)."""
+        payload: dict = {
+            "tuples": [
+                t if isinstance(t, dict)
+                else RelationTuple.from_string(t).to_json()
+                if isinstance(t, str)
+                else t.to_json()
+                for t in tuples
+            ]
+        }
+        if max_depth:
+            payload["max_depth"] = max_depth
+        payload.update(
+            self._consistency_fields(consistency, snaptoken, latest)
+        )
+        status, body = self._request(
+            "POST", f"{self.read_url}/relation-tuples/batch/check", payload
+        )
+        if status != 200:
+            self._raise_for(status, body)
+        data = json.loads(body)
+        if data.get("snaptoken"):
+            self.last_snaptoken = data["snaptoken"]
+        return list(data["results"])
+
     def batch_check(
         self,
         tuples: Sequence[RelationTuple],
         *,
         max_depth: int = 0,
+        consistency: Optional[str] = None,
         snaptoken: Optional[str] = None,
         latest: bool = False,
     ) -> List[bool]:
-        """Many checks in one request (extension endpoint
-        POST /relation-tuples/check/batch; the TPU engine answers the whole
-        list in fused device dispatches)."""
-        params = {}
-        if max_depth:
-            params["max-depth"] = str(max_depth)
-        if snaptoken:
-            params["snaptoken"] = snaptoken
-        if latest:
-            params["latest"] = "true"
-        url = f"{self.read_url}/relation-tuples/check/batch"
-        if params:
-            url += "?" + urllib.parse.urlencode(params)
-        status, body = self._request(
-            "POST", url, {"tuples": [t.to_json() for t in tuples]}
+        """Many checks in one request over the batch front door; the TPU
+        engine answers the whole list in fused device dispatches.  Returns
+        one verdict per tuple; a per-item error raises its typed error
+        (use :meth:`batch_check_results` for per-item isolation)."""
+        results = self.batch_check_results(
+            tuples, max_depth=max_depth, consistency=consistency,
+            snaptoken=snaptoken, latest=latest,
         )
-        if status != 200:
-            self._raise_for(status, body)
-        return [bool(r["allowed"]) for r in json.loads(body)["results"]]
+        out: List[bool] = []
+        for r in results:
+            if "error" in r:
+                self._raise_for(
+                    int(r.get("status", 500)), json.dumps(r)
+                )
+            out.append(bool(r["allowed"]))
+        return out
 
     # -- expand -------------------------------------------------------------
 
@@ -206,6 +261,68 @@ class KetoClient:
         if status != 200:
             self._raise_for(status, body)
         return Tree.from_json(json.loads(body))
+
+    def batch_expand_results(
+        self,
+        subject_sets: Sequence[SubjectSet],
+        *,
+        max_depth: int = 0,
+        consistency: Optional[str] = None,
+        snaptoken: Optional[str] = None,
+        latest: bool = False,
+    ) -> List[dict]:
+        """Per-item trees for many expansions in ONE request (batch front
+        door, POST /relation-tuples/batch/expand).  Each result is either
+        ``{"tree": {...}}`` or ``{"error": str, "status": int}`` (an empty
+        expansion is a per-item 404, matching the single endpoint)."""
+        payload: dict = {"subjects": [
+            {
+                "namespace": s.namespace,
+                "object": s.object,
+                "relation": s.relation,
+            }
+            for s in subject_sets
+        ]}
+        if max_depth:
+            payload["max_depth"] = max_depth
+        payload.update(
+            self._consistency_fields(consistency, snaptoken, latest)
+        )
+        status, body = self._request(
+            "POST", f"{self.read_url}/relation-tuples/batch/expand", payload
+        )
+        if status != 200:
+            self._raise_for(status, body)
+        data = json.loads(body)
+        if data.get("snaptoken"):
+            self.last_snaptoken = data["snaptoken"]
+        return list(data["results"])
+
+    def batch_expand(
+        self,
+        subject_sets: Sequence[SubjectSet],
+        *,
+        max_depth: int = 0,
+        consistency: Optional[str] = None,
+        snaptoken: Optional[str] = None,
+        latest: bool = False,
+    ) -> List[Optional[Tree]]:
+        """Many expansions in one request.  Returns one ``Tree`` (or
+        ``None`` for an empty expansion) per subject set; a non-404
+        per-item error raises its typed error."""
+        out: List[Optional[Tree]] = []
+        for r in self.batch_expand_results(
+            subject_sets, max_depth=max_depth, consistency=consistency,
+            snaptoken=snaptoken, latest=latest,
+        ):
+            if "error" in r:
+                if int(r.get("status", 500)) == 404:
+                    out.append(None)
+                    continue
+                self._raise_for(int(r.get("status", 500)), json.dumps(r))
+            else:
+                out.append(Tree.from_json(r["tree"]))
+        return out
 
     # -- relation tuples ----------------------------------------------------
 

@@ -27,20 +27,29 @@ class AdmissionController:
     def enabled(self) -> bool:
         return self.limit > 0
 
-    def try_acquire(self) -> bool:
-        """Admit one request, or refuse without blocking."""
+    def try_acquire(self, weight: int = 1) -> bool:
+        """Admit ``weight`` units of work, or refuse without blocking.
+
+        Batch RPCs are admitted by ITEM count, not request count — one
+        4096-item batch costs 4096 units, so a flood of batches sheds at
+        the same engine pressure a flood of singles would.  A single
+        batch larger than the whole budget is clamped to the budget:
+        it can still run, but only alone (otherwise any batch above
+        ``limit`` would be unservable by construction).
+        """
         if self.limit <= 0:
             return True
+        weight = min(max(1, int(weight)), self.limit)
         with self._lock:
-            if self.inflight >= self.limit:
-                self.shed += 1
+            if self.inflight + weight > self.limit:
+                self.shed += weight
                 return False
-            self.inflight += 1
+            self.inflight += weight
             return True
 
-    def release(self) -> None:
+    def release(self, weight: int = 1) -> None:
         if self.limit <= 0:
             return
+        weight = min(max(1, int(weight)), self.limit)
         with self._lock:
-            if self.inflight > 0:
-                self.inflight -= 1
+            self.inflight = max(0, self.inflight - weight)

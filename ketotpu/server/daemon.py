@@ -363,20 +363,15 @@ class Server:
 
         # metrics: plain HTTP, no gRPC, no mux (daemon.go:189-228)
         host, port = r.config.listen_on("metrics")
-        httpd = rest.make_http_server(
-            rest.metrics_router(r), host, port, reuse_port=self.reuse_port
-        )
         ctx = self._ssl_context("metrics")
-        if ctx is not None:
-            # deferred handshake: with do_handshake_on_connect the TLS
-            # handshake would run inside accept() on the serve_forever
-            # thread, so one stalled client blocks every scrape; deferring
-            # moves it into the per-connection handler thread, which also
-            # gets a read timeout
-            httpd.socket = ctx.wrap_socket(
-                httpd.socket, server_side=True,
-                do_handshake_on_connect=False,
-            )
+        # TLS rides the event loop (server/aio.py): per-connection
+        # handshakes run inside the loop with their own timeout, so a
+        # stalled client can never block accepts — no deferred-handshake
+        # socket wrapping needed
+        httpd = rest.make_http_server(
+            rest.metrics_router(r), host, port,
+            reuse_port=self.reuse_port, ssl_ctx=ctx,
+        )
         self._http_servers.append(httpd)
         t = threading.Thread(target=httpd.serve_forever, daemon=True)
         t.start()

@@ -24,10 +24,11 @@ Behavioral parity notes (each encoded below, with the reference site):
 from __future__ import annotations
 
 import time
+from contextlib import contextmanager
 
 import grpc
 
-from ketotpu import consistency, flightrec
+from ketotpu import consistency, deadline, flightrec
 from ketotpu.cache import context as cache_context
 from ketotpu.cache import expand_key as cache_expand_key
 from ketotpu.api.proto_codec import (
@@ -38,11 +39,13 @@ from ketotpu.api.proto_codec import (
 )
 from ketotpu.api.types import (
     BadRequestError,
+    DeadlineExceededError,
     KetoAPIError,
     NotFoundError,
     RelationQuery,
     RelationTuple,
     SubjectSet,
+    TooManyRequestsError,
 )
 from ketotpu.observability import (
     PERMISSIONS_CHECKED,
@@ -52,6 +55,7 @@ from ketotpu.observability import (
 )
 from ketotpu.opl.parser import parse as opl_parse
 from ketotpu.proto import (
+    batch_service_pb2,
     check_service_pb2,
     expand_service_pb2,
     namespaces_service_pb2,
@@ -91,6 +95,54 @@ def _abort(context, e: Exception):
         code = _GRPC_CODES.get(e.status_code or 500, grpc.StatusCode.UNKNOWN)
         context.abort(code, str(e))
     context.abort(grpc.StatusCode.INTERNAL, str(e))
+
+
+@contextmanager
+def batch_admission(r, n: int):
+    """Per-item admission accounting for batch RPCs.
+
+    The front door (REST handler / gRPC interceptor) already admitted the
+    REQUEST (weight 1); a batch of n items acquires the remaining n-1
+    units here so a flood of batches sheds at the same engine pressure a
+    flood of singles would.  Refusal raises the typed 429 that both
+    transports already map (Retry-After on REST, RESOURCE_EXHAUSTED on
+    gRPC)."""
+    ctl = r.admission()
+    extra = max(0, int(n) - 1)
+    if extra == 0 or ctl is None or not ctl.enabled:
+        yield
+        return
+    # the front door already holds this REQUEST's unit, so clamp the
+    # batch's extra weight to limit-1: an oversized batch can still run,
+    # but only alone (try_acquire's own clamp stops at limit, which on
+    # top of the held unit would make any batch > limit unservable)
+    extra = min(extra, max(1, ctl.limit - 1))
+    if not ctl.try_acquire(extra):
+        r.metrics().counter(
+            "keto_requests_shed_total", 1.0,
+            help="requests refused by admission control",
+            transport="batch",
+        )
+        raise TooManyRequestsError(
+            f"in-flight limit reached ({ctl.limit}); "
+            f"batch of {n} refused; retry later"
+        )
+    try:
+        yield
+    finally:
+        ctl.release(extra)
+
+
+def record_batch(r, op: str, n: int) -> None:
+    """Batch observability vocabulary (README metric table)."""
+    r.metrics().counter(
+        "keto_batch_requests_total", 1.0,
+        help="batch RPCs served", op=op,
+    )
+    r.metrics().observe(
+        "keto_batch_size", float(n),
+        help="items per batch RPC", op=op,
+    )
 
 
 class CheckHandler:
@@ -167,6 +219,100 @@ class CheckHandler:
         r.tracer().event(PERMISSIONS_CHECKED)
         return out
 
+    def batch_check_items(self, items, max_depth: int, r=None):
+        """Wire-facing batch core with PER-ITEM verdicts and errors.
+
+        ``items`` entries are either RelationTuples or exceptions (a
+        caller that failed to parse item i passes the error in its slot —
+        one bad tuple must not fail the batch).  Returns one dict per
+        item: ``{"allowed": bool}`` or ``{"error": str, "status": int}``.
+
+        Error isolation contract:
+        * per-item parse/validation errors -> that item only;
+        * unknown namespace -> ``allowed=false`` (single-check parity);
+        * a deadline expiry mid-batch -> the UNANSWERED items come back
+          as per-item 504 DEADLINE_EXCEEDED entries and the batch still
+          returns (partial results, not a dropped batch);
+        * any other engine-level failure annotates the items that were
+          riding that dispatch, never the pre-resolved ones.
+        """
+        r = r if r is not None else self.r
+        out: list = [None] * len(items)
+        ok_idx = []
+        for i, t in enumerate(items):
+            if isinstance(t, Exception):
+                code = getattr(t, "status_code", None) or 400
+                out[i] = {"error": str(t), "status": int(code)}
+                continue
+            try:
+                r.read_only_mapper().from_tuple(t)
+            except NotFoundError:
+                out[i] = {"allowed": False}  # check/handler.go:169-171
+                continue
+            except KetoAPIError as e:
+                out[i] = {"error": str(e), "status": e.status_code or 400}
+                continue
+            ok_idx.append(i)
+        if ok_idx:
+            engine = r.check_engine()
+            batch = [items[i] for i in ok_idx]
+            with r.tracer().span("check.Engine.BatchCheck"):
+                try:
+                    rem = deadline.remaining()
+                    if rem is not None and rem <= 0:
+                        raise DeadlineExceededError(
+                            "deadline exceeded before batch dispatch"
+                        )
+                    bc = getattr(engine, "batch_check", None)
+                    verdicts = (
+                        bc(batch, max_depth) if bc is not None
+                        else [
+                            engine.check_is_member(t, max_depth)
+                            for t in batch
+                        ]
+                    )
+                    for i, v in zip(ok_idx, verdicts):
+                        out[i] = {"allowed": bool(v)}
+                except DeadlineExceededError as e:
+                    # ONE deadline budget for the whole batch: the expiry
+                    # is batch-wide by design, every unanswered item gets
+                    # its per-item 504 (partial results, the batch returns)
+                    for i in ok_idx:
+                        if out[i] is None:
+                            out[i] = {"error": str(e), "status": 504}
+                except KetoAPIError:
+                    # a typed error aborted the fused dispatch: answer
+                    # each unanswered item individually so only the
+                    # erroring items fail (still inside the one budget)
+                    for i in ok_idx:
+                        if out[i] is not None:
+                            continue
+                        rem = deadline.remaining()
+                        if rem is not None and rem <= 0:
+                            out[i] = {
+                                "error": "deadline exceeded mid-batch",
+                                "status": 504,
+                            }
+                            continue
+                        try:
+                            out[i] = {"allowed": bool(
+                                engine.check_is_member(items[i], max_depth)
+                            )}
+                        except KetoAPIError as e2:
+                            out[i] = {
+                                "error": str(e2),
+                                "status": e2.status_code or 500,
+                            }
+        for v in out:
+            if v is not None and "allowed" in v:
+                r.metrics().counter(
+                    "keto_checks_total", 1,
+                    help="authorization checks served",
+                    allowed=str(v["allowed"]).lower(),
+                )
+        r.tracer().event(PERMISSIONS_CHECKED)
+        return out
+
     def snaptoken(self, r=None) -> str:
         """A real snaptoken (the Zanzibar zookie the reference stubs,
         check_service.proto:51-60): store version + changelog cursor +
@@ -224,6 +370,64 @@ class CheckHandler:
         except Exception as e:  # noqa: BLE001 - mapped to status codes
             _abort(context, e)
 
+    # gRPC CheckService.BatchCheck (EXTENSION — batch_service.proto)
+    def BatchCheck(self, request, context):
+        try:
+            md = _md(context)
+            r = self.r.resolve(md)
+            # ONE flight-recorder span for the whole batch: the stage
+            # vector decomposes the batch, not each item
+            with flightrec.rpc_recording(
+                r, "check", traceparent=md.get("traceparent"),
+                detail=f"grpc BatchCheck n={len(request.tuples)}",
+            ):
+                t0 = time.perf_counter()
+                items = []
+                for p in request.tuples:
+                    try:
+                        items.append(tuple_from_proto(p))
+                    except KetoAPIError as e:
+                        items.append(e)
+                flightrec.note_stage("parse", time.perf_counter() - t0)
+                flightrec.note(batch=len(items))
+                record_batch(r, "check", len(items))
+                with batch_admission(r, len(items)):
+                    token = None
+                    if request.snaptoken or request.latest:
+                        # one shared consistency mode: every verdict in
+                        # the batch is computed against the same snapshot
+                        tb = time.perf_counter()
+                        token = consistency.ensure_fresh(
+                            r, request.snaptoken or None,
+                            bool(request.latest), op="check",
+                        )
+                        flightrec.note_stage(
+                            "barrier", time.perf_counter() - tb
+                        )
+                    t1 = time.perf_counter()
+                    with cache_context.request_scope(
+                        r, md, token=token, latest=bool(request.latest)
+                    ):
+                        results = self.batch_check_items(
+                            items, int(request.max_depth), r
+                        )
+                flightrec.note_stage("compute", time.perf_counter() - t1)
+                t2 = time.perf_counter()
+                resp = batch_service_pb2.BatchCheckResponse(
+                    snaptoken=self.snaptoken(r)
+                )
+                for res in results:
+                    item = resp.results.add()
+                    if "allowed" in res:
+                        item.allowed = res["allowed"]
+                    else:
+                        item.error = res["error"]
+                        item.status = res["status"]
+                flightrec.note_stage("encode", time.perf_counter() - t2)
+                return resp
+        except Exception as e:  # noqa: BLE001
+            _abort(context, e)
+
 
 class ExpandHandler:
     """`internal/expand/handler.go` — REST core + ExpandService servicer."""
@@ -257,6 +461,42 @@ class ExpandHandler:
             cache.insert(key, tree, cursor)
         r.tracer().event(PERMISSIONS_EXPANDED)
         return tree
+
+    def batch_expand_items(self, subjects, max_depth: int, r=None):
+        """Per-item batch expansion.  ``subjects`` entries are SubjectSets
+        or exceptions (parse isolation, same contract as
+        batch_check_items).  Returns one dict per item: ``{"tree": Tree}``
+        (tree may be None: empty expansion, 404 on the single route), or
+        ``{"error": str, "status": int}``.
+
+        Expansion has no fused device batch, so items run sequentially
+        inside the one RPC — which makes TRUE partial results on deadline
+        natural: once the budget expires, every remaining item comes back
+        as a per-item 504 and the answered prefix is kept."""
+        r = r if r is not None else self.r
+        out: list = []
+        expired = False
+        for s in subjects:
+            if isinstance(s, Exception):
+                code = getattr(s, "status_code", None) or 400
+                out.append({"error": str(s), "status": int(code)})
+                continue
+            rem = deadline.remaining()
+            if expired or (rem is not None and rem <= 0):
+                expired = True
+                out.append({
+                    "error": "deadline exceeded before item expansion",
+                    "status": 504,
+                })
+                continue
+            try:
+                out.append({"tree": self.expand_core(s, max_depth, r)})
+            except DeadlineExceededError as e:
+                expired = True
+                out.append({"error": str(e), "status": 504})
+            except KetoAPIError as e:
+                out.append({"error": str(e), "status": e.status_code or 500})
+        return out
 
     # gRPC ExpandService.Expand
     def Expand(self, request, context):
@@ -307,6 +547,64 @@ class ExpandHandler:
                     resp = expand_service_pb2.ExpandResponse(
                         tree=tree_to_proto(tree)
                     )
+                flightrec.note_stage("encode", time.perf_counter() - t2)
+                return resp
+        except Exception as e:  # noqa: BLE001
+            _abort(context, e)
+
+    # gRPC ExpandService.BatchExpand (EXTENSION — batch_service.proto)
+    def BatchExpand(self, request, context):
+        try:
+            md = _md(context)
+            r = self.r.resolve(md)
+            with flightrec.rpc_recording(
+                r, "expand", traceparent=md.get("traceparent"),
+                detail=f"grpc BatchExpand n={len(request.subjects)}",
+            ):
+                t0 = time.perf_counter()
+                subjects = [
+                    SubjectSet(s.namespace, s.object, s.relation)
+                    for s in request.subjects
+                ]
+                flightrec.note_stage("parse", time.perf_counter() - t0)
+                flightrec.note(batch=len(subjects))
+                record_batch(r, "expand", len(subjects))
+                with batch_admission(r, len(subjects)):
+                    token = None
+                    if request.snaptoken or request.latest:
+                        tb = time.perf_counter()
+                        token = consistency.ensure_fresh(
+                            r, request.snaptoken or None,
+                            bool(request.latest), op="expand",
+                        )
+                        flightrec.note_stage(
+                            "barrier", time.perf_counter() - tb
+                        )
+                    t1 = time.perf_counter()
+                    with cache_context.request_scope(
+                        r, md, token=token, latest=bool(request.latest)
+                    ):
+                        results = self.batch_expand_items(
+                            subjects, int(request.max_depth), r
+                        )
+                flightrec.note_stage("compute", time.perf_counter() - t1)
+                t2 = time.perf_counter()
+                resp = batch_service_pb2.BatchExpandResponse(
+                    snaptoken=consistency.mint(
+                        r.store(), r._device_engine()
+                    ).encode()
+                )
+                for res in results:
+                    item = resp.results.add()
+                    if "tree" in res:
+                        if res["tree"] is None:
+                            item.error = "no relation tuple found"
+                            item.status = 404
+                        else:
+                            item.tree.CopyFrom(tree_to_proto(res["tree"]))
+                    else:
+                        item.error = res["error"]
+                        item.status = res["status"]
                 flightrec.note_stage("encode", time.perf_counter() - t2)
                 return resp
         except Exception as e:  # noqa: BLE001

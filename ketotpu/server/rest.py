@@ -23,14 +23,10 @@ Errors are herodot-shaped JSON: ``{"error": {"code", "status", "message"}}``.
 from __future__ import annotations
 
 import json
-import socket
-import time
-from contextlib import nullcontext
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional, Tuple
-from urllib.parse import parse_qs, urlencode, urlparse
+from urllib.parse import urlencode
 
-from ketotpu import consistency, deadline, flightrec
+from ketotpu import consistency, flightrec
 from ketotpu.cache import context as cache_context
 from ketotpu.api.types import (
     BadRequestError,
@@ -42,6 +38,9 @@ from ketotpu.api.types import (
 from ketotpu.observability import RELATIONTUPLES_CREATED
 
 _STATUS_TEXT = {
+    200: "OK",
+    201: "Created",
+    204: "No Content",
     400: "Bad Request",
     403: "Forbidden",
     404: "Not Found",
@@ -75,6 +74,8 @@ _RPC_OPS = {
     "/relation-tuples/check": "check",
     "/relation-tuples/check/openapi": "check",
     "/relation-tuples/check/batch": "check",
+    "/relation-tuples/batch/check": "check",
+    "/relation-tuples/batch/expand": "expand",
     "/relation-tuples/expand": "expand",
     "/relation-tuples/list-objects": "list_objects",
     "/relation-tuples/list-subjects": "list_subjects",
@@ -108,6 +109,17 @@ def _consistency_params(q: Dict[str, str]):
     raise BadRequestError(
         f"unable to parse 'latest' query parameter as bool: {raw!r}"
     )
+
+
+def _batch_consistency(body: dict, q: Dict[str, str]):
+    """(snaptoken, latest) for a batch request: ONE consistency mode for
+    the whole batch, from the JSON body (preferred) or query params."""
+    token, latest = _consistency_params(q)
+    if body.get("snaptoken"):
+        token = str(body["snaptoken"])
+    if body.get("latest") is not None:
+        latest = bool(body["latest"])
+    return token, latest
 
 
 class StreamingResponse:
@@ -371,6 +383,99 @@ def read_router(registry) -> Router:
         }
 
     rt.add("POST", "/relation-tuples/check/batch", post_check_batch)
+
+    def post_batch_check(req):
+        # batch front door (ISSUE 7): per-item verdicts/errors, one shared
+        # consistency mode + snaptoken, per-item admission accounting.
+        # Supersedes /relation-tuples/check/batch (kept for compat).
+        from ketotpu.server.handlers import batch_admission, record_batch
+
+        body = req.json()
+        if not isinstance(body, dict) or not isinstance(
+            body.get("tuples"), list
+        ):
+            raise BadRequestError('expected {"tuples": [...]}')
+        items = []
+        for d in body["tuples"]:
+            try:
+                # a bad tuple becomes ITS item's error, not the batch's
+                items.append(RelationTuple.from_json(d or {}))
+            except KetoAPIError as e:
+                items.append(e)
+        r = registry.resolve(req.headers)
+        token, latest = _batch_consistency(body, req.query)
+        depth = body.get("max_depth")
+        depth = int(depth) if depth is not None else _max_depth(req.query)
+        flightrec.note(batch=len(items))
+        record_batch(r, "check", len(items))
+        with batch_admission(r, len(items)):
+            decoded = None
+            if token or latest:
+                decoded = consistency.ensure_fresh(
+                    r, token, latest, op="check"
+                )
+            with cache_context.request_scope(r, req.headers, token=decoded,
+                                             latest=latest):
+                results = check.batch_check_items(items, depth, r)
+        return 200, {
+            "results": results,
+            "snaptoken": check.snaptoken(r),
+        }
+
+    rt.add("POST", "/relation-tuples/batch/check", post_batch_check)
+
+    def post_batch_expand(req):
+        from ketotpu.server.handlers import batch_admission, record_batch
+
+        body = req.json()
+        if not isinstance(body, dict) or not isinstance(
+            body.get("subjects"), list
+        ):
+            raise BadRequestError('expected {"subjects": [...]}')
+        items = []
+        for d in body["subjects"]:
+            if not isinstance(d, dict):
+                items.append(BadRequestError("subject must be an object"))
+                continue
+            items.append(SubjectSet(
+                namespace=str(d.get("namespace", "")),
+                object=str(d.get("object", "")),
+                relation=str(d.get("relation", "")),
+            ))
+        r = registry.resolve(req.headers)
+        token, latest = _batch_consistency(body, req.query)
+        depth = body.get("max_depth")
+        depth = int(depth) if depth is not None else _max_depth(req.query)
+        flightrec.note(batch=len(items))
+        record_batch(r, "expand", len(items))
+        with batch_admission(r, len(items)):
+            decoded = None
+            if token or latest:
+                decoded = consistency.ensure_fresh(
+                    r, token, latest, op="expand"
+                )
+            with cache_context.request_scope(r, req.headers, token=decoded,
+                                             latest=latest):
+                results = expand.batch_expand_items(items, depth, r)
+        enc = []
+        for res in results:
+            if "tree" in res:
+                if res["tree"] is None:
+                    enc.append({
+                        "error": "no relation tuple found", "status": 404,
+                    })
+                else:
+                    enc.append({"tree": res["tree"].to_json()})
+            else:
+                enc.append(res)
+        return 200, {
+            "results": enc,
+            "snaptoken": consistency.mint(
+                r.store(), r._device_engine()
+            ).encode(),
+        }
+
+    rt.add("POST", "/relation-tuples/batch/expand", post_batch_expand)
 
     def get_expand(req):
         subject = SubjectSet(
@@ -665,233 +770,16 @@ def metrics_router(registry) -> Router:
 
 
 def make_http_server(router: Router, host: str, port: int,
-                     reuse_port: bool = False) -> ThreadingHTTPServer:
-    registry = router.r
-    logger = registry.logger()
-    # per-request access log (negroni middleware parity, daemon.go:336);
-    # benches disable it via config to keep the hammer path clean
-    access_log = bool(registry.config.get("log.request_log", True))
+                     reuse_port: bool = False, ssl_ctx=None):
+    """Build the REST front end: an asyncio event-loop server (see
+    server/aio.py) behind the lifecycle surface the daemon drives
+    (``server_address`` / ``serve_forever`` / ``shutdown`` /
+    ``server_close``).  ``reuse_port`` binds SO_REUSEPORT for the
+    multi-process worker topology; ``ssl_ctx`` terminates TLS in the
+    event loop (per-connection handshakes never block the accept loop).
+    """
+    from ketotpu.server.aio import AsyncHTTPServer
 
-    class Handler(BaseHTTPRequestHandler):
-        protocol_version = "HTTP/1.1"
-        # per-connection read timeout (socketserver applies it in the
-        # handler thread): bounds a stalled client — including a deferred
-        # TLS handshake on the metrics port — to one worker thread for at
-        # most this long, never the accept loop
-        timeout = 30.0
-
-        def _serve(self, method: str):
-            t0 = time.perf_counter()
-            parsed = urlparse(self.path)
-            query = _flatten_query(parse_qs(parsed.query))
-            length = int(self.headers.get("Content-Length") or 0)
-            body = self.rfile.read(length) if length else b""
-            hdrs = {k.lower(): v for k, v in self.headers.items()}
-            t_parse = time.perf_counter()
-            op = _RPC_OPS.get(parsed.path)
-            rec = flightrec.rpc_recording(
-                registry, op, traceparent=hdrs.get("traceparent"),
-                detail=f"{method} {parsed.path}", t0=t0,
-            ) if op else nullcontext()
-            with rec:
-                flightrec.note_stage("parse", t_parse - t0)
-                ctl = (
-                    registry.admission()
-                    if parsed.path not in _ADMISSION_EXEMPT else None
-                )
-                if ctl is not None and not ctl.try_acquire():
-                    registry.metrics().counter(
-                        "keto_requests_shed_total", 1.0,
-                        help="requests refused by admission control",
-                        transport="rest",
-                    )
-                    registry.metrics().observe(
-                        flightrec.STAGE_METRIC, 0.0,
-                        help="per-RPC stage wall time decomposition",
-                        op=op or "http", stage="shed",
-                    )
-                    status, payload, extra = (
-                        429,
-                        _error_body(
-                            429,
-                            f"in-flight limit reached ({ctl.limit}); "
-                            "retry later",
-                        ),
-                        {"Retry-After": "1"},
-                    )
-                else:
-                    try:
-                        try:
-                            # per-request budget: the X-Request-Timeout
-                            # header bounds every blocking hop downstream
-                            budget = deadline.parse_timeout(
-                                hdrs.get("x-request-timeout")
-                            )
-                        except KetoAPIError as e:
-                            code = e.status_code or 500
-                            status, payload, extra = (
-                                code, _error_body(code, str(e)), {}
-                            )
-                        else:
-                            with deadline.scope(budget):
-                                status, payload, extra = router.dispatch(
-                                    method, parsed.path,
-                                    Request(query, body, hdrs),
-                                )
-                    finally:
-                        if ctl is not None:
-                            ctl.release()
-                flightrec.note_stage(
-                    "compute", time.perf_counter() - t_parse
-                )
-                if (op == "check" and isinstance(payload, dict)
-                        and "allowed" in payload):
-                    flightrec.note(verdict=payload["allowed"])
-                t_enc = time.perf_counter()
-                if isinstance(payload, StreamingResponse):
-                    # SSE escape hatch: no Content-Length, one chunk per
-                    # event, connection closed when the stream ends.  A
-                    # client that disappears (or stalls past the socket
-                    # timeout) just ends the stream — the generator's
-                    # finally block unsubscribes from the hub.
-                    self.close_connection = True
-                    self.send_response(status)
-                    self.send_header("Content-Type", payload.content_type)
-                    self.send_header("Cache-Control", "no-store")
-                    for k, v in extra.items():
-                        self.send_header(k, v)
-                    if router.cors:
-                        for k, v in (cors_headers(
-                            router.cors, hdrs.get("origin")
-                        ) or {}).items():
-                            self.send_header(k, v)
-                    self.end_headers()
-                    try:
-                        for chunk in payload.iterator:
-                            self.wfile.write(chunk)
-                            self.wfile.flush()
-                    except (BrokenPipeError, ConnectionResetError,
-                            OSError):
-                        pass
-                    finally:
-                        close = getattr(payload.iterator, "close", None)
-                        if close is not None:
-                            close()
-                    flightrec.note_stage(
-                        "encode", time.perf_counter() - t_enc
-                    )
-                    dt = time.perf_counter() - t0
-                    registry.metrics().observe(
-                        "keto_http_request_duration_seconds", dt,
-                        help="REST request latency",
-                        endpoint=router.endpoint, method=method,
-                        status=str(status),
-                    )
-                    if access_log:
-                        logger.info(
-                            "http_stream", extra={"fields": {
-                                "method": method,
-                                "path": parsed.path,
-                                "status": status,
-                                "duration_ms": round(dt * 1e3, 3),
-                                "peer": "%s:%s" % self.client_address[:2],
-                                "endpoint": router.endpoint,
-                            }},
-                        )
-                    return
-                if payload is None:
-                    data = b""
-                    ctype = "application/json"
-                elif isinstance(payload, tuple):
-                    ctype, text = payload
-                    data = text.encode("utf-8")
-                else:
-                    ctype = "application/json"
-                    data = json.dumps(payload).encode("utf-8")
-                self.send_response(status)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(data)))
-                for k, v in extra.items():
-                    self.send_header(k, v)
-                if router.cors:
-                    for k, v in (cors_headers(
-                        router.cors, hdrs.get("origin")
-                    ) or {}).items():
-                        self.send_header(k, v)
-                self.end_headers()
-                if data:
-                    self.wfile.write(data)
-                flightrec.note_stage(
-                    "encode", time.perf_counter() - t_enc
-                )
-            dt = time.perf_counter() - t0
-            registry.metrics().observe(
-                "keto_http_request_duration_seconds", dt,
-                help="REST request latency",
-                endpoint=router.endpoint, method=method,
-                status=str(status),
-            )
-            if parsed.path not in ("/health/alive", "/health/ready"):
-                if access_log:
-                    logger.info(
-                        "http_request", extra={"fields": {
-                            "method": method,
-                            "path": parsed.path,
-                            "status": status,
-                            "duration_ms": round(dt * 1e3, 3),
-                            "peer": "%s:%s" % self.client_address[:2],
-                            "endpoint": router.endpoint,
-                        }},
-                    )
-                else:
-                    logger.debug(
-                        "%s %s -> %d (%.1fms)",
-                        method, parsed.path, status, dt * 1e3,
-                    )
-
-        def do_OPTIONS(self):
-            # CORS preflight (rs/cors handles OPTIONS before routing)
-            origin = self.headers.get("Origin")
-            want = self.headers.get("Access-Control-Request-Method")
-            hs = cors_headers(
-                router.cors, origin, request_method=want, preflight=True
-            ) if router.cors else None
-            self.send_response(204 if hs else 405)
-            for k, v in (hs or {}).items():
-                self.send_header(k, v)
-            self.send_header("Content-Length", "0")
-            self.end_headers()
-
-        def do_GET(self):
-            self._serve("GET")
-
-        def do_POST(self):
-            self._serve("POST")
-
-        def do_PUT(self):
-            self._serve("PUT")
-
-        def do_DELETE(self):
-            self._serve("DELETE")
-
-        def do_PATCH(self):
-            self._serve("PATCH")
-
-        def log_message(self, fmt, *args):  # route through the logger
-            pass
-
-    if reuse_port:
-        # SO_REUSEPORT worker mode: bind the same public port from every
-        # worker process and let the kernel balance accepts
-        class _ReusePortServer(ThreadingHTTPServer):
-            def server_bind(self):
-                self.socket.setsockopt(
-                    socket.SOL_SOCKET, socket.SO_REUSEPORT, 1
-                )
-                super().server_bind()
-
-        server = _ReusePortServer((host, port), Handler)
-    else:
-        server = ThreadingHTTPServer((host, port), Handler)
-    server.daemon_threads = True
-    return server
+    return AsyncHTTPServer(
+        router, host, port, reuse_port=reuse_port, ssl_ctx=ssl_ctx,
+    )

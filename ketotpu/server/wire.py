@@ -1,0 +1,204 @@
+"""Length-prefixed binary framing for the worker↔owner engine wire.
+
+Replaces newline-delimited JSON: each frame is
+
+    !II header (meta_len, bin_len) | meta JSON | packed binary payload
+
+where the payload is the concatenation of zero or more numpy arrays
+described by the meta's ``_arrays`` manifest (``[name, dtype, shape,
+nbytes]`` per entry, in payload order).  A 4096-item check batch rides
+as ONE frame carrying an ``int32 (n, 4)`` id matrix instead of 4096
+JSON strings — one owner round-trip per worker batch.
+
+Payloads at or above a size threshold can ride a **shared-memory ring**
+instead of the socket: the sender parks the bytes in a
+``multiprocessing.shared_memory`` segment it owns (grown as needed,
+reused across calls) and the frame's meta carries ``_shm`` =
+``{"name", "nbytes"}`` with ``bin_len == 0`` on the wire.  The receiver
+attaches the segment once and copies the bytes out.  Strict
+request/response framing makes the single segment safe: the sender
+never writes the next payload before it has read the response to the
+previous one.  The socket remains the control channel either way, so a
+lost peer degrades to ordinary connection errors.
+
+The unix socket is a trusted same-host channel; frames carry JSON +
+raw little-endian arrays, never pickle.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+HEADER = struct.Struct("!II")
+
+#: refuse absurd frames outright — a desynced stream otherwise turns a
+#: garbage length prefix into a multi-gigabyte allocation
+MAX_META = 64 * 1024 * 1024
+MAX_BIN = 1024 * 1024 * 1024
+
+
+class WireError(ValueError):
+    """Framing violation: the stream is desynced or the peer is not
+    speaking this protocol.  Callers treat it like a transport error
+    (discard the connection)."""
+
+
+def pack_arrays(arrays: Optional[Dict[str, np.ndarray]]):
+    """(manifest, payload bytes) for the meta's ``_arrays`` key."""
+    if not arrays:
+        return None, b""
+    manifest = []
+    chunks = []
+    for name, arr in arrays.items():
+        a = np.ascontiguousarray(arr)
+        raw = a.tobytes()
+        manifest.append([name, str(a.dtype), list(a.shape), len(raw)])
+        chunks.append(raw)
+    return manifest, b"".join(chunks)
+
+
+def unpack_arrays(manifest, payload: bytes) -> Dict[str, np.ndarray]:
+    arrays: Dict[str, np.ndarray] = {}
+    off = 0
+    for name, dtype, shape, nbytes in manifest:
+        if off + nbytes > len(payload):
+            raise WireError("array manifest overruns the frame payload")
+        arrays[name] = np.frombuffer(
+            payload, dtype=np.dtype(dtype), count=-1 if not shape else int(
+                np.prod(shape, dtype=np.int64)
+            ), offset=off,
+        ).reshape(shape)
+        off += nbytes
+    return arrays
+
+
+class ShmRing:
+    """Sender-owned shared-memory segment for large frame payloads,
+    reused (and grown) across calls; unlinked on close."""
+
+    def __init__(self):
+        self._seg = None
+
+    def place(self, payload: bytes) -> dict:
+        from multiprocessing import shared_memory
+
+        n = len(payload)
+        if self._seg is None or self._seg.size < n:
+            if self._seg is not None:
+                self._close_seg(unlink=True)
+            # grow in powers of two: reuse beats precise sizing
+            size = 1 << max(12, (n - 1).bit_length())
+            self._seg = shared_memory.SharedMemory(create=True, size=size)
+        self._seg.buf[:n] = payload
+        return {"name": self._seg.name, "nbytes": n}
+
+    def _close_seg(self, unlink: bool) -> None:
+        seg, self._seg = self._seg, None
+        if seg is None:
+            return
+        try:
+            seg.close()
+            if unlink:
+                seg.unlink()
+        except (OSError, FileNotFoundError):
+            pass
+
+    def close(self) -> None:
+        self._close_seg(unlink=True)
+
+
+class ShmCache:
+    """Receiver-side attachment cache: one attach per segment name."""
+
+    def __init__(self):
+        self._segs: dict = {}
+
+    def read(self, desc: dict) -> bytes:
+        from multiprocessing import shared_memory
+
+        name, n = desc["name"], int(desc["nbytes"])
+        seg = self._segs.get(name)
+        if seg is None:
+            seg = shared_memory.SharedMemory(name=name)
+            # the SENDER owns the segment's lifetime; keep this process's
+            # resource tracker from unlinking it on exit (3.12 tracks
+            # attachments too — the known premature-unlink footgun)
+            try:
+                from multiprocessing import resource_tracker
+
+                resource_tracker.unregister(seg._name, "shared_memory")
+            except Exception:  # noqa: BLE001 - tracker quirks vary
+                pass
+            self._segs[name] = seg
+        if n > seg.size:
+            raise WireError("shm descriptor exceeds segment size")
+        return bytes(seg.buf[:n])
+
+    def close(self) -> None:
+        segs, self._segs = self._segs, {}
+        for seg in segs.values():
+            try:
+                seg.close()
+            except (OSError, BufferError):
+                pass
+
+
+def send_frame(
+    sock, meta: dict,
+    arrays: Optional[Dict[str, np.ndarray]] = None, *,
+    ring: Optional[ShmRing] = None, shm_threshold: int = 0,
+) -> int:
+    """Write one frame; returns bytes that crossed the SOCKET (shm
+    payload bytes intentionally excluded — that is the point)."""
+    manifest, payload = pack_arrays(arrays)
+    if manifest is not None:
+        meta = dict(meta, _arrays=manifest)
+    if (ring is not None and shm_threshold > 0
+            and len(payload) >= shm_threshold):
+        meta = dict(meta, _shm=ring.place(payload))
+        payload = b""
+    raw_meta = json.dumps(meta).encode("utf-8")
+    frame = HEADER.pack(len(raw_meta), len(payload)) + raw_meta + payload
+    sock.sendall(frame)
+    return len(frame)
+
+
+def recv_frame(
+    rfile, *, shm_cache: Optional[ShmCache] = None,
+) -> Optional[Tuple[dict, Dict[str, np.ndarray], int]]:
+    """Read one frame from a buffered file object; None on clean EOF.
+    Returns (meta, arrays, socket_bytes_read)."""
+    head = rfile.read(HEADER.size)
+    if not head:
+        return None
+    if len(head) < HEADER.size:
+        raise WireError("truncated frame header")
+    meta_len, bin_len = HEADER.unpack(head)
+    if meta_len > MAX_META or bin_len > MAX_BIN:
+        raise WireError(
+            f"frame sizes out of range (meta={meta_len}, bin={bin_len})"
+        )
+    raw_meta = rfile.read(meta_len)
+    if len(raw_meta) < meta_len:
+        raise WireError("truncated frame meta")
+    try:
+        meta = json.loads(raw_meta)
+    except ValueError as e:
+        raise WireError(f"frame meta is not JSON: {e}") from None
+    payload = b""
+    if bin_len:
+        payload = rfile.read(bin_len)
+        if len(payload) < bin_len:
+            raise WireError("truncated frame payload")
+    shm_desc = meta.pop("_shm", None)
+    if shm_desc is not None:
+        if shm_cache is None:
+            raise WireError("unexpected shm frame on this channel")
+        payload = shm_cache.read(shm_desc)
+    manifest = meta.pop("_arrays", None)
+    arrays = unpack_arrays(manifest, payload) if manifest else {}
+    return meta, arrays, HEADER.size + meta_len + bin_len

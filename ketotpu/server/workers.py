@@ -20,15 +20,22 @@ writes land in the store from any worker and reach the device through
 the owner's ordinary change-log drain.  A ``memory`` DSN cannot be
 shared across processes and is refused.
 
-Wire protocol: newline-delimited JSON over the unix socket — tuples in
-their canonical string form (`RelationTuple.from_string` round-trips),
-typed errors re-raised client-side by status code.  The socket is a
-trusted same-host channel (mode 0700 directory recommended); no pickle.
+Wire protocol (server/wire.py): length-prefixed binary frames — a JSON
+meta section plus packed numpy arrays, with an optional shared-memory
+hop for large payloads.  A worker pre-encodes tuples it has seen before
+as ``int32 (n, 4)`` id rows against a MIRROR of the owner's vocabulary
+(learned from responses, invalidated by a vocab epoch counter when the
+owner's engine swaps vocabularies on snapshot resume); unseen tuples
+ride as canonical strings and come back with their id rows so the next
+batch sends ids.  One owner round-trip per worker batch, whatever the
+batch size.  Typed errors re-raise client-side by status code.  The
+socket is a trusted same-host channel (mode 0700 directory
+recommended); no pickle.
 """
 
 from __future__ import annotations
 
-import json
+import itertools
 import os
 import random
 import socket
@@ -36,12 +43,15 @@ import socketserver
 import subprocess
 import threading
 import time
-from typing import Callable, List, Optional, Sequence
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
 
 from ketotpu import deadline, faults, flightrec
 from ketotpu.cache import SingleFlight
 from ketotpu.cache import check_key as cache_check_key
 from ketotpu.cache import context as cache_context
+from ketotpu.server import wire
 from ketotpu.api.types import (
     DeadlineExceededError,
     KetoAPIError,
@@ -51,6 +61,10 @@ from ketotpu.api.types import (
     SubjectSet,
     Tree,
 )
+
+#: a worker's vocab mirror is bounded; on overflow it simply resets and
+#: relearns (the owner remains the source of truth either way)
+_MIRROR_CAP = 262144
 
 
 def _encode_subject(s: Subject) -> str:
@@ -63,6 +77,36 @@ def _decode_subject(u: str) -> Subject:
     return SubjectID(u[3:] if u.startswith("id:") else u)
 
 
+class _Reverse:
+    """Incremental id -> string view over an append-only Interner.
+
+    ``Interner.strings()`` copies the whole table; at 10M subjects that
+    is milliseconds per call.  Insertion order is id order, so the view
+    only ever EXTENDS from the interner's dict."""
+
+    def __init__(self, interner):
+        self._interner = interner
+        self._rev: List[str] = []
+
+    def get(self, i: int) -> Optional[str]:
+        if i < 0:
+            return None
+        if i >= len(self._rev):
+            ids = self._interner._ids
+            if len(ids) > len(self._rev):
+                try:
+                    self._rev.extend(
+                        itertools.islice(ids.keys(), len(self._rev), None)
+                    )
+                except RuntimeError:
+                    # the engine thread interned mid-iteration; fall back
+                    # to a consistent full copy
+                    self._rev = self._interner.strings()
+        if i >= len(self._rev):
+            return None
+        return self._rev[i]
+
+
 class EngineHostServer:
     """The device owner's unix-socket engine service."""
 
@@ -71,6 +115,15 @@ class EngineHostServer:
         self.registry = registry
         self.path = path
         self.health_fn = health_fn
+        self._shm_threshold = int(
+            registry.config.get("engine.wire_shm_threshold", 262144)
+        )
+        # vocab epoch: bumped whenever the device engine swaps vocabulary
+        # objects (snapshot resume, store-vocab adoption) so worker id
+        # mirrors learned against the old id space get invalidated
+        self._vocab_obj = None
+        self._vepoch = 0
+        self._rev: Optional[dict] = None
         if os.path.exists(path):
             os.unlink(path)
 
@@ -78,18 +131,42 @@ class EngineHostServer:
 
         class Handler(socketserver.StreamRequestHandler):
             def handle(self):
-                for line in self.rfile:
-                    try:
-                        faults.inject("owner_handler")
-                        req = json.loads(line)
-                        resp = host._serve_one(req)
-                    except Exception as e:  # noqa: BLE001
-                        resp = {"error": {
-                            "msg": str(e),
-                            "status": getattr(e, "status_code", 500),
-                        }}
-                    self.wfile.write(json.dumps(resp).encode() + b"\n")
-                    self.wfile.flush()
+                ring = wire.ShmRing()
+                shm_cache = wire.ShmCache()
+                try:
+                    while True:
+                        try:
+                            got = wire.recv_frame(
+                                self.rfile, shm_cache=shm_cache
+                            )
+                        except wire.WireError:
+                            break  # desynced peer: drop the connection
+                        if got is None:
+                            break
+                        meta, arrays, nread = got
+                        host._wire_count("rx", nread)
+                        try:
+                            faults.inject("owner_handler")
+                            resp, resp_arrays = host._serve_frame(
+                                meta, arrays
+                            )
+                        except Exception as e:  # noqa: BLE001
+                            resp, resp_arrays = {"error": {
+                                "msg": str(e),
+                                "status": getattr(e, "status_code", 500),
+                            }}, None
+                        try:
+                            sent = wire.send_frame(
+                                self.connection, resp, resp_arrays,
+                                ring=ring,
+                                shm_threshold=host._shm_threshold,
+                            )
+                        except OSError:
+                            break
+                        host._wire_count("tx", sent)
+                finally:
+                    ring.close()
+                    shm_cache.close()
 
         class Srv(socketserver.ThreadingUnixStreamServer):
             daemon_threads = True
@@ -121,36 +198,133 @@ class EngineHostServer:
         fresh = EngineHostServer(self.registry, self.path, self.health_fn)
         return fresh.start()
 
-    def _serve_one(self, req):
-        op = req.get("op")
+    def _wire_count(self, direction: str, nbytes: int) -> None:
+        self.registry.metrics().counter(
+            "keto_wire_bytes_total", float(nbytes),
+            help="engine-wire socket bytes by direction", dir=direction,
+        )
+
+    def _vocab_state(self):
+        """(vocab, epoch) of the owner's device engine, tracking object
+        identity: a swapped vocab (checkpoint resume) bumps the epoch."""
+        try:
+            eng = self.registry._device_engine()
+        except Exception:  # noqa: BLE001 - oracle/remote registries
+            eng = None
+        vocab = getattr(eng, "_vocab", None)
+        if vocab is None:
+            return None, 0
+        if vocab is not self._vocab_obj:
+            self._vocab_obj = vocab
+            self._vepoch += 1
+            self._rev = {
+                "ns": _Reverse(vocab.namespaces),
+                "obj": _Reverse(vocab.objects),
+                "rel": _Reverse(vocab.relations),
+                "subj": _Reverse(vocab.subjects),
+            }
+        return vocab, self._vepoch
+
+    def _serve_frame(self, meta, arrays) -> Tuple[dict, Optional[dict]]:
+        op = meta.get("op")
         # workers forward their RPC's traceparent so the owner-side spans
         # (coalescer wave, device dispatch) stitch into the same trace
-        tp = req.pop("traceparent", None)
+        tp = meta.pop("traceparent", None)
         # workers forward the remaining budget; bind it so the coalescer
         # slot wait and oracle-fallback loop on the owner side stay inside
-        # what the worker's client granted
-        ms = req.pop("deadline_ms", None)
+        # what the worker's client granted.  ONE budget covers the whole
+        # batch — items never re-arm their own timers.
+        ms = meta.pop("deadline_ms", None)
         # a worker serving X-Keto-Cache: bypass forwards the flag so the
         # owner-side probe/insert (engine pre-dispatch, coalescer) see the
         # bypass too — the escape hatch must hold across the process hop
-        bypass = bool(req.pop("cache_bypass", False))
+        bypass = bool(meta.pop("cache_bypass", False))
         with deadline.scope(None if ms is None else ms / 1000.0):
             if bypass:
                 with cache_context.scope(bypass=True):
-                    return self._serve_op(req, op, tp)
-            return self._serve_op(req, op, tp)
+                    return self._serve_op(meta, arrays, op, tp)
+            return self._serve_op(meta, arrays, op, tp)
 
-    def _serve_op(self, req, op, tp):
+    def _decode_batch(self, meta, arrays):
+        """Rebuild the worker's tuple batch from id rows + strings.
+        Returns (tuples, vepoch, stale) — stale means the worker sent id
+        rows minted against a different vocab epoch and must resend."""
+        n = int(meta.get("n", 0))
+        pos_ids = meta.get("pos_ids") or []
+        pos_str = meta.get("pos_str") or []
+        strs = meta.get("tuples") or []
+        if not pos_ids and not pos_str and strs:
+            # plain all-strings batch with no position map
+            pos_str = list(range(len(strs)))
+            n = n or len(strs)
+        vocab, vepoch = self._vocab_state()
+        ids = arrays.get("ids") if arrays else None
+        if pos_ids:
+            if vocab is None or int(meta.get("vepoch", 0)) != vepoch:
+                return None, vepoch, True
+            if ids is None or ids.shape != (len(pos_ids), 4):
+                raise ValueError("id rows missing or misshapen")
+        tuples: List[Optional[RelationTuple]] = [None] * n
+        if pos_ids:
+            rev = self._rev
+            for row, pos in zip(np.asarray(ids, dtype=np.int64), pos_ids):
+                ns = rev["ns"].get(int(row[0]))
+                obj = rev["obj"].get(int(row[1]))
+                rel = rev["rel"].get(int(row[2]))
+                subj = rev["subj"].get(int(row[3]))
+                if ns is None or obj is None or rel is None or subj is None:
+                    raise ValueError("id row outside the owner vocabulary")
+                tuples[int(pos)] = RelationTuple(
+                    ns, obj, rel, _decode_subject(subj)
+                )
+        for s, pos in zip(strs, pos_str):
+            tuples[int(pos)] = RelationTuple.from_string(s)
+        if any(t is None for t in tuples):
+            raise ValueError("batch positions do not cover the batch")
+        return tuples, vepoch, False
+
+    def _learn_rows(self, meta, vepoch):
+        """Id rows for the string-sent tuples so the worker can mirror
+        them: only fully-known rows (no -1 anywhere) are learnable."""
+        vocab = self._vocab_obj if vepoch else None
+        pos_str = meta.get("pos_str") or []
+        strs = meta.get("tuples") or []
+        if vocab is None or not strs:
+            return [], np.zeros((0, 4), dtype=np.int32)
+        if not pos_str:
+            pos_str = list(range(len(strs)))
+        learn_pos, rows = [], []
+        for s, pos in zip(strs, pos_str):
+            try:
+                t = RelationTuple.from_string(s)
+            except Exception:  # noqa: BLE001 - unparseable never mirrors
+                continue
+            row = (
+                vocab.namespaces.lookup(t.namespace),
+                vocab.objects.lookup(t.object),
+                vocab.relations.lookup(t.relation),
+                vocab.subjects.lookup(t.subject.unique_id()),
+            )
+            if min(row) >= 0:
+                learn_pos.append(int(pos))
+                rows.append(row)
+        return learn_pos, np.asarray(rows, dtype=np.int32).reshape(-1, 4)
+
+    def _serve_op(self, meta, arrays, op, tp):
         r = self.registry
         if op == "check":
             with flightrec.rpc_recording(
                 r, "check", traceparent=tp, detail="worker->owner check"
             ):
                 t0 = time.perf_counter()
-                tuples = [RelationTuple.from_string(s) for s in req["tuples"]]
+                tuples, vepoch, stale = self._decode_batch(meta, arrays)
+                if stale:
+                    # the worker's id mirror predates the current vocab:
+                    # one extra round trip (strings) re-learns it
+                    return {"stale_vocab": vepoch}, None
                 flightrec.note_stage("parse", time.perf_counter() - t0)
                 eng = r.check_engine()
-                depth = int(req.get("depth", 0))
+                depth = int(meta.get("depth", 0))
                 # cursor piggyback for the workers' local caches: the store
                 # head read BEFORE the compute is a lower bound on the state
                 # every verdict in this response is computed from — the
@@ -163,53 +337,67 @@ class EngineHostServer:
                     # single-check RPCs from the workers MUST go through
                     # check_is_member: that is the coalescer's enqueue point,
                     # so concurrent singles from every worker merge into one
-                    # shared device wave.  batch_check passes straight
-                    # through the coalescer (it is already batched) — routing
-                    # singles there made each RPC its own device dispatch.
+                    # shared device wave.
                     ok = [bool(eng.check_is_member(tuples[0], depth))]
                     flightrec.note(verdict=ok[0])
-                    return {"ok": ok, "cursor": int(cur)}
-                batch = getattr(eng, "batch_check", None)
-                if batch is not None:
-                    ok = batch(tuples, depth)
-                else:  # oracle engine: sequential surface only
-                    ok = [eng.check_is_member(t, depth) for t in tuples]
-                return {"ok": [bool(v) for v in ok], "cursor": int(cur)}
+                else:
+                    batch = getattr(eng, "batch_check", None)
+                    if batch is not None:
+                        ok = [bool(v) for v in batch(tuples, depth)]
+                    else:  # oracle engine: sequential surface only
+                        ok = [
+                            bool(eng.check_is_member(t, depth))
+                            for t in tuples
+                        ]
+                learn_pos, learn_ids = self._learn_rows(meta, vepoch)
+                resp = {
+                    "cursor": int(cur),
+                    "vepoch": vepoch,
+                    "learn_pos": learn_pos,
+                }
+                out = {"ok": np.asarray(ok, dtype=np.uint8)}
+                if len(learn_pos):
+                    out["learn_ids"] = learn_ids
+                return resp, out
         if op == "expand":
             with flightrec.rpc_recording(
                 r, "expand", traceparent=tp, detail="worker->owner expand"
             ):
-                subject = _decode_subject(req["subject"])
+                subject = _decode_subject(meta["subject"])
                 tree = r.expand_engine().build_tree(
-                    subject, int(req.get("depth", 0))
+                    subject, int(meta.get("depth", 0))
                 )
-                return {"tree": tree.to_json() if tree is not None else None}
+                return {
+                    "tree": tree.to_json() if tree is not None else None
+                }, None
         if op == "list_objects":
             with flightrec.rpc_recording(
                 r, "list_objects", traceparent=tp,
                 detail="worker->owner list_objects",
             ):
                 objs, next_token = r.list_engine().list_objects(
-                    req["namespace"], req["relation"],
-                    _decode_subject(req["subject"]),
-                    page_size=int(req.get("page_size", 0)),
-                    page_token=req.get("page_token", ""),
+                    meta["namespace"], meta["relation"],
+                    _decode_subject(meta["subject"]),
+                    page_size=int(meta.get("page_size", 0)),
+                    page_token=meta.get("page_token", ""),
                 )
-                return {"objects": list(objs), "next_page_token": next_token}
+                return {
+                    "objects": list(objs), "next_page_token": next_token,
+                }, None
         if op == "list_subjects":
             with flightrec.rpc_recording(
                 r, "list_subjects", traceparent=tp,
                 detail="worker->owner list_subjects",
             ):
                 subs, next_token = r.list_engine().list_subjects(
-                    req["namespace"], req["object"], req["relation"],
-                    page_size=int(req.get("page_size", 0)),
-                    page_token=req.get("page_token", ""),
+                    meta["namespace"], meta["object"], meta["relation"],
+                    page_size=int(meta.get("page_size", 0)),
+                    page_token=meta.get("page_token", ""),
                 )
                 return {
                     "subjects": [_encode_subject(s) for s in subs],
                     "next_page_token": next_token,
-                }
+                }, None
         if op == "barrier":
             # freshness barrier forwarded from a worker: the worker can
             # see the shared store but not the device engine, so the
@@ -224,20 +412,20 @@ class EngineHostServer:
                 t0 = time.perf_counter()
                 consistency.ensure_fresh(
                     r,
-                    req.get("snaptoken") or None,
-                    bool(req.get("latest")),
-                    op=str(req.get("rpc") or "check"),
+                    meta.get("snaptoken") or None,
+                    bool(meta.get("latest")),
+                    op=str(meta.get("rpc") or "check"),
                 )
                 flightrec.note_stage("barrier", time.perf_counter() - t0)
-                return {"ok": True}
+                return {"ok": True}, None
         if op == "ping":
-            return {"pong": True}
+            return {"pong": True}, None
         if op == "health":
             # owner-side readiness for the workers' health surface: the
             # worker cannot see the device engine directly, so degraded
             # state (CPU fallback, respawning workers) flows over the wire
             fn = self.health_fn
-            return {"health": dict(fn()) if fn is not None else {}}
+            return {"health": dict(fn()) if fn is not None else {}}, None
         raise ValueError(f"unknown op {op!r}")
 
     def stop(self) -> None:
@@ -250,12 +438,16 @@ class EngineHostServer:
 
 
 class _Conn:
-    def __init__(self, path: str):
+    def __init__(self, path: str, *, metrics=None, shm_threshold: int = 0):
         self.sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         self.sock.connect(path)
         self.rfile = self.sock.makefile("rb")
         self.lock = threading.Lock()
         self.broken = False
+        self._metrics = metrics
+        self._shm_threshold = int(shm_threshold)
+        self._ring = wire.ShmRing()
+        self._shm_cache = wire.ShmCache()
 
     def close(self) -> None:
         self.broken = True
@@ -263,11 +455,22 @@ class _Conn:
             self.sock.close()
         except OSError:
             pass
+        self._ring.close()
+        self._shm_cache.close()
 
-    def call(self, req, timeout: Optional[float] = None) -> dict:
-        """One request/response on this connection.
+    def _count(self, direction: str, nbytes: int) -> None:
+        if self._metrics is not None:
+            self._metrics.counter(
+                "keto_wire_bytes_total", float(nbytes),
+                help="engine-wire socket bytes by direction",
+                dir=direction,
+            )
 
-        Any transport error — timeout, EOF, decode failure — marks the
+    def call(self, meta, arrays=None,
+             timeout: Optional[float] = None) -> Tuple[dict, dict]:
+        """One framed request/response on this connection.
+
+        Any transport error — timeout, EOF, framing failure — marks the
         connection broken and closes it: the wire is strictly one
         response per request, so after a partial exchange the NEXT call
         on this socket would read THIS request's late response (the
@@ -279,19 +482,24 @@ class _Conn:
         try:
             with self.lock:
                 self.sock.settimeout(timeout)
-                self.sock.sendall(json.dumps(req).encode() + b"\n")
-                line = self.rfile.readline()
-            if not line:
+                sent = wire.send_frame(
+                    self.sock, meta, arrays,
+                    ring=self._ring, shm_threshold=self._shm_threshold,
+                )
+                got = wire.recv_frame(self.rfile, shm_cache=self._shm_cache)
+            if got is None:
                 raise ConnectionError("engine host closed the connection")
-            resp = json.loads(line)
+            resp, resp_arrays, nread = got
         except Exception:
             self.close()
             raise
+        self._count("tx", sent)
+        self._count("rx", nread)
         if "error" in resp:
             err = KetoAPIError(resp["error"]["msg"])
             err.status_code = resp["error"].get("status", 500)
             raise err
-        return resp
+        return resp, resp_arrays
 
 
 class RemoteCheckEngine:
@@ -302,9 +510,18 @@ class RemoteCheckEngine:
     concurrency maps 1:1 onto owner-side handler threads — which is
     exactly what feeds the owner's coalescer bigger waves.
 
+    Tuples the worker has mirrored ids for ride the wire as packed int32
+    rows; the rest go as strings and their ids come back in the response
+    (``learn_pos``/``learn_ids``), so steady-state batches are nearly
+    all binary.  The owner's vocab EPOCH rides every response; a bump
+    (engine vocab swap) resets the mirror, and a ``stale_vocab`` reply
+    makes the worker resend that batch as strings.
+
     Connection errors retry on a fresh connection with capped exponential
     backoff + jitter (the owner may be mid-respawn); a TIMEOUT does not
-    retry — the budget is spent and the caller gets DEADLINE_EXCEEDED."""
+    retry — the budget is spent and the caller gets DEADLINE_EXCEEDED.
+    A batch shares ONE deadline budget across all its items: the budget
+    is read once per owner RPC, never re-armed per item."""
 
     #: reconnect schedule: base*2^n jittered, capped — tuned so a worker
     #: rides out an owner respawn without stampeding the fresh socket
@@ -313,7 +530,7 @@ class RemoteCheckEngine:
     backoff_cap = 0.25
 
     def __init__(self, path: str, *, rpc_timeout: float = 30.0,
-                 cache=None, metrics=None):
+                 cache=None, metrics=None, shm_threshold: int = 262144):
         self.path = path
         # budget for calls with no request deadline: a wedged owner must
         # surface as an error, not hang every worker thread (<=0 disables)
@@ -325,14 +542,23 @@ class RemoteCheckEngine:
         # also advances the local staleness fence (the owner broadcasting
         # its drain position to every worker that talks to it).
         self.cache = cache
+        self.metrics = metrics
+        self.shm_threshold = int(shm_threshold)
         self._flight = SingleFlight(metrics=metrics)
         self.reconnects = 0  # observability: retried transport failures
         self._local = threading.local()
+        # vocab mirror shared by every serving thread in this process
+        self._mirror_lock = threading.Lock()
+        self._mirror_epoch = 0
+        self._mirror: dict = {}
 
     def _conn(self) -> _Conn:
         c = getattr(self._local, "conn", None)
         if c is None or c.broken:
-            c = self._local.conn = _Conn(self.path)
+            c = self._local.conn = _Conn(
+                self.path, metrics=self.metrics,
+                shm_threshold=self.shm_threshold,
+            )
         return c
 
     def _discard(self) -> None:
@@ -341,10 +567,10 @@ class RemoteCheckEngine:
             c.close()
         self._local.conn = None
 
-    def _call(self, req) -> dict:
+    def _call(self, meta, arrays=None) -> Tuple[dict, dict]:
         tp = flightrec.current_traceparent()
         if tp:
-            req = dict(req, traceparent=tp)
+            meta = dict(meta, traceparent=tp)
         budget = deadline.remaining()
         if budget is not None:
             if budget <= 0:
@@ -352,10 +578,15 @@ class RemoteCheckEngine:
                     "deadline exceeded before owner RPC"
                 )
             # forward the remaining budget so the owner bounds ITS waits
-            req = dict(req, deadline_ms=deadline.deadline_ms())
+            meta = dict(meta, deadline_ms=deadline.deadline_ms())
         timeout = budget
         if timeout is None and self.rpc_timeout > 0:
             timeout = self.rpc_timeout
+        if self.metrics is not None:
+            self.metrics.counter(
+                "keto_wire_calls_total", 1.0,
+                help="owner RPC round trips", op=str(meta.get("op")),
+            )
         t0 = time.perf_counter()
         try:
             last: Optional[BaseException] = None
@@ -364,7 +595,7 @@ class RemoteCheckEngine:
                     if faults.should("socket_drop"):
                         self._discard()
                         raise ConnectionError("injected owner-socket drop")
-                    return self._conn().call(req, timeout=timeout)
+                    return self._conn().call(meta, arrays, timeout=timeout)
                 except KetoAPIError:
                     raise
                 except TimeoutError:
@@ -375,7 +606,7 @@ class RemoteCheckEngine:
                         f"owner RPC exceeded {timeout:.3f}s"
                     ) from None
                 except (ConnectionError, OSError, ValueError) as e:
-                    # ValueError covers a JSON decode failure: the stream
+                    # ValueError covers a framing failure: the stream
                     # desynced, the connection is already discarded
                     last = e
                     self._discard()
@@ -400,6 +631,109 @@ class RemoteCheckEngine:
         finally:
             flightrec.note_stage("worker_rpc", time.perf_counter() - t0)
 
+    # -- vocab mirror --------------------------------------------------------
+
+    def _mirror_encode(self, strs: List[str]):
+        """Split a batch into mirrored id rows and string leftovers."""
+        with self._mirror_lock:
+            epoch = self._mirror_epoch
+            if not epoch:
+                return 0, [], None, list(range(len(strs))), strs
+            pos_ids, rows, pos_str, leftovers = [], [], [], []
+            for j, s in enumerate(strs):
+                row = self._mirror.get(s)
+                if row is not None:
+                    pos_ids.append(j)
+                    rows.append(row)
+                else:
+                    pos_str.append(j)
+                    leftovers.append(s)
+        ids = (
+            np.asarray(rows, dtype=np.int32).reshape(len(rows), 4)
+            if rows else None
+        )
+        return epoch, pos_ids, ids, pos_str, leftovers
+
+    def _mirror_learn(self, resp, resp_arrays, sent_strs: List[str]) -> None:
+        epoch = int(resp.get("vepoch", 0))
+        if not epoch:
+            return
+        learn_pos = resp.get("learn_pos") or []
+        learn_ids = (resp_arrays or {}).get("learn_ids")
+        with self._mirror_lock:
+            if epoch != self._mirror_epoch:
+                self._mirror = {}
+                self._mirror_epoch = epoch
+            if learn_ids is None or not len(learn_pos):
+                return
+            if len(self._mirror) + len(learn_pos) > _MIRROR_CAP:
+                self._mirror = {}
+            # learn_pos indexes into the strings WE sent this call; map
+            # each back to its canonical form and remember its id row
+            pos_to_str = dict(enumerate(sent_strs))
+            for row, pos in zip(learn_ids, learn_pos):
+                s = pos_to_str.get(int(pos))
+                if s is not None:
+                    self._mirror[s] = tuple(int(v) for v in row)
+
+    def _mirror_reset(self) -> None:
+        with self._mirror_lock:
+            self._mirror = {}
+            self._mirror_epoch = 0
+
+    # -- check surface -------------------------------------------------------
+
+    def _wire_check(self, strs: List[str], rest_depth: int,
+                    bypass: bool) -> Tuple[List[bool], Optional[int]]:
+        """One owner round trip for the whole miss-list; id-encodes what
+        the mirror knows, learns ids for the rest."""
+        epoch, pos_ids, ids, pos_str, leftovers = self._mirror_encode(strs)
+        meta = {
+            "op": "check",
+            "depth": rest_depth,
+            "n": len(strs),
+            "vepoch": epoch,
+            "pos_ids": pos_ids,
+            "pos_str": pos_str,
+            "tuples": leftovers,
+        }
+        if bypass:
+            meta["cache_bypass"] = True
+        arrays = {"ids": ids} if ids is not None else None
+        # the position lists index into THIS call's layout; remember the
+        # string list actually sent for mirror learning
+        resp, resp_arrays = self._call(meta, arrays)
+        if resp.get("stale_vocab") is not None:
+            # owner swapped vocabularies under our mirror: resend the
+            # whole batch as strings (one extra round trip, rare) and
+            # relearn from that response
+            self._mirror_reset()
+            meta = {
+                "op": "check",
+                "depth": rest_depth,
+                "n": len(strs),
+                "vepoch": 0,
+                "pos_ids": [],
+                "pos_str": list(range(len(strs))),
+                "tuples": strs,
+            }
+            if bypass:
+                meta["cache_bypass"] = True
+            leftovers = strs
+            resp, resp_arrays = self._call(meta)
+        self._mirror_learn(resp, resp_arrays, leftovers)
+        ok_arr = (resp_arrays or {}).get("ok")
+        if ok_arr is None:
+            ok = [bool(v) for v in resp.get("ok", [])]
+        else:
+            ok = [bool(v) for v in np.asarray(ok_arr).reshape(-1)]
+        if len(ok) != len(strs):
+            raise ValueError(
+                f"owner answered {len(ok)} verdicts for {len(strs)} tuples"
+            )
+        cur = resp.get("cursor")
+        return ok, (int(cur) if cur is not None else None)
+
     def batch_check(
         self, queries: Sequence[RelationTuple], rest_depth: int = 0
     ) -> List[bool]:
@@ -419,22 +753,16 @@ class RemoteCheckEngine:
                     results[i] = bool(h.value)
             if not miss:
                 return [bool(v) for v in results]
-        req = {
-            "op": "check",
-            "tuples": [str(queries[i]) for i in miss],
-            "depth": rest_depth,
-        }
-        if bypass:
-            req["cache_bypass"] = True
-        resp = self._call(req)
-        cur = resp.get("cursor")
+        ok, cur = self._wire_check(
+            [str(queries[i]) for i in miss], rest_depth, bypass,
+        )
         if cache is not None and cur is not None:
             cache.advance_fence(int(cur))
-            for i, v in zip(miss, resp["ok"]):
+            for i, v in zip(miss, ok):
                 cache.insert(
                     cache_check_key(queries[i], rest_depth), bool(v), int(cur)
                 )
-        for i, v in zip(miss, resp["ok"]):
+        for i, v in zip(miss, ok):
             results[i] = bool(v)
         return [bool(v) for v in results]
 
@@ -463,12 +791,12 @@ class RemoteCheckEngine:
         (ketotpu/consistency/barrier.py routes here when the engine is
         remote).  Raises the owner's typed refusal — StaleSnapshotError
         412 — through the wire-error path."""
-        req = {"op": "barrier", "rpc": op}
+        meta = {"op": "barrier", "rpc": op}
         if snaptoken:
-            req["snaptoken"] = snaptoken
+            meta["snaptoken"] = snaptoken
         if latest:
-            req["latest"] = True
-        self._call(req)
+            meta["latest"] = True
+        self._call(meta)
 
 
 class RemoteExpandEngine:
@@ -478,7 +806,7 @@ class RemoteExpandEngine:
         self._remote = check if check is not None else RemoteCheckEngine(path)
 
     def build_tree(self, subject: Subject, max_depth: int = 0) -> Optional[Tree]:
-        resp = self._remote._call({
+        resp, _ = self._remote._call({
             "op": "expand",
             "subject": _encode_subject(subject),
             "depth": max_depth,
@@ -499,7 +827,7 @@ class RemoteListEngine:
         self, namespace: str, relation: str, subject: Subject,
         *, page_size: int = 0, page_token: str = "",
     ):
-        resp = self._remote._call({
+        resp, _ = self._remote._call({
             "op": "list_objects",
             "namespace": namespace,
             "relation": relation,
@@ -513,7 +841,7 @@ class RemoteListEngine:
         self, namespace: str, object: str, relation: str,
         *, page_size: int = 0, page_token: str = "",
     ):
-        resp = self._remote._call({
+        resp, _ = self._remote._call({
             "op": "list_subjects",
             "namespace": namespace,
             "object": object,
@@ -536,7 +864,7 @@ def engine_host_readiness(path: str, timeout: float = 1.0):
     def probe():
         conn = _Conn(path)
         try:
-            resp = conn.call({"op": "health"}, timeout=timeout)
+            resp, _ = conn.call({"op": "health"}, timeout=timeout)
         finally:
             conn.close()
         health = resp.get("health", {})

@@ -89,6 +89,51 @@ def scrape(server):
                 )
             )
         )
+
+    def post(url, payload):
+        req = urllib.request.Request(
+            url, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.read().decode()
+
+    # batch front door — keto_batch_requests_total / keto_batch_size
+    post(f"{read}/relation-tuples/batch/check", {
+        "tuples": [
+            {"namespace": "Doc", "object": "readme",
+             "relation": "viewers", "subject_id": s}
+            for s in ("alice", "mallory")
+        ],
+    })
+    post(f"{read}/relation-tuples/batch/expand", {
+        "subjects": [
+            {"namespace": "Doc", "object": "readme", "relation": "viewers"},
+        ],
+    })
+
+    # framed worker wire — one in-process owner round trip so the
+    # byte/call counters are live on the same scrape (owner side counts
+    # into the daemon registry; the worker side is handed that registry's
+    # metrics explicitly)
+    import os
+    import tempfile
+
+    from ketotpu.server.workers import EngineHostServer, RemoteCheckEngine
+
+    sock = os.path.join(
+        tempfile.mkdtemp(prefix="keto-wire-smoke-"), "engine.sock"
+    )
+    host = EngineHostServer(server.registry, sock).start()
+    try:
+        remote = RemoteCheckEngine(
+            sock, metrics=server.registry.metrics()
+        )
+        assert remote.batch_check([
+            RelationTuple.from_string("Group:admin#members@alice"),
+        ]) == [True]
+    finally:
+        host.stop()
     return {
         "metrics_text": get(f"{metrics}/metrics/prometheus"),
         "flight": json.loads(get(f"{metrics}/debug/flight-recorder")),
@@ -140,3 +185,16 @@ def test_flight_recorder_debug_endpoint(scrape):
     entry = max(slowest, key=lambda e: e["total_ms"])
     assert entry["stages_ms"]  # a stage vector rode along
     assert entry["total_ms"] >= max(entry["stages_ms"].values())
+
+
+def test_batch_and_wire_metric_vocabulary(scrape):
+    """ISSUE 7: the batch front door and the framed worker wire publish
+    their metric vocabulary — batch RPC counts, items-per-batch, and
+    socket bytes by direction on both wire endpoints."""
+    text = scrape["metrics_text"]
+    for op in ("check", "expand"):
+        assert f'keto_batch_requests_total{{op="{op}"}}' in text, op
+    assert "keto_batch_size" in text
+    for d in ("tx", "rx"):
+        assert f'keto_wire_bytes_total{{dir="{d}"}}' in text, d
+    assert 'keto_wire_calls_total{op="check"}' in text
