@@ -15,7 +15,8 @@ Verbs:
 * ``namespace validate <file.ts>`` — OPL diagnostics (cmd/namespace/)
 * ``status [--block] [--debug]`` — gRPC health watch (cmd/status/root.go:
   24-95); ``--debug`` dumps the flight recorder (slowest recent requests
-  with per-stage latencies) from the metrics port
+  with per-stage latencies), wave ledger, compile observatory, and
+  projection/compaction state from the metrics port
 * ``version``
 
 Client commands talk gRPC to a running daemon, selected by ``--read-remote``
@@ -763,6 +764,53 @@ def _dump_compiles(metrics_remote: str) -> int:
     return 0
 
 
+def _dump_projection(metrics_remote: str) -> int:
+    """Pretty-print projection/compaction state (/debug/projection):
+    snapshot generation, fold/rebuild/compaction counters, overlay
+    occupancy and the snap <= served <= log cursor triple."""
+    payload = _fetch_debug(metrics_remote, "/debug/projection")
+    if payload is None:
+        return 1
+    if not payload:
+        print("projection: n/a (engine kind has no device snapshot)")
+        return 0
+    print(
+        f"projection: gen={payload.get('generation', 0)}"
+        f" mode={payload.get('last_compaction_mode', 'none')}"
+        f" rebuilds={payload.get('rebuilds', 0)}"
+        f" folds={payload.get('folds', 0)}"
+        f" compactions={payload.get('compactions', 0)}"
+        f" errors={payload.get('compaction_errors', 0)}"
+        f" background={payload.get('background', False)}"
+        f" in_flight={payload.get('compaction_in_flight', False)}"
+    )
+    print(
+        f"  cursors: snap={payload.get('snap_cursor', 0)}"
+        f" served={payload.get('served_cursor', 0)}"
+        f" log={payload.get('log_cursor', 0)}"
+        f" pending={payload.get('pending_changes', 0)}"
+        f" since_base={payload.get('since_base', 0)}"
+        f"/{payload.get('fold_max_pairs', 0)}"
+    )
+    print(
+        f"  overlay: active={payload.get('overlay_active', False)}"
+        f" pairs={payload.get('overlay_pairs', 0)}"
+        f"/{payload.get('overlay_pair_cap', 0)}"
+        f" dirty={payload.get('overlay_dirty', 0)}"
+        f"/{payload.get('overlay_dirty_cap', 0)}"
+    )
+    phases = " ".join(
+        f"{k}={v}s"
+        for k, v in sorted((payload.get("build_phases") or {}).items())
+    )
+    print(
+        f"  last build: {payload.get('projection_build_s', 0.0)}s build,"
+        f" {payload.get('projection_upload_s', 0.0)}s upload"
+        + (f" [{phases}]" if phases else "")
+    )
+    return 0
+
+
 def cmd_status(args) -> int:
     import grpc
 
@@ -774,6 +822,7 @@ def cmd_status(args) -> int:
             _dump_flight_recorder(args.metrics_remote),
             _dump_waves(args.metrics_remote),
             _dump_compiles(args.metrics_remote),
+            _dump_projection(args.metrics_remote),
         ]
         return max(rcs)
 

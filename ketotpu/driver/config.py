@@ -135,6 +135,22 @@ def _defaults() -> Dict[str, Any]:
             # matches the store version + namespace config; every full
             # rebuild refreshes it (engine/checkpoint.py)
             "checkpoint": "",
+            # write-path compaction (engine/tpu.py): when the delta overlay
+            # hits its thresholds, `fold` merges the accumulated changelog
+            # into the existing snapshot (O(delta log N)) instead of
+            # re-projecting all N tuples; `background` moves that work (and
+            # any remaining full rebuild) off the serving path onto a
+            # compactor thread that publishes the next generation with a
+            # pointer swap.  fold_max_pairs bounds the changelog slice a
+            # fold may cover (past it, the next escape is a full build);
+            # catchup_rounds bounds how many back-to-back generations one
+            # compactor kick may publish while chasing a write burst.
+            "compaction": {
+                "fold": True,
+                "background": False,
+                "fold_max_pairs": 200_000,
+                "catchup_rounds": 8,
+            },
         },
         # Leopard closure index (ketotpu/leopard/): the transitive-closure
         # pair index behind ListObjects/ListSubjects and closure-first
@@ -498,6 +514,17 @@ class Provider:
             val = self.get(key)
             if not isinstance(val, int) or val < 1:
                 raise ConfigError(key, f"must be a positive integer, got {val!r}")
+        for key in ("engine.compaction.fold", "engine.compaction.background"):
+            val = self.get(key)
+            if not isinstance(val, bool):
+                raise ConfigError(key, f"must be a boolean, got {val!r}")
+        for key in ("engine.compaction.fold_max_pairs",
+                    "engine.compaction.catchup_rounds"):
+            val = self.get(key)
+            if not isinstance(val, int) or val < 1:
+                raise ConfigError(
+                    key, f"must be a positive integer, got {val!r}"
+                )
         if not isinstance(self.get("leopard.enabled", True), bool):
             raise ConfigError(
                 "leopard.enabled",

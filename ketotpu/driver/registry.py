@@ -524,6 +524,29 @@ class Registry:
                                 )
                             ),
                         },
+                        compaction={
+                            "fold": bool(
+                                self.config.get(
+                                    "engine.compaction.fold", True
+                                )
+                            ),
+                            "background": bool(
+                                self.config.get(
+                                    "engine.compaction.background", False
+                                )
+                            ),
+                            "fold_max_pairs": int(
+                                self.config.get(
+                                    "engine.compaction.fold_max_pairs",
+                                    200_000,
+                                )
+                            ),
+                            "catchup_rounds": int(
+                                self.config.get(
+                                    "engine.compaction.catchup_rounds", 8
+                                )
+                            ),
+                        },
                     )
                     n_mesh = int(self.config.get("engine.mesh_devices") or 0)
                     if n_mesh > 0:
@@ -589,6 +612,13 @@ class Registry:
         eng = self.check_engine()
         inner = getattr(eng, "inner", eng)
         return inner if isinstance(inner, DeviceCheckEngine) else None
+
+    def projection_stats(self) -> dict:
+        """Projection/compaction counters for /debug/projection and
+        `status --debug`; {} for engine kinds without a device snapshot."""
+        dev = self._device_engine()
+        fn = getattr(dev, "projection_stats", None) if dev is not None else None
+        return fn() if callable(fn) else {}
 
     def oracle_engine(self) -> CheckEngine:
         with self._lock:
@@ -786,6 +816,36 @@ class Registry:
         m.gauge("keto_engine_projection_upload_seconds",
                 eng.projection_upload_s,
                 help="device snapshot upload wall time")
+        # write-path compaction gauges (engine/tpu.py): how each overlay
+        # escape resolved (fold vs full rebuild vs background swap) and
+        # how full the overlay is against its thresholds
+        proj_fn = getattr(eng, "projection_stats", None)
+        if proj_fn is not None:
+            ps = proj_fn()
+            m.gauge("keto_projection_generation", ps["generation"],
+                    help="snapshot generations published")
+            m.gauge("keto_projection_rebuilds_total", ps["rebuilds"],
+                    help="full snapshot re-projections")
+            m.gauge("keto_projection_folds_total", ps["folds"],
+                    help="incremental CSR folds of the changelog slice")
+            m.gauge("keto_projection_compactions_total", ps["compactions"],
+                    help="background generation swaps published")
+            m.gauge("keto_projection_compaction_errors_total",
+                    ps["compaction_errors"],
+                    help="background compactor failures (serving unaffected)")
+            m.gauge("keto_projection_compaction_in_flight",
+                    int(ps["compaction_in_flight"]),
+                    help="1 while a background generation build is running")
+            m.gauge("keto_projection_pending_changes", ps["pending_changes"],
+                    help="drained writes not yet covered by the served view")
+            m.gauge("keto_projection_overlay_pairs", ps["overlay_pairs"],
+                    help="membership pairs resident in the delta overlay")
+            m.gauge("keto_projection_overlay_dirty", ps["overlay_dirty"],
+                    help="CSR rows marked dirty in the delta overlay")
+            cap = max(1, ps["overlay_pair_cap"])
+            m.gauge("keto_projection_overlay_occupancy",
+                    ps["overlay_pairs"] / cap,
+                    help="overlay pair fill fraction against its threshold")
         # demand-adaptive scheduling state: EMA frontier occupancy per BFS
         # level (units of active roots), for the fast path and the general
         # (AND/NOT) tier's skeleton + fast-leaf sub-runs

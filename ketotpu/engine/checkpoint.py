@@ -34,8 +34,11 @@ from ketotpu.engine.vocab import Interner, Vocab
 #: the shallower lookup unroll; v3: err_reach closure table added for
 #: the algebra path's short-circuit gate; v4: InvertResult folds into
 #: the p_child_neg edge-parity column — a v3 OpTable still has P_NOT
-#: nodes the folded interpreters would mis-handle)
-SNAPSHOT_FORMAT = 4
+#: nodes the folded interpreters would mis-handle; v5: host-side
+#: node_hi/node_lo/mem_node/mem_subj serialize unpadded — a v4
+#: checkpoint's padded columns would break the fold path's exact-length
+#: merges)
+SNAPSHOT_FORMAT = 5
 
 _SCALARS = ("num_rels", "n_nodes", "n_edges", "n_tuples", "version")
 _ARRAYS = (

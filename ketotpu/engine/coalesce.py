@@ -304,6 +304,12 @@ class CoalescingEngine:
         with self._wake:
             self._closed = True
             self._wake.notify()
+        # defining close() here shadows __getattr__ forwarding, so retire
+        # the wrapped engine explicitly (its background compactor thread
+        # must be joined before daemon shutdown)
+        inner_close = getattr(self.inner, "close", None)
+        if callable(inner_close):
+            inner_close()
 
     # -- worker --------------------------------------------------------------
 

@@ -44,7 +44,7 @@ from typing import Dict, List, Optional, Set, Tuple
 import numpy as np
 
 from ketotpu.api.types import RelationTuple, SubjectSet
-from ketotpu.engine import hashtab
+from ketotpu.engine import hashtab, parallel
 from ketotpu.engine.snapshot import Snapshot, _bucket
 from ketotpu.engine.vocab import Vocab
 
@@ -106,6 +106,26 @@ class TupleColumns:
         out.alive = self.alive.copy()
         out.alive[: self.n] &= keep_rows[: self.n]
         out.alive_count = int(out.alive[: self.n].sum())
+        out._rows_by_key = None
+        return out
+
+    def freeze(self) -> "TupleColumns":
+        """Stable view for an off-thread snapshot build while the original
+        keeps absorbing writes.  Appends only touch rows >= the frozen
+        ``n`` (growth reallocates, never mutates the prefix) and deletes
+        only flip the (copied) alive bitmap, so the id-column prefix this
+        view reads is immutable — EXCEPT under ``compact()``, which the
+        engine only runs on the blocking rebuild path after invalidating
+        the in-flight build's generation token.  The clone must never be
+        written."""
+        out = TupleColumns.__new__(TupleColumns)
+        out.vocab = self.vocab
+        out.cap = self.cap
+        out.n = self.n
+        for c in self.COLS:
+            setattr(out, c, getattr(self, c))
+        out.alive = self.alive[: self.n].copy()
+        out.alive_count = int(out.alive.sum())
         out._rows_by_key = None
         return out
 
@@ -205,54 +225,147 @@ class TupleColumns:
                 ]
 
 
+#: per-phase wall-time keys ``build_snapshot_cols`` reports (the bench and
+#: ``keto_projection_phase_seconds`` carry the same vocabulary)
+BUILD_PHASES = ("columns", "sort_unique", "csr_pack", "hashtab", "optable")
+
+
 def build_snapshot_cols(
     cols: TupleColumns,
     manager,
     *,
     strict: bool = False,
     version: int = -1,
+    phases: Optional[Dict[str, float]] = None,
 ) -> Snapshot:
     """Vectorized snapshot build from the column cache.
 
     Produces arrays identical to `snapshot.build_snapshot` (same node
     ordering, same insertion-order CSR, same membership sort) without
-    per-tuple Python loops — rebuild cost is a few numpy passes.
+    per-tuple Python loops — rebuild cost is a few numpy passes, sharded
+    across the build pool on multi-core hosts (engine/parallel.py).
+
+    ``phases`` (optional dict) accumulates per-phase wall seconds under
+    the BUILD_PHASES keys, so a projection_build_s regression is
+    attributable to a specific stage.
     """
+    import time
+
     from ketotpu.engine.optable import compile_flat_tables, compile_op_table
     from ketotpu.engine.snapshot import _compute_taint
 
+    ph = phases if phases is not None else {}
+
+    def _mark(key, t0):
+        t1 = time.perf_counter()
+        ph[key] = ph.get(key, 0.0) + (t1 - t0)
+        return t1
+
+    t0 = time.perf_counter()
     vocab = cols.vocab
     op = compile_op_table(manager, vocab, strict=strict)
     num_rels = op.prog_root.shape[1]
     num_ns = op.prog_root.shape[0]
+    t0 = _mark("optable", t0)
 
-    live = np.flatnonzero(cols.alive[: cols.n])
-    ns = cols.ns[live]
-    obj = cols.obj[live]
-    rel = cols.rel[live]
-    subj = cols.subj[live]
-    hi = ns.astype(np.int64) * num_rels + rel
+    # -- columns: live views of the id columns ------------------------------
+    # all-alive (the cold build after compaction) takes zero-copy slices;
+    # otherwise one gather per column.  The subject-set decode columns are
+    # NEVER gathered at full width — later stages index them through the
+    # (much smaller) set-row selection instead.
+    n_all = cols.n
+    if cols.alive_count == n_all:
+        live = None
+        ns = cols.ns[:n_all]
+        obj = cols.obj[:n_all]
+        rel = cols.rel[:n_all]
+        subj = cols.subj[:n_all]
+        is_set = cols.is_set[:n_all]
+    else:
+        live = np.flatnonzero(cols.alive[:n_all])
+        ns = cols.ns[live]
+        obj = cols.obj[live]
+        rel = cols.rel[live]
+        subj = cols.subj[live]
+        is_set = cols.is_set[live]
+    n_tuples = len(ns)
+    t0 = _mark("columns", t0)
 
     # -- node table (sorted by (hi, lo), ids dense) -------------------------
-    packed = (hi << 32) | obj.astype(np.int64)
-    uniq_packed = np.unique(packed)  # sorted
-    n_nodes = len(uniq_packed)
-    node_of_row = np.searchsorted(uniq_packed, packed).astype(np.int32)
+    # packed key = (ns * num_rels + rel) << 32 | obj, built in place to
+    # avoid four 85MB temporaries at the 10M-row scale
+    packed = np.empty(n_tuples, np.int64)
 
-    # -- membership pairs ---------------------------------------------------
-    n_tuples = len(live)
-    order = np.lexsort((subj, node_of_row))
-    mem_node_v = node_of_row[order]
-    mem_subj_v = subj[order]
+    def _pack(lo, hi_):
+        seg = packed[lo:hi_]
+        np.multiply(ns[lo:hi_], num_rels, out=seg, casting="unsafe")
+        seg += rel[lo:hi_]
+        seg <<= 32
+        seg += obj[lo:hi_]
+
+    parallel.shard_apply(n_tuples, _pack)
+
+    # one stable argsort of the packed key replaces the old
+    # unique + searchsorted + argsort(node_of_row) triple: equal packed
+    # keys ARE equal nodes and packed order IS node order, so this
+    # permutation doubles as the membership insertion order (m_order)
+    s1 = np.argsort(packed, kind="stable")
+    sp = packed[s1]
+    subj_s1 = subj[s1]  # membership insertion order (seq within node)
+    if n_tuples:
+        newg = np.empty(n_tuples, bool)
+        newg[0] = True
+        np.not_equal(sp[1:], sp[:-1], out=newg[1:])
+        uniq_packed = sp[newg]
+        gid32 = np.cumsum(newg, dtype=np.int32)  # node id + 1 per position
+        gid32 -= 1
+        node_of_row = np.empty(n_tuples, np.int32)
+        node_of_row[s1] = gid32  # scatter back to row order
+    else:
+        uniq_packed = np.zeros(0, np.int64)
+        node_of_row = np.zeros(0, np.int32)
+        gid32 = np.zeros(0, np.int32)
+    n_nodes = len(uniq_packed)
+
+    # membership pairs sorted by (node, subj): node values come free as
+    # the group ids (gid32); the subject column only needs sorting WITHIN
+    # multi-tuple groups — most nodes own a single tuple, so instead of a
+    # full lexsort (the old build's single hottest pass) sort just the
+    # multi-group rows by a packed (node, subj) VALUE key.  Singleton
+    # rows pass through in s1 order, which is already (node, subj) order.
+    mem_node_v = gid32
+    if n_tuples:
+        is_last = np.empty(n_tuples, bool)
+        is_last[:-1] = newg[1:]
+        is_last[-1] = True
+        multi = ~(newg & is_last)  # row sits in a group of size >= 2
+        mem_subj_v = subj_s1.copy()
+        rows_m = np.flatnonzero(multi)
+        if len(rows_m):
+            mk = gid32[rows_m].astype(np.int64)
+            mk <<= 32
+            mk += subj_s1[rows_m]
+            mk.sort()  # values only: grouped by node, subj ascending
+            mem_subj_v[rows_m] = mk & 0xFFFFFFFF
+    else:
+        mem_subj_v = subj_s1
+    t0 = _mark("sort_unique", t0)
 
     # -- subject-set CSR (insertion order within each row) -------------------
-    ss = np.flatnonzero(cols.is_set[live] == 1)
-    ss_rows = node_of_row[ss]
-    e_order = np.argsort(ss_rows, kind="stable")  # stable: keeps seq order
-    ss_sorted = ss[e_order]
-    edge_ns_v = cols.s_ns[live][ss_sorted]
-    edge_obj_v = cols.s_obj[live][ss_sorted]
-    edge_rel_v = cols.s_rel[live][ss_sorted]
+    # s1 already groups rows by node with seq order preserved, so the set
+    # rows in s1 order ARE the edge list (old: flatnonzero + stable argsort)
+    sel = np.empty(n_tuples, bool)
+
+    def _sel(lo, hi_):
+        np.equal(is_set[s1[lo:hi_]], 1, out=sel[lo:hi_])
+
+    parallel.shard_apply(n_tuples, _sel)
+    ss_sorted = s1[sel]  # row index (live-space) per edge, grouped by node
+    ss_rows = gid32[sel]  # node id per edge
+    rows_set = ss_sorted if live is None else live[ss_sorted]
+    edge_ns_v = cols.s_ns[rows_set]
+    edge_obj_v = cols.s_obj[rows_set]
+    edge_rel_v = cols.s_rel[rows_set]
     n_edges = len(ss_sorted)
     counts = np.bincount(ss_rows, minlength=max(n_nodes, 1))[: max(n_nodes, 1)]
 
@@ -261,77 +374,101 @@ def build_snapshot_cols(
     e_packed = (e_hi << 32) | edge_obj_v.astype(np.int64)
     e_idx = np.searchsorted(uniq_packed, e_packed)
     e_found = (e_idx < n_nodes) & (
-        uniq_packed[np.clip(e_idx, 0, max(n_nodes - 1, 0))] == e_packed
+        uniq_packed[np.minimum(e_idx, max(n_nodes - 1, 0))] == e_packed
     )
     edge_node_v = np.where(e_found, e_idx, -1).astype(np.int32)
 
     # -- dynamic relation-level pairs (for taint) ---------------------------
+    # packed unique over the edge rows instead of a Python set of 4-tuples
+    # over millions of lists; the source (ns, rel) pair is the high word
+    # of the node key already gathered into sp
+    src_pk = sp[sel] >> 32
+    dkey = (src_pk << 32) | (e_hi & 0xFFFFFFFF)
+    du = np.unique(dkey)
+    d_src = du >> 32
+    d_dst = du & 0xFFFFFFFF
     dyn = set(
         zip(
-            ns[ss].tolist(),
-            rel[ss].tolist(),
-            cols.s_ns[live][ss].tolist(),
-            cols.s_rel[live][ss].tolist(),
+            (d_src // num_rels).tolist(), (d_src % num_rels).tolist(),
+            (d_dst // num_rels).tolist(), (d_dst % num_rels).tolist(),
         )
     )
 
     # -- pack + pad ---------------------------------------------------------
+    # only device-bound arrays get _bucket padding; node_hi/node_lo and the
+    # sorted membership columns stay host-side (checkpointing + overlay
+    # binary searches) and are stored at exact length
     npad = _bucket(n_nodes)
     epad = _bucket(n_edges)
     mpad = _bucket(n_tuples)
 
-    node_hi = np.full(npad, _I32MAX, np.int32)
-    node_lo = np.full(npad, _I32MAX, np.int32)
-    node_hi[:n_nodes] = (uniq_packed >> 32).astype(np.int32)
-    node_lo[:n_nodes] = (uniq_packed & 0xFFFFFFFF).astype(np.int32)
+    node_hi = np.empty(n_nodes, np.int32)
+    node_lo = np.empty(n_nodes, np.int32)
 
-    row_ptr = np.zeros(npad + 1, np.int32)
+    def _node_cols(lo, hi_):
+        node_hi[lo:hi_] = uniq_packed[lo:hi_] >> 32
+        node_lo[lo:hi_] = uniq_packed[lo:hi_] & 0xFFFFFFFF
+
+    parallel.shard_apply(n_nodes, _node_cols)
+
+    row_ptr = np.empty(npad + 1, np.int32)
+    row_ptr[0] = 0
     if n_nodes:
         np.cumsum(counts, out=row_ptr[1 : n_nodes + 1])
-    row_ptr[n_nodes + 1:] = row_ptr[n_nodes]
+    row_ptr[n_nodes + 1:] = n_edges
 
     def pad_edges(v):
-        out = np.full(epad, -1, np.int32)
+        out = np.empty(epad, np.int32)
         out[:n_edges] = v
+        out[n_edges:] = -1
         return out
 
-    mem_node = np.full(mpad, _I32MAX, np.int32)
-    mem_subj = np.full(mpad, _I32MAX, np.int32)
-    mem_node[:n_tuples] = mem_node_v
-    mem_subj[:n_tuples] = mem_subj_v
-    mem_row_ptr = np.searchsorted(
-        mem_node_v, np.arange(npad + 1)
-    ).astype(np.int32)
-    # insertion-ordered member list per node: stable sort by node keeps
-    # the live rows' append (seq) order within each group
-    mem_ord_subj = np.full(mpad, -1, np.int32)
-    m_order = np.argsort(node_of_row, kind="stable")
-    mem_ord_subj[:n_tuples] = subj[m_order]
+    mem_node = mem_node_v
+    mem_subj = mem_subj_v
+    mem_ord_subj = np.empty(mpad, np.int32)
+
+    def _mem_fill(lo, hi_):
+        # insertion-ordered member list per node: s1 is stable by node, so
+        # it keeps the live rows' append (seq) order within each group
+        mem_ord_subj[lo:hi_] = subj_s1[lo:hi_]
+
+    parallel.shard_apply(n_tuples, _mem_fill)
+    mem_ord_subj[n_tuples:] = -1
+    # per-node membership CSR straight from the group boundaries: every
+    # node owns >= 1 tuple, so the i-th True in newg IS the row offset of
+    # node i (no bincount/cumsum pass over the 10M column)
+    mem_row_ptr = np.empty(npad + 1, np.int32)
+    mem_row_ptr[n_nodes:] = n_tuples
+    if n_nodes:
+        mem_row_ptr[:n_nodes] = np.flatnonzero(newg)
 
     spad = _bucket(max(len(vocab.subjects), 1))
     sub_ns = np.full(spad, -1, np.int32)
     sub_obj = np.full(spad, -1, np.int32)
     sub_rel = np.full(spad, -1, np.int32)
-    ss_subj = subj[ss]
-    sub_ns[ss_subj] = cols.s_ns[live][ss]
-    sub_obj[ss_subj] = cols.s_obj[live][ss]
-    sub_rel[ss_subj] = cols.s_rel[live][ss]
+    ss_subj = subj[ss_sorted]
+    sub_ns[ss_subj] = edge_ns_v
+    sub_obj[ss_subj] = edge_obj_v
+    sub_rel[ss_subj] = edge_rel_v
+    t0 = _mark("csr_pack", t0)
 
     flat = compile_flat_tables(
         manager, vocab, strict=strict, num_ns=num_ns, num_rel=num_rels
     )
     taint, err_reach = _compute_taint(flat, op, dyn, num_ns, num_rels)
+    t0 = _mark("optable", t0)
 
     node_tab = hashtab.build_table(
-        node_hi[:n_nodes].astype(np.int64),
-        node_lo[:n_nodes].astype(np.int64),
+        node_hi,
+        node_lo,
         np.arange(n_nodes, dtype=np.int32),
         lean=True, probe=2 * hashtab.SNAPSHOT_PROBE,
     )
     mem_tab = hashtab.build_table(
-        mem_node_v.astype(np.int64), mem_subj_v.astype(np.int64),
+        mem_node_v, mem_subj_v,
         lean=True, probe=2 * hashtab.SNAPSHOT_PROBE,
     )
+    t0 = _mark("hashtab", t0)
 
     snap = Snapshot(
         vocab=vocab,
@@ -520,4 +657,498 @@ def overlay_arrays(
     }
     out.update({f"om_{k}": v for k, v in om.items()})
     out.update({f"ovt_{k}": v for k, v in ovt.items()})
+    return out
+
+
+# -- incremental CSR fold -----------------------------------------------------
+
+
+FOLD_PHASES = ("fold_replay", "fold_merge", "fold_hashtab")
+
+
+class FoldRejected(Exception):
+    """The changelog slice cannot fold into the base snapshot; the caller
+    must run a full build."""
+
+
+def _edge_class_counts(snap: Snapshot) -> Dict[int, int]:
+    """Per relation-level edge class (src_hi << 32 | dst_hi) edge counts,
+    cached on the snapshot: the fold uses these to detect when a delete
+    retires the last edge of a class (the taint closure would shrink —
+    unfoldable without recompiling op tables)."""
+    cached = getattr(snap, "_edge_class_counts", None)
+    if cached is not None:
+        return cached
+    counts: Dict[int, int] = {}
+    n_nodes, n_edges = snap.n_nodes, snap.n_edges
+    if n_edges:
+        per_node = np.diff(snap.row_ptr[: n_nodes + 1].astype(np.int64))
+        src_hi = np.repeat(snap.node_hi.astype(np.int64), per_node)
+        dst_hi = (
+            snap.edge_ns[:n_edges].astype(np.int64) * snap.num_rels
+            + snap.edge_rel[:n_edges]
+        )
+        u, c = np.unique((src_hi << 32) | dst_hi, return_counts=True)
+        counts = dict(zip(u.tolist(), c.tolist()))
+    snap._edge_class_counts = counts
+    return counts
+
+
+def fold_snapshot_cols(
+    snap: Snapshot,
+    vocab: Vocab,
+    changes,
+    *,
+    version: int = -1,
+    phases: Optional[Dict[str, float]] = None,
+) -> Snapshot:
+    """Fold a changelog slice into an existing snapshot.
+
+    Instead of re-projecting all N tuples, merge the (sorted) delta into
+    the membership and edge arrays, repair the row pointers from count
+    cumsums, and splice the hash tables in place: O(delta log N) key work
+    plus O(N) memcpy passes — no 10M-row sorts, no full hash builds on the
+    common path.  Delete ordering matches the column cache's FIFO
+    semantics (base occurrences are consumed before slice-local adds), so
+    the folded snapshot is verdict-identical to a from-scratch
+    ``build_snapshot_cols`` at the same cursor.
+
+    All padded shapes are preserved (pow2-crossing growth is rejected), so
+    a folded snapshot re-ships to the device without changing any jitted
+    program's input shapes.
+
+    Raises FoldRejected when the slice cannot fold: ids beyond the
+    compiled op/flat table dims, subject-pad or padded-shape overflow, or
+    a change to the relation-level edge-pair set in either direction (the
+    taint closure would move).  The caller falls back to a full build.
+
+    ``phases`` accumulates per-phase wall seconds under FOLD_PHASES keys.
+    """
+    import time
+
+    ph = phases if phases is not None else {}
+
+    def _mark(key, t0):
+        t1 = time.perf_counter()
+        ph[key] = ph.get(key, 0.0) + (t1 - t0)
+        return t1
+
+    t0 = time.perf_counter()
+    num_rels = snap.num_rels
+    num_ns = snap.op.prog_root.shape[0]
+    spad = len(snap.sub_ns)
+    if _bucket(max(len(vocab.subjects), 1)) != spad:
+        raise FoldRejected("subject pad growth")
+    dyn = getattr(snap, "dyn_pairs", None)
+    if dyn is None:
+        raise FoldRejected("base snapshot carries no dyn_pairs")
+
+    n_nodes0 = snap.n_nodes
+    n_edges0 = snap.n_edges
+    n_tuples0 = snap.n_tuples
+    mem_rp = snap.mem_row_ptr
+    row_ptr0 = snap.row_ptr
+
+    # -- replay the slice per tuple identity (FIFO delete parity) -----------
+    # key = (hi, obj, subj) in id space; every base row is older than any
+    # add in the slice, so deletes consume base occurrences first, then
+    # slice-local adds oldest-first — exactly TupleColumns.apply's order.
+    state: Dict[Tuple[int, int, int], list] = {}  # [base_left, rm, [seqs]]
+    info: Dict[Tuple[int, int, int], Tuple[int, int, int, int]] = {}
+    node_cache: Dict[Tuple[int, int], int] = {}
+    seq = 0
+    for op_, t in changes:
+        seq += 1
+        ns = vocab.namespaces.lookup(t.namespace)
+        rel = vocab.relations.lookup(t.relation)
+        obj = vocab.objects.lookup(t.object)
+        subj = vocab.subject_key(t.subject)
+        if op_ <= 0 and min(ns, rel, obj, subj) < 0:
+            continue  # delete of a tuple the vocab never saw: no-op
+        if ns < 0 or rel < 0 or ns >= num_ns or rel >= num_rels:
+            raise FoldRejected("namespace/relation beyond compiled tables")
+        if obj < 0 or subj < 0 or subj >= spad:
+            raise FoldRejected("object/subject id overflow")
+        hi = ns * num_rels + rel
+        key = (hi, obj, subj)
+        st = state.get(key)
+        if st is None:
+            nk = (hi, obj)
+            node = node_cache.get(nk, -2)
+            if node == -2:
+                node = _base_node_id(snap, hi, obj)
+                node_cache[nk] = node
+            base = _base_pair_count(snap, node, subj) if node >= 0 else 0
+            st = state[key] = [base, 0, []]
+            if isinstance(t.subject, SubjectSet):
+                sns = vocab.namespaces.lookup(t.subject.namespace)
+                sobj = vocab.objects.lookup(t.subject.object)
+                srel = vocab.relations.lookup(t.subject.relation)
+                if min(sns, sobj, srel) < 0 or sns >= num_ns or srel >= num_rels:
+                    raise FoldRejected("subject-set id overflow")
+                info[key] = (1, sns, sobj, srel)
+            else:
+                info[key] = (0, -1, -1, -1)
+        if op_ > 0:
+            if info[key][0]:
+                sns, srel = info[key][1], info[key][3]
+                if (ns, rel, sns, srel) not in dyn:
+                    raise FoldRejected("new relation-level edge pair (taint)")
+            st[2].append(seq)
+        else:
+            if st[0] > 0:
+                st[0] -= 1
+                st[1] += 1
+            elif st[2]:
+                st[2].pop(0)
+
+    # -- aggregate per node --------------------------------------------------
+    mem_rm: Dict[int, list] = {}       # old node id -> [(subj, k)]
+    edge_rm: Dict[int, list] = {}      # old node id -> [(sns, sobj, srel, k)]
+    adds_by_node: Dict[Tuple[int, int], list] = {}
+    class_delta: Dict[int, int] = {}
+    final_delta: Dict[int, int] = {}   # old node id -> net membership delta
+    new_node_rows: Dict[Tuple[int, int], int] = {}
+    sub_scatter: Dict[int, Tuple[int, int, int]] = {}
+    for key, (base_left, rm, seqs) in state.items():
+        hi, obj, subj = key
+        is_set, sns, sobj, srel = info[key]
+        node = node_cache[(hi, obj)]
+        if rm:
+            mem_rm.setdefault(node, []).append((subj, rm))
+            if is_set:
+                edge_rm.setdefault(node, []).append((sns, sobj, srel, rm))
+        if is_set:
+            d = len(seqs) - rm
+            if d:
+                ck = (hi << 32) | (sns * num_rels + srel)
+                class_delta[ck] = class_delta.get(ck, 0) + d
+            if seqs:
+                sub_scatter[subj] = (sns, sobj, srel)
+        if seqs:
+            adds_by_node.setdefault((hi, obj), []).extend(
+                (s_, subj, is_set, sns, sobj, srel) for s_ in seqs
+            )
+        if node >= 0:
+            net = len(seqs) - rm
+            if net:
+                final_delta[node] = final_delta.get(node, 0) + net
+        elif seqs:
+            new_node_rows[(hi, obj)] = (
+                new_node_rows.get((hi, obj), 0) + len(seqs)
+            )
+
+    if class_delta:
+        base_classes = _edge_class_counts(snap)
+        for ck, d in class_delta.items():
+            if base_classes.get(ck, 0) + d <= 0:
+                raise FoldRejected("relation-level edge pair retired (taint)")
+
+    # node set changes: removed = membership emptied; inserted = new keys
+    removed_ids = sorted(
+        n for n, d in final_delta.items()
+        if d < 0 and int(mem_rp[n + 1]) - int(mem_rp[n]) + d == 0
+    )
+    ins_keys = np.array(
+        sorted((hi << 32) | obj for (hi, obj) in new_node_rows), np.int64
+    )
+    n_nodes1 = n_nodes0 - len(removed_ids) + len(ins_keys)
+    n_tuples1 = n_tuples0 + sum(len(v[2]) - v[1] for v in state.values())
+    e_add_n = sum(1 for a in adds_by_node.values() for e in a if e[2])
+    e_rm_n = sum(k for lst in edge_rm.values() for (_, _, _, k) in lst)
+    n_edges1 = n_edges0 + e_add_n - e_rm_n
+    if (
+        _bucket(n_nodes1) != _bucket(n_nodes0)
+        or _bucket(n_edges1) != _bucket(n_edges0)
+        or _bucket(n_tuples1) != _bucket(n_tuples0)
+    ):
+        raise FoldRejected("padded shape crossing")
+    npad = _bucket(n_nodes1)
+    t0 = _mark("fold_replay", t0)
+
+    # -- node renumbering ----------------------------------------------------
+    keep_nodes = np.ones(n_nodes0, bool)
+    keep_nodes[removed_ids] = False
+    kept_old = np.flatnonzero(keep_nodes)
+    old_packed = (snap.node_hi.astype(np.int64) << 32) | snap.node_lo.astype(
+        np.int64
+    )
+    kept_keys = old_packed[kept_old]
+    shift = np.searchsorted(ins_keys, kept_keys)
+    remap = np.full(n_nodes0, -1, np.int32)
+    remap[kept_old] = (np.arange(len(kept_old), dtype=np.int64) + shift).astype(
+        np.int32
+    )
+    ins_pos_in_kept = np.searchsorted(kept_keys, ins_keys)
+    new_id_of_ins = (
+        ins_pos_in_kept + np.arange(len(ins_keys))
+    ).astype(np.int32)
+    node_keys1 = np.insert(kept_keys, ins_pos_in_kept, ins_keys)
+    node_hi1 = (node_keys1 >> 32).astype(np.int32)
+    node_lo1 = (node_keys1 & 0xFFFFFFFF).astype(np.int32)
+    new_id_by_key = dict(
+        zip((int(k) for k in ins_keys), (int(i) for i in new_id_of_ins))
+    )
+    renumbered = bool(len(ins_keys)) or bool(removed_ids)
+
+    # -- membership merge ----------------------------------------------------
+    mem_node0 = snap.mem_node
+    mem_subj0 = snap.mem_subj
+    ord0 = snap.mem_ord_subj
+    keep_mem = np.ones(n_tuples0, bool)
+    ord_del: list = []
+    rm_per_old = np.zeros(n_nodes0, np.int64)
+    for node, lst in mem_rm.items():
+        lo = int(mem_rp[node])
+        hi_ = int(mem_rp[node + 1])
+        seg = mem_subj0[lo:hi_]
+        oseg = ord0[lo:hi_]
+        for subj, k in lst:
+            p = lo + int(np.searchsorted(seg, subj))
+            keep_mem[p : p + k] = False
+            # the ord column deletes FIRST-k occurrences (FIFO)
+            occ = np.flatnonzero(oseg == subj)[:k] + lo
+            ord_del.extend(occ.tolist())
+            rm_per_old[node] += k
+    old_mcnt = np.diff(mem_rp[: n_nodes0 + 1].astype(np.int64))
+    kept_mcnt_old = old_mcnt - rm_per_old
+    kept_cnt1 = np.zeros(max(n_nodes1, 1), np.int64)
+    kept_cnt1[remap[kept_old]] = kept_mcnt_old[kept_old]
+    add_cnt1 = np.zeros(max(n_nodes1, 1), np.int64)
+
+    add_mem: list = []   # (new_id, subj)
+    add_ord: list = []   # (new_id, seq, subj)
+    add_edges: list = []  # (new_id, seq, sns, sobj, srel)
+    for (hi, obj), entries in adds_by_node.items():
+        old = node_cache[(hi, obj)]
+        nid = int(remap[old]) if old >= 0 else new_id_by_key[(hi << 32) | obj]
+        for (s_, subj, is_set, sns, sobj, srel) in entries:
+            add_mem.append((nid, subj))
+            add_ord.append((nid, s_, subj))
+            if is_set:
+                add_edges.append((nid, s_, sns, sobj, srel))
+        add_cnt1[nid] += len(entries)
+
+    kept_node = mem_node0[keep_mem] if ord_del else mem_node0
+    kept_subj = mem_subj0[keep_mem] if ord_del else mem_subj0
+    new_mem_node = remap[kept_node]
+    new_mem_subj = kept_subj
+    if add_mem:
+        add_mem.sort()
+        am_node = np.array([a[0] for a in add_mem], np.int32)
+        am_subj = np.array([a[1] for a in add_mem], np.int32)
+        kept_key = (new_mem_node.astype(np.int64) << 32) | new_mem_subj.astype(
+            np.int64
+        )
+        add_key = (am_node.astype(np.int64) << 32) | am_subj.astype(np.int64)
+        pos = np.searchsorted(kept_key, add_key)
+        mem_node1 = np.insert(new_mem_node, pos, am_node)
+        mem_subj1 = np.insert(new_mem_subj, pos, am_subj)
+    else:
+        mem_node1 = new_mem_node
+        mem_subj1 = (
+            new_mem_subj if new_mem_subj is not mem_subj0 else mem_subj0.copy()
+        )
+    assert len(mem_node1) == n_tuples1
+    cnt1 = kept_cnt1 + add_cnt1
+    mem_row_ptr1 = np.empty(npad + 1, np.int32)
+    mem_row_ptr1[0] = 0
+    if n_nodes1:
+        np.cumsum(cnt1[:n_nodes1], out=mem_row_ptr1[1 : n_nodes1 + 1])
+    mem_row_ptr1[n_nodes1 + 1:] = n_tuples1
+
+    # insertion-ordered member column: delete FIFO positions, append new
+    # rows at each node's segment end (np.insert keeps value order at
+    # duplicate positions)
+    ord_body = ord0[:n_tuples0]
+    if ord_del:
+        ord_keep = np.ones(n_tuples0, bool)
+        ord_keep[np.array(ord_del, np.int64)] = False
+        ord_body = ord_body[ord_keep]
+    kept_cum = np.zeros(max(n_nodes1, 1) + 1, np.int64)
+    np.cumsum(kept_cnt1, out=kept_cum[1:])
+    if add_ord:
+        add_ord.sort()  # (node, seq): per-node append order
+        ao_pos = kept_cum[np.array([a[0] for a in add_ord], np.int64) + 1]
+        ao_val = np.array([a[2] for a in add_ord], np.int32)
+        ord_body = np.insert(ord_body, ao_pos, ao_val)
+    mpad = _bucket(n_tuples1)
+    mem_ord1 = np.empty(mpad, np.int32)
+    mem_ord1[:n_tuples1] = ord_body
+    mem_ord1[n_tuples1:] = -1
+
+    # -- edge merge ----------------------------------------------------------
+    old_ecnt = np.diff(row_ptr0[: n_nodes0 + 1].astype(np.int64))
+    e_keep = np.ones(n_edges0, bool)
+    erm_per_old = np.zeros(n_nodes0, np.int64)
+    for node, lst in edge_rm.items():
+        lo = int(row_ptr0[node])
+        hi_ = int(row_ptr0[node + 1])
+        for sns, sobj, srel, k in lst:
+            m = np.flatnonzero(
+                (snap.edge_ns[lo:hi_] == sns)
+                & (snap.edge_obj[lo:hi_] == sobj)
+                & (snap.edge_rel[lo:hi_] == srel)
+            )[:k] + lo
+            if len(m) != k:  # every set tuple owns exactly one edge
+                raise FoldRejected("edge bookkeeping mismatch")
+            e_keep[m] = False
+            erm_per_old[node] += k
+    if e_rm_n:
+        e_ns1 = snap.edge_ns[:n_edges0][e_keep]
+        e_obj1 = snap.edge_obj[:n_edges0][e_keep]
+        e_rel1 = snap.edge_rel[:n_edges0][e_keep]
+        en0 = snap.edge_node[:n_edges0][e_keep]
+    else:
+        e_ns1 = snap.edge_ns[:n_edges0]
+        e_obj1 = snap.edge_obj[:n_edges0]
+        e_rel1 = snap.edge_rel[:n_edges0]
+        en0 = snap.edge_node[:n_edges0]
+    en1 = np.where(
+        en0 >= 0, remap[np.clip(en0, 0, None)], np.int32(-1)
+    ).astype(np.int32)
+    if len(ins_keys):
+        # dangling edges may now resolve against the inserted nodes
+        dang = np.flatnonzero(en1 < 0)
+        if len(dang):
+            dk = (
+                (e_ns1[dang].astype(np.int64) * num_rels + e_rel1[dang]) << 32
+            ) | e_obj1[dang].astype(np.int64)
+            di = np.searchsorted(ins_keys, dk)
+            hit = (di < len(ins_keys)) & (
+                ins_keys[np.minimum(di, len(ins_keys) - 1)] == dk
+            )
+            en1[dang[hit]] = new_id_of_ins[di[hit]]
+
+    kept_ecnt1 = np.zeros(max(n_nodes1, 1), np.int64)
+    kept_ecnt1[remap[kept_old]] = (old_ecnt - erm_per_old)[kept_old]
+    e_cum = np.zeros(max(n_nodes1, 1) + 1, np.int64)
+    np.cumsum(kept_ecnt1, out=e_cum[1:])
+    add_ecnt1 = np.zeros(max(n_nodes1, 1), np.int64)
+    if add_edges:
+        add_edges.sort()  # (node, seq): per-node append order
+        ae_nid = np.array([a[0] for a in add_edges], np.int64)
+        ae_ns = np.array([a[2] for a in add_edges], np.int32)
+        ae_obj = np.array([a[3] for a in add_edges], np.int32)
+        ae_rel = np.array([a[4] for a in add_edges], np.int32)
+        tk = (
+            (ae_ns.astype(np.int64) * num_rels + ae_rel) << 32
+        ) | ae_obj.astype(np.int64)
+        ti = np.searchsorted(node_keys1, tk)
+        thit = (ti < n_nodes1) & (
+            node_keys1[np.minimum(ti, max(n_nodes1 - 1, 0))] == tk
+        )
+        ae_node = np.where(thit, ti, -1).astype(np.int32)
+        ae_pos = e_cum[ae_nid + 1]
+        e_ns1 = np.insert(e_ns1, ae_pos, ae_ns)
+        e_obj1 = np.insert(e_obj1, ae_pos, ae_obj)
+        e_rel1 = np.insert(e_rel1, ae_pos, ae_rel)
+        en1 = np.insert(en1, ae_pos, ae_node)
+        np.add.at(add_ecnt1, ae_nid, 1)
+    assert len(e_ns1) == n_edges1
+    ecnt1 = kept_ecnt1 + add_ecnt1
+    row_ptr1 = np.empty(npad + 1, np.int32)
+    row_ptr1[0] = 0
+    if n_nodes1:
+        np.cumsum(ecnt1[:n_nodes1], out=row_ptr1[1 : n_nodes1 + 1])
+    row_ptr1[n_nodes1 + 1:] = n_edges1
+    epad = _bucket(n_edges1)
+
+    def pad_edges(v):
+        out = np.empty(epad, np.int32)
+        out[:n_edges1] = v
+        out[n_edges1:] = -1
+        return out
+
+    # subject decode columns: scatter new set subjects; stale entries for
+    # subjects with no surviving rows are harmless (unreachable through
+    # membership) and keeping them preserves the expand path's behaviour
+    if sub_scatter:
+        sub_ns1 = snap.sub_ns.copy()
+        sub_obj1 = snap.sub_obj.copy()
+        sub_rel1 = snap.sub_rel.copy()
+        for subj, (sns, sobj, srel) in sub_scatter.items():
+            sub_ns1[subj] = sns
+            sub_obj1[subj] = sobj
+            sub_rel1[subj] = srel
+    else:
+        sub_ns1, sub_obj1, sub_rel1 = snap.sub_ns, snap.sub_obj, snap.sub_rel
+    t0 = _mark("fold_merge", t0)
+
+    # -- hash tables: splice in place, rebuild only on shape pressure --------
+    rm_keys = old_packed[np.array(removed_ids, np.int64)]
+    node_tab = hashtab.splice_table(
+        snap.node_tab,
+        (rm_keys >> 32).astype(np.int32),
+        (rm_keys & 0xFFFFFFFF).astype(np.int32),
+        (ins_keys >> 32).astype(np.int32),
+        (ins_keys & 0xFFFFFFFF).astype(np.int32),
+        new_id_of_ins,
+        val_remap=remap,
+    )
+    if node_tab is None:
+        node_tab = hashtab.build_table(
+            node_hi1, node_lo1,
+            np.arange(n_nodes1, dtype=np.int32),
+            lean=True, probe=2 * hashtab.SNAPSHOT_PROBE,
+        )
+    mem_tab = None
+    if not renumbered:
+        # (node, subj) keys are stable — splice the per-removal and
+        # per-add entries (duplicates remove/insert distinct slots)
+        r_node: list = []
+        r_subj: list = []
+        for node, lst in mem_rm.items():
+            for subj, k in lst:
+                r_node.extend([node] * k)
+                r_subj.extend([subj] * k)
+        mem_tab = hashtab.splice_table(
+            snap.mem_tab,
+            np.array(r_node, np.int32),
+            np.array(r_subj, np.int32),
+            np.array([a[0] for a in add_mem], np.int32),
+            np.array([a[1] for a in add_mem], np.int32),
+        )
+    if mem_tab is None:
+        mem_tab = hashtab.build_table(
+            mem_node1, mem_subj1,
+            lean=True, probe=2 * hashtab.SNAPSHOT_PROBE,
+        )
+    t0 = _mark("fold_hashtab", t0)
+
+    out = Snapshot(
+        vocab=vocab,
+        op=snap.op,
+        flat=snap.flat,
+        taint=snap.taint,
+        err_reach=snap.err_reach,
+        num_rels=num_rels,
+        node_hi=node_hi1,
+        node_lo=node_lo1,
+        row_ptr=row_ptr1,
+        edge_ns=pad_edges(e_ns1),
+        edge_obj=pad_edges(e_obj1),
+        edge_rel=pad_edges(e_rel1),
+        edge_node=pad_edges(en1),
+        mem_node=mem_node1,
+        mem_subj=mem_subj1,
+        mem_row_ptr=mem_row_ptr1,
+        mem_ord_subj=mem_ord1,
+        sub_ns=sub_ns1,
+        sub_obj=sub_obj1,
+        sub_rel=sub_rel1,
+        n_nodes=n_nodes1,
+        n_edges=n_edges1,
+        n_tuples=n_tuples1,
+        version=version,
+        node_tab=node_tab,
+        mem_tab=mem_tab,
+    )
+    out.dyn_pairs = dyn
+    base_classes = getattr(snap, "_edge_class_counts", None)
+    if base_classes is not None:
+        nc = dict(base_classes)
+        for ck, d in class_delta.items():
+            nc[ck] = nc.get(ck, 0) + d
+        out._edge_class_counts = nc
     return out

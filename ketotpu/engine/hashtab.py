@@ -30,6 +30,8 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
+from ketotpu.engine import parallel
+
 PROBE = 8  # default probe depth; the build guarantees max bucket <= probe
 PROBE_SHALLOW = 4  # for small side tables on hot probe paths (delta overlay)
 # the big snapshot tables (node resolution + tuple membership) TARGET a
@@ -91,6 +93,61 @@ def mix_device(a, b, salt):
     return h
 
 
+def _bincount(h: np.ndarray, buckets: int) -> np.ndarray:
+    """Per-bucket entry counts, sharded across the build pool when the
+    host has cores to spare (each shard counts its slice; the partials
+    sum) — single-core hosts take the plain bincount path."""
+    threads = parallel.pool_size()
+    n = len(h)
+    if threads <= 1 or n < (1 << 21):
+        return np.bincount(h, minlength=buckets)
+    shards = min(threads, 4)  # partials are buckets-wide: cap the memory
+    step = -(-n // shards)
+    parts = [None] * shards
+
+    def _count(i):
+        parts[i] = np.bincount(
+            h[i * step : min((i + 1) * step, n)], minlength=buckets
+        )
+
+    pool = parallel._get_pool(threads)
+    futs = [pool.submit(_count, i) for i in range(shards)]
+    for f in futs:
+        f.result()
+    out = parts[0]
+    for p in parts[1:]:
+        out += p
+    return out
+
+
+def _grouped_order(h: np.ndarray, buckets: int) -> np.ndarray:
+    """A permutation grouping entries by bucket id.
+
+    Bucket-CSR layout only needs entries GROUPED by bucket — order within
+    a bucket is free (lookups scan the whole bucket) — so this uses the
+    faster non-stable introsort, and on multi-core hosts partitions the
+    bucket space so each shard selects + sorts its own range
+    concurrently (concatenation preserves bucket grouping)."""
+    threads = parallel.pool_size()
+    n = len(h)
+    if threads <= 1 or n < (1 << 21):
+        return np.argsort(h)
+    shards = min(threads, 8)
+    bstep = -(-buckets // shards)
+    parts = [None] * shards
+
+    def _part(i):
+        lo, hi = np.uint32(i * bstep), np.uint32(min((i + 1) * bstep, buckets))
+        idx = np.flatnonzero((h >= lo) & (h < hi))
+        parts[i] = idx[np.argsort(h[idx])]
+
+    pool = parallel._get_pool(threads)
+    futs = [pool.submit(_part, i) for i in range(shards)]
+    for f in futs:
+        f.result()
+    return np.concatenate(parts)
+
+
 def build_table(
     key_a: np.ndarray,
     key_b: np.ndarray,
@@ -125,8 +182,11 @@ def build_table(
     consumer never recompiles.  If the content cannot satisfy the probe
     bound in the fixed bucket count (after the salt schedule) the build
     raises ``ValueError`` — the caller falls back to a full rebuild."""
-    key_a = np.asarray(key_a, np.int64)
-    key_b = np.asarray(key_b, np.int64)
+    # keys keep their native dtype: the mix only reads the low 32 bits and
+    # the entry columns store int32, so forcing int64 here was two full
+    # copy passes per table at the 10M-entry scale
+    key_a = np.asarray(key_a)
+    key_b = np.asarray(key_b)
     n = key_a.shape[0]
     if fixed_shape is not None:
         buckets = fixed_shape[0]
@@ -134,29 +194,52 @@ def build_table(
             raise ValueError(f"{n} entries exceed fixed cap {fixed_shape[1]}")
     else:
         buckets = _bucket_pow2(max(n if lean else 2 * n, 1), min_buckets)
+    # at lean 10M-entry load factors the max bucket sits above the probe
+    # TARGET for every salt (they all draw from the same distribution), so
+    # walking the schedule is mix+bincount passes over multi-GB arrays
+    # just to settle for salt 0's depth anyway — big tables take the first
+    # salt's achieved depth immediately (lookups pay ~1 extra probe round,
+    # measured ~free on-chip).  Small and fixed-shape tables keep the full
+    # schedule (there a lucky salt genuinely changes the shape/fit).
+    max_salts = (
+        len(_SALTS) if n <= (1 << 20) or fixed_shape is not None else 1
+    )
     salt_i = 0
     best = None  # flattest (max_bucket, salt_i, h, counts) seen
     probe_eff = probe
+    h = np.empty(n, np.uint32)
+    mask = np.uint32(buckets - 1)
     while True:
-        h = _mix_np(key_a, key_b, _SALTS[salt_i]) & np.uint32(buckets - 1)
-        counts = np.bincount(h.astype(np.int64), minlength=buckets)
+        def _hash(lo, hi, _s=_SALTS[salt_i]):
+            h[lo:hi] = _mix_np(key_a[lo:hi], key_b[lo:hi], _s) & mask
+        parallel.shard_apply(n, _hash)
+        counts = _bincount(h, buckets)
         top = int(counts.max()) if n else 0
         if n == 0 or top <= probe:
             probe_eff = max(top, 1)
             break
         if best is None or top < best[0]:
-            best = (top, salt_i, h, counts)
-        if salt_i + 1 < len(_SALTS):
+            best = (top, salt_i, counts)
+        if salt_i + 1 < max_salts:
             salt_i += 1
         elif fixed_shape is not None:
             raise ValueError(
                 f"no salt fits {n} entries in {buckets} buckets at probe {probe}"
             )
         else:
-            # salt schedule exhausted: settle for the flattest salt's
-            # actual bound — lookups pay extra probe rounds instead of the
-            # build paying bucket doubling (the 10M-scale projection cliff)
-            probe_eff, salt_i, h, counts = best
+            # salt walk done: settle for the flattest salt's actual bound —
+            # lookups pay extra probe rounds instead of the build paying
+            # bucket doubling (the 10M-scale projection cliff).  ``h`` is
+            # recomputed when a non-final salt won (it is reused in place
+            # between rounds).
+            probe_eff, best_i, counts = best
+            if best_i != salt_i:
+                salt_i = best_i
+
+                def _rehash(lo, hi, _s=_SALTS[salt_i]):
+                    h[lo:hi] = _mix_np(key_a[lo:hi], key_b[lo:hi], _s) & mask
+
+                parallel.shard_apply(n, _rehash)
             break
     if n <= 512 and fixed_shape is None:
         # pin the probe depth (== the pw array SHAPE) for small tables:
@@ -166,12 +249,22 @@ def build_table(
         # compile.  Costs at most probe-1 extra unrolled gather rounds on
         # tables this small; the 10M-scale adaptive depth is untouched.
         probe_eff = max(probe_eff, probe)
-    order = np.argsort(h, kind="stable") if n else np.zeros(0, np.int64)
+    order = _grouped_order(h, buckets) if n else np.zeros(0, np.int64)
     cap = fixed_shape[1] if fixed_shape is not None else _bucket_pow2(max(n, 1), 64)
-    ta = np.full(cap, -1, np.int32)
-    tb = np.full(cap, -1, np.int32)
-    ta[:n] = key_a[order]
-    tb[:n] = key_b[order]
+    # empty + range fills instead of full(-1) + overwrite: one write pass
+    # over the entry region instead of two (real at 10M+ rows), and the
+    # gather through ``order`` shards across cores when the host has them
+    ta = np.empty(cap, np.int32)
+    tb = np.empty(cap, np.int32)
+
+    def _fill(lo, hi):
+        seg = order[lo:hi]
+        ta[lo:hi] = key_a[seg]
+        tb[lo:hi] = key_b[seg]
+
+    parallel.shard_apply(n, _fill)
+    ta[n:] = -1
+    tb[n:] = -1
     ptr = np.zeros(buckets + 1, np.int32)
     np.cumsum(counts, out=ptr[1:])
     out = {
@@ -189,9 +282,132 @@ def build_table(
         ),
     }
     if val is not None:
-        tv = np.full(cap, -1, np.int32)
+        tv = np.empty(cap, np.int32)
         tv[:n] = np.asarray(val, np.int32)[order]
+        tv[n:] = -1
         out["val"] = tv
+    return out
+
+
+def splice_table(
+    t: Dict[str, np.ndarray],
+    rm_a: np.ndarray,
+    rm_b: np.ndarray,
+    add_a: np.ndarray,
+    add_b: np.ndarray,
+    add_val: Optional[np.ndarray] = None,
+    *,
+    val_remap: Optional[np.ndarray] = None,
+) -> Optional[Dict[str, np.ndarray]]:
+    """Incrementally edit a built table without re-hashing its entries.
+
+    Removes ONE entry per (rm_a, rm_b) key (duplicate keys remove distinct
+    entries), inserts the add keys into their buckets, and optionally maps
+    every surviving payload through ``val_remap`` (int32 gather — the fold
+    renumbers node ids).  The salt, bucket count, capacity and probe-depth
+    (``pw``) shapes are all preserved, so a spliced table re-ships to the
+    device without changing the jitted program's pytree.
+
+    Returns None when the edit cannot keep that shape contract — more
+    entries than capacity, a bucket growing past the recorded probe
+    rounds, or a removal key that is not resident (inconsistent caller
+    bookkeeping).  The caller falls back to a full ``build_table``.
+    """
+    salt_i = int(t["meta"][0])
+    mask = np.uint32(int(t["meta"][1]))
+    buckets = int(mask) + 1
+    cap = len(t["key_a"])
+    pw = t["pw"].shape[0]
+    ptr = t["ptr"]
+    n_old = int(ptr[-1])
+    n_rm, n_add = len(rm_a), len(add_a)
+    n_new = n_old - n_rm + n_add
+    if n_new > cap:
+        return None
+    salt = _SALTS[salt_i]
+    ka, kb = t["key_a"], t["key_b"]
+
+    if n_rm:
+        h_rm = (
+            _mix_np(np.asarray(rm_a), np.asarray(rm_b), salt) & mask
+        ).astype(np.int64)
+        del_pos = np.empty(n_rm, np.int64)
+        used: set = set()
+        rm_a_l = np.asarray(rm_a).tolist()
+        rm_b_l = np.asarray(rm_b).tolist()
+        for i in range(n_rm):
+            b = int(h_rm[i])
+            found = -1
+            for j in range(int(ptr[b]), int(ptr[b + 1])):
+                if j not in used and ka[j] == rm_a_l[i] and kb[j] == rm_b_l[i]:
+                    found = j
+                    break
+            if found < 0:
+                return None
+            used.add(found)
+            del_pos[i] = found
+        del_per_bucket = np.bincount(h_rm, minlength=buckets)
+    else:
+        del_pos = np.zeros(0, np.int64)
+        del_per_bucket = np.zeros(buckets, np.int64)
+
+    if n_add:
+        h_add = (
+            _mix_np(np.asarray(add_a), np.asarray(add_b), salt) & mask
+        ).astype(np.int64)
+        add_per_bucket = np.bincount(h_add, minlength=buckets)
+    else:
+        h_add = np.zeros(0, np.int64)
+        add_per_bucket = np.zeros(buckets, np.int64)
+
+    counts_new = np.diff(ptr.astype(np.int64)) - del_per_bucket + add_per_bucket
+    if n_new and int(counts_new.max()) > pw:
+        return None
+
+    body_sel = np.ones(n_old, bool)
+    body_sel[del_pos] = False
+    cum_del = np.zeros(buckets + 1, np.int64)
+    np.cumsum(del_per_bucket, out=cum_del[1:])
+    ptr_mid = ptr.astype(np.int64) - cum_del
+    # insert each add at its bucket's (post-delete) start; order within a
+    # bucket is free — lookups scan the whole bucket
+    order = np.argsort(h_add, kind="stable")
+    ins_pos = ptr_mid[h_add[order]]
+    a_body = np.insert(ka[:n_old][body_sel], ins_pos,
+                       np.asarray(add_a, np.int32)[order])
+    b_body = np.insert(kb[:n_old][body_sel], ins_pos,
+                       np.asarray(add_b, np.int32)[order])
+    cum_add = np.zeros(buckets + 1, np.int64)
+    np.cumsum(add_per_bucket, out=cum_add[1:])
+    ptr_new = (ptr_mid + cum_add).astype(np.int32)
+
+    out_a = np.empty(cap, np.int32)
+    out_a[:n_new] = a_body
+    out_a[n_new:] = -1
+    out_b = np.empty(cap, np.int32)
+    out_b[:n_new] = b_body
+    out_b[n_new:] = -1
+    out = {
+        "ptr": ptr_new,
+        "key_a": out_a,
+        "key_b": out_b,
+        "meta": t["meta"],
+        "pw": t["pw"],
+    }
+    tv = t.get("val")
+    if tv is not None:
+        v_body = tv[:n_old][body_sel]
+        if val_remap is not None:
+            v_body = val_remap[v_body]
+        v_ins = (
+            np.asarray(add_val, np.int32)[order]
+            if add_val is not None else np.full(n_add, -1, np.int32)
+        )
+        v_body = np.insert(v_body, ins_pos, v_ins)
+        out_v = np.empty(cap, np.int32)
+        out_v[:n_new] = v_body
+        out_v[n_new:] = -1
+        out["val"] = out_v
     return out
 
 

@@ -295,11 +295,10 @@ def build_snapshot(
     epad = _bucket(n_edges)
     mpad = _bucket(n_tuples)
 
-    node_hi = np.full(npad, _I32MAX, np.int32)
-    node_lo = np.full(npad, _I32MAX, np.int32)
-    if n_nodes:
-        node_hi[:n_nodes] = [k[0] for k in uniq]
-        node_lo[:n_nodes] = [k[1] for k in uniq]
+    # node_hi/node_lo and the sorted membership columns stay host-side
+    # (checkpointing + overlay binary searches) — exact length, no padding
+    node_hi = np.asarray([k[0] for k in uniq], np.int32)
+    node_lo = np.asarray([k[1] for k in uniq], np.int32)
 
     row_ptr = np.zeros(npad + 1, np.int32)
     edge_ns = np.full(epad, -1, np.int32)
@@ -314,13 +313,10 @@ def build_snapshot(
             e += 1
     row_ptr[n_nodes:] = e
 
-    mem_node = np.full(mpad, _I32MAX, np.int32)
-    mem_subj = np.full(mpad, _I32MAX, np.int32)
-    if n_tuples:
-        mem_node[:n_tuples] = [p[0] for p in pairs]
-        mem_subj[:n_tuples] = [p[1] for p in pairs]
+    mem_node = np.asarray([p[0] for p in pairs], np.int32)
+    mem_subj = np.asarray([p[1] for p in pairs], np.int32)
     mem_row_ptr = np.searchsorted(
-        mem_node[:n_tuples], np.arange(npad + 1)
+        mem_node, np.arange(npad + 1)
     ).astype(np.int32)
     # insertion-ordered member list per node (tuples iterate in seq order)
     mem_ord_subj = np.full(mpad, -1, np.int32)

@@ -139,6 +139,11 @@ def config_fingerprint(manager: Optional[NamespaceManager]) -> int:
 class DeviceCheckEngine:
     """Batched permission checks on the device, oracle fallback on the host."""
 
+    # the mesh engine opts out of both: its device state is per-shard
+    # stacks with their own publish discipline
+    supports_fold = True
+    supports_background_compaction = True
+
     def __init__(
         self,
         store: InMemoryTupleStore,
@@ -159,6 +164,7 @@ class DeviceCheckEngine:
         metrics=None,
         leopard: Optional[dict] = None,
         result_cache=None,
+        compaction: Optional[dict] = None,
     ):
         self.store = store
         self.namespace_manager = namespace_manager
@@ -273,6 +279,44 @@ class DeviceCheckEngine:
         # BENCH_r05 cliff class and warns loudly (ketotpu/compilewatch.py)
         self._clean_dispatches = 0
         self.warm_after_clean = 2
+        # -- incremental fold + off-path compaction (engine/delta.py) -------
+        # the overlay's escape hatch used to be a blocking full rebuild
+        # (136s-class at 10M tuples).  Two cheaper tiers now sit in front:
+        # an incremental CSR fold of the accumulated changelog slice, and
+        # (opt-in) a background compactor that builds the next generation
+        # off the serving path and publishes it with a pointer swap.
+        ccfg = dict(compaction or {})
+        self.fold_enabled = (
+            bool(ccfg.get("fold", True)) and self.supports_fold
+        )
+        self.compaction_background = (
+            bool(ccfg.get("background", False))
+            and self.supports_background_compaction
+        )
+        self.fold_max_pairs = int(ccfg.get("fold_max_pairs", 200_000))
+        self.compact_rounds = int(ccfg.get("catchup_rounds", 8))
+        # ordered changelog entries drained since the snapshot the engine
+        # serves was built (the fold input); None once the slice outgrew
+        # fold_max_pairs — folds are then off until the next full build
+        self._since_base: Optional[list] = []
+        # background mode only: drained changes the overlay could NOT
+        # absorb — serving stays on the stale view (the served cursor lags)
+        # until the compactor publishes a generation that covers them
+        self._pending: list = []
+        # cursor the SERVING state (snapshot + overlay) covers; equals
+        # _log_cursor except while background pending exists
+        self._served_cursor = 0
+        self._snap_cursor = 0  # store cursor the base snapshot was built at
+        # generation bookkeeping: the token invalidates in-flight compactor
+        # results when a sync rebuild wins the race
+        self._gen_token = 0
+        self._compact_thread: Optional[threading.Thread] = None
+        self.generation = 0  # observability: snapshot generations published
+        self.folds = 0  # observability: incremental CSR folds
+        self.compactions = 0  # observability: background generation swaps
+        self.compaction_errors = 0  # worker failures (served view unaffected)
+        self.last_compaction_mode = "none"  # fold | rebuild | none
+        self.last_build_phases: dict = {}  # per-phase seconds of last build
 
     def _phase(self, name: str, dt: float) -> None:
         self.phase_seconds[name] = self.phase_seconds.get(name, 0.0) + dt
@@ -362,6 +406,7 @@ class DeviceCheckEngine:
 
     def _rebuild(self, fingerprint: int) -> None:
         t0 = time.perf_counter()
+        ph: dict = {}
         self._sync_cols()
         self._cols.compact()
         self._snap = dl.build_snapshot_cols(
@@ -369,21 +414,41 @@ class DeviceCheckEngine:
             self.namespace_manager,
             strict=self.strict_mode,
             version=self.store.version,
+            phases=ph,
         )
         self.projection_build_s = time.perf_counter() - t0
         self._snap_fingerprint = fingerprint
         self._overlay = dl.OverlayState()
         self._overlay_active = False
+        old_shapes = self._array_shapes(self._device_arrays)
         t0 = time.perf_counter()
         self._install_device_arrays()
         jax.block_until_ready(jax.tree_util.tree_leaves(self._device_arrays))
         self.projection_upload_s = time.perf_counter() - t0
         self.rebuilds += 1
-        self._gen_sched_cache.clear()  # new graph, re-adapt once
-        # new shapes may legitimately compile after a rebuild — the warm
-        # alarm re-arms once dispatches run clean again
-        self._clean_dispatches = 0
-        compilewatch.get().declare_cold("snapshot rebuild")
+        self.generation += 1
+        self._gen_token += 1  # any in-flight compactor result is now stale
+        self._snap_cursor = self._log_cursor
+        self._served_cursor = self._log_cursor
+        self._since_base = []
+        self._pending = []
+        self.last_compaction_mode = "rebuild"
+        self._projection_phases(ph)
+        new_shapes = self._array_shapes(self._device_arrays)
+        if (
+            old_shapes is not None and new_shapes is not None
+            and new_shapes == old_shapes
+        ):
+            # same-shape regeneration: every jitted program still fits —
+            # keep the schedule cache and do NOT re-arm the compile
+            # observatory (a compile after this swap is a real regression)
+            pass
+        else:
+            self._gen_sched_cache.clear()  # new graph, re-adapt once
+            # new shapes may legitimately compile after a rebuild — the warm
+            # alarm re-arms once dispatches run clean again
+            self._clean_dispatches = 0
+            compilewatch.get().declare_cold("snapshot rebuild")
         self._install_leopard()
         if self.checkpoint_path:
             from ketotpu.engine import checkpoint as ckpt
@@ -481,12 +546,32 @@ class DeviceCheckEngine:
                 for op, t in changes:
                     self._cols.apply(op, t)
             self._log_cursor = head
-            if not self._overlay_apply(changes):
-                self._rebuild(fingerprint)
-                return self._snap
-            self._overlay_active = True
-            self.overlay_applies += 1
+            self._note_since_base(changes)
+            # the closure index folds eagerly at drain time in both modes:
+            # it is maintained against the mirror, not the snapshot
+            # generation, and answering fresher than the served cursor is
+            # always legal (staleness bounds are lower bounds)
             self._leopard_fold(changes)
+            if self.compaction_background:
+                self._pending.extend(changes)
+                if self._absorb_pending():
+                    self.overlay_applies += 1
+                else:
+                    self._kick_compactor()
+            else:
+                if self._overlay_apply(changes):
+                    self._overlay_active = True
+                    self.overlay_applies += 1
+                    self._served_cursor = self._log_cursor
+                elif not self._fold_locked(fingerprint):
+                    self._rebuild(fingerprint)
+        elif (
+            self.compaction_background and self._pending
+            and not self._compactor_alive()
+        ):
+            # un-absorbed writes with no compactor in flight (a previous
+            # round gave up or died): any read re-kicks the catch-up
+            self._kick_compactor()
         return self._snap
 
     def _overlay_apply(self, changes) -> bool:
@@ -512,6 +597,305 @@ class DeviceCheckEngine:
         self._device_arrays = dict(self._base_device, **jax.device_put(ov))
         return True
 
+    # -- incremental fold + off-path compaction ------------------------------
+
+    @staticmethod
+    def _array_shapes(d) -> Optional[dict]:
+        """Shape+dtype signature of a device dict: the generation-swap
+        referee.  Equal signatures mean every jitted program's pytree is
+        unchanged and the swap must not re-arm the compile observatory."""
+        if d is None:
+            return None
+        return {
+            k: (tuple(getattr(v, "shape", ())), str(getattr(v, "dtype", "")))
+            for k, v in d.items()
+        }
+
+    def _projection_phases(self, ph: dict) -> None:
+        """File per-phase build/fold seconds into the engine phase
+        accumulators and the keto_projection_phase_seconds histogram."""
+        out = {}
+        for k, v in ph.items():
+            key = k if k.startswith("fold_") else f"build_{k}"
+            out[key] = v
+            self._phase(key, v)
+            if self.metrics is not None:
+                self.metrics.observe(
+                    "keto_projection_phase_seconds", v,
+                    help="projection build/fold phase wall time", phase=key,
+                )
+        self.last_build_phases = out
+
+    def _note_since_base(self, changes) -> None:
+        """Accumulate the drained slice for the fold path; a slice past the
+        fold budget can no longer fold and is dropped (folds stay off until
+        the next full build resets the base)."""
+        if self._since_base is None:
+            return
+        self._since_base.extend(changes)
+        if len(self._since_base) > self.fold_max_pairs:
+            self._since_base = None
+
+    def _absorb_pending(self) -> bool:
+        """Copy-on-write overlay absorb of the whole pending slice.  The
+        live overlay never observes a partial application: on any failure
+        (reject, thresholds, table overflow) serving continues on the
+        current view unchanged and the compactor takes over."""
+        if self._base_device is None:
+            return False
+        if not self._pending:
+            self._served_cursor = self._log_cursor
+            return True
+        ov = dl.OverlayState(
+            pair_net=dict(self._overlay.pair_net),
+            new_nodes=dict(self._overlay.new_nodes),
+            dirty_nodes=set(self._overlay.dirty_nodes),
+        )
+        try:
+            dl.apply_changes(ov, self._snap, self._vocab, self._pending)
+        except (dl.OverlayRejected, ValueError):
+            return False
+        pairs, dirty = ov.size()
+        if pairs > self.max_overlay_pairs or dirty > self.max_overlay_dirty:
+            return False
+        try:
+            arrs = dl.overlay_arrays(
+                ov, self._snap, pair_cap=self.max_overlay_pairs
+            )
+        except ValueError:  # fixed-shape table could not fit the content
+            return False
+        self._overlay = ov
+        self._device_arrays = dict(
+            self._base_device, **jax.device_put(arrs)
+        )
+        self._overlay_active = True
+        self._pending = []
+        self._served_cursor = self._log_cursor
+        return True
+
+    def _fold_locked(self, fingerprint: int) -> bool:
+        """Second tier of the sync write path: fold the accumulated
+        changelog slice into the base snapshot instead of re-projecting all
+        N tuples.  All device shapes are preserved by construction (the
+        fold rejects pad crossings), so the swap is recompile-free; only a
+        hash table that outgrew its capacity inside the fold changes shape,
+        and the observatory is re-armed exactly then."""
+        if not self.fold_enabled or not self._since_base:
+            return False  # no fold input (or the slice outgrew the budget)
+        ph: dict = {}
+        t0 = time.perf_counter()
+        try:
+            snap = dl.fold_snapshot_cols(
+                self._snap, self._vocab, self._since_base,
+                version=self.store.version, phases=ph,
+            )
+        except dl.FoldRejected:
+            return False
+        self.projection_build_s = time.perf_counter() - t0
+        old_shapes = self._array_shapes(self._device_arrays)
+        self._snap = snap
+        self._snap_fingerprint = fingerprint
+        self._snap_cursor = self._log_cursor
+        self._since_base = []
+        self._pending = []
+        self._overlay = dl.OverlayState()
+        self._overlay_active = False
+        t0 = time.perf_counter()
+        self._install_device_arrays()
+        jax.block_until_ready(jax.tree_util.tree_leaves(self._device_arrays))
+        self.projection_upload_s = time.perf_counter() - t0
+        self.generation += 1
+        self._gen_token += 1
+        self.folds += 1
+        self.last_compaction_mode = "fold"
+        self._projection_phases(ph)
+        new_shapes = self._array_shapes(self._device_arrays)
+        if old_shapes is None or new_shapes != old_shapes:
+            self._gen_sched_cache.clear()
+            self._clean_dispatches = 0
+            compilewatch.get().declare_cold(
+                "projection fold: device shapes changed"
+            )
+        self._served_cursor = self._log_cursor
+        return True
+
+    def _compactor_alive(self) -> bool:
+        t = self._compact_thread
+        return t is not None and t.is_alive()
+
+    def _kick_compactor(self) -> None:
+        if self._compactor_alive():
+            return
+        t = threading.Thread(
+            target=self._compact_worker, args=(self._gen_token,),
+            name="keto-compactor", daemon=True,
+        )
+        self._compact_thread = t
+        t.start()
+
+    def _compact_worker(self, token: int) -> None:
+        """Off-path generation builder.  Pins the inputs under the sync
+        lock, builds (fold-else-rebuild) and ships to the device with the
+        lock RELEASED — checks keep serving the old generation + overlay —
+        then re-takes the lock only for the pointer swap.  A sync rebuild
+        racing ahead bumps the generation token and the stale result is
+        discarded at the swap gate."""
+        try:
+            for _ in range(max(1, self.compact_rounds)):
+                with self._sync_lock:
+                    if token != self._gen_token or self._snap is None:
+                        return
+                    snap = self._snap
+                    fingerprint = self._snap_fingerprint
+                    since = (
+                        list(self._since_base)
+                        if self._since_base is not None else None
+                    )
+                    pin_cursor = self._log_cursor
+                    version = self.store.version
+                    frozen = (
+                        self._cols.freeze() if self._cols is not None
+                        else None
+                    )
+                # -- build off-lock ----------------------------------------
+                ph: dict = {}
+                t0 = time.perf_counter()
+                mode = "fold"
+                new_snap = None
+                if self.fold_enabled and since:
+                    try:
+                        new_snap = dl.fold_snapshot_cols(
+                            snap, self._vocab, since,
+                            version=version, phases=ph,
+                        )
+                    except dl.FoldRejected:
+                        new_snap = None
+                if new_snap is None:
+                    if frozen is None:
+                        # no mirror to rebuild from (post-checkpoint-resume
+                        # boot): fall back to the blocking path once
+                        with self._sync_lock:
+                            if token == self._gen_token:
+                                self._rebuild(fingerprint)
+                        return
+                    mode = "rebuild"
+                    new_snap = dl.build_snapshot_cols(
+                        frozen, self.namespace_manager,
+                        strict=self.strict_mode,
+                        version=version, phases=ph,
+                    )
+                build_s = time.perf_counter() - t0
+                t0 = time.perf_counter()
+                base = jax.device_put(new_snap.check_arrays())
+                empty_ov = jax.device_put(
+                    dl.overlay_arrays(
+                        dl.OverlayState(), new_snap,
+                        pair_cap=self.max_overlay_pairs,
+                    )
+                )
+                jax.block_until_ready(jax.tree_util.tree_leaves(base))
+                upload_s = time.perf_counter() - t0
+                # -- swap under the lock -----------------------------------
+                with self._sync_lock:
+                    if token != self._gen_token:
+                        return  # a sync rebuild won the race
+                    residual, head = self.store.changes_since(pin_cursor)
+                    if residual is None:
+                        return  # changelog overflow: next drain rebuilds
+                    # drain any store tail the serving path hasn't seen yet,
+                    # so mirror/leopard/cursor state stays single-writer
+                    tail = residual[self._log_cursor - pin_cursor:]
+                    if tail:
+                        if self._cols is not None:
+                            for op, t in tail:
+                                self._cols.apply(op, t)
+                        self._log_cursor = head
+                        self._note_since_base(tail)
+                        self._leopard_fold(tail)
+                    old_shapes = self._array_shapes(self._device_arrays)
+                    self._snap = new_snap
+                    self._snap_fingerprint = fingerprint
+                    self._snap_cursor = pin_cursor
+                    self._since_base = list(residual)
+                    self._overlay = dl.OverlayState()
+                    self._overlay_active = False
+                    self._base_device = base
+                    self._device_arrays = dict(base, **empty_ov)
+                    self._expand_extra = None
+                    self._pending = list(residual)
+                    self._served_cursor = pin_cursor
+                    self.projection_build_s = build_s
+                    self.projection_upload_s = upload_s
+                    self.generation += 1
+                    self.compactions += 1
+                    if mode == "fold":
+                        self.folds += 1
+                    else:
+                        self.rebuilds += 1
+                    self.last_compaction_mode = mode
+                    self._projection_phases(ph)
+                    new_shapes = self._array_shapes(self._device_arrays)
+                    if old_shapes is None or new_shapes != old_shapes:
+                        self._gen_sched_cache.clear()
+                        self._clean_dispatches = 0
+                        compilewatch.get().declare_cold(
+                            "generation swap: device shapes changed"
+                        )
+                    if self._absorb_pending():
+                        return  # caught up: overlay covers the residual
+                    # residual too large/unrepresentable: loop — the next
+                    # round folds it into the generation just published
+        except Exception:  # noqa: BLE001 - serving view must stay intact
+            self.compaction_errors += 1
+
+    def close(self) -> None:
+        """Stop the background compactor (in-flight results are discarded
+        at the swap gate)."""
+        t = self._compact_thread
+        if t is not None and t.is_alive():
+            with self._sync_lock:
+                self._gen_token += 1
+            t.join(timeout=10.0)
+
+    def projection_stats(self) -> dict:
+        """Projection/compaction state for status --debug, the flight
+        recorder, and the metrics gauges — one consistent read."""
+        with self._sync_lock:
+            pairs, dirty = (
+                self._overlay.size() if self._overlay is not None else (0, 0)
+            )
+            return {
+                "generation": self.generation,
+                "rebuilds": self.rebuilds,
+                "folds": self.folds,
+                "compactions": self.compactions,
+                "compaction_errors": self.compaction_errors,
+                "last_compaction_mode": self.last_compaction_mode,
+                "background": self.compaction_background,
+                "fold_enabled": self.fold_enabled,
+                "compaction_in_flight": self._compactor_alive(),
+                "overlay_active": self._overlay_active,
+                "overlay_pairs": pairs,
+                "overlay_dirty": dirty,
+                "overlay_pair_cap": self.max_overlay_pairs,
+                "overlay_dirty_cap": self.max_overlay_dirty,
+                "pending_changes": len(self._pending),
+                "since_base": (
+                    len(self._since_base)
+                    if self._since_base is not None else -1
+                ),
+                "fold_max_pairs": self.fold_max_pairs,
+                "snap_cursor": self._snap_cursor,
+                "served_cursor": self._served_cursor,
+                "log_cursor": self._log_cursor,
+                "projection_build_s": round(self.projection_build_s, 6),
+                "projection_upload_s": round(self.projection_upload_s, 6),
+                "build_phases": {
+                    k: round(v, 6)
+                    for k, v in self.last_build_phases.items()
+                },
+            }
+
     def _sync_view(self):
         """Atomic (snapshot, device_arrays, overlay_active, cursor) view.
         Writers mutate all of these together under ``_sync_lock``, so a
@@ -523,8 +907,12 @@ class DeviceCheckEngine:
         it is exactly the state the verdicts will describe, never newer."""
         with self._sync_lock:
             snap = self._snapshot_locked()
+            # the SERVED cursor, not the drain cursor: under background
+            # compaction the drain can run ahead of what the device view
+            # covers, and cache entries must be stamped with what the
+            # verdicts actually describe
             return (snap, self._device_arrays, self._overlay_active,
-                    self._log_cursor)
+                    self._served_cursor)
 
     def refresh(self) -> None:
         """Force a full rebuild (the CheckRequest.latest consistency knob —
@@ -536,9 +924,12 @@ class DeviceCheckEngine:
         """Drained changelog cursor(s) for the freshness barrier
         (ketotpu/consistency/barrier.py): the serving state covers every
         store delta at positions <= the cursor.  One entry here; the mesh
-        engine overrides with a per-shard vector."""
+        engine overrides with a per-shard vector.  Under background
+        compaction this lags the drain cursor while un-absorbed writes
+        wait on the compactor — the barrier then bound-waits on the
+        changelog position, never on a rebuild."""
         with self._sync_lock:
-            return (self._log_cursor,)
+            return (self._served_cursor,)
 
     # -- checkpoint / resume (SURVEY §5.4) ----------------------------------
 
@@ -552,7 +943,7 @@ class DeviceCheckEngine:
 
         with self._sync_lock:
             snap = self._snapshot_locked()
-            if self._overlay_active:
+            if self._overlay_active or self._pending:
                 self.refresh()
                 snap = self._snap
             # stamp the fingerprint the snapshot was BUILT under, not a
@@ -589,6 +980,12 @@ class DeviceCheckEngine:
             self._vocab = snap.vocab
             self._cols = None  # lazily re-mirrored on the next full rebuild
             self._log_cursor = log_head
+            self._served_cursor = log_head
+            self._snap_cursor = log_head
+            self._since_base = []
+            self._pending = []
+            self._gen_token += 1
+            self.generation += 1
             self._overlay = dl.OverlayState()
             self._overlay_active = False
             # no column mirror to build the closure from: the index stays

@@ -53,6 +53,12 @@ from ketotpu.parallel.mesh import make_mesh
 class MeshCheckEngine(DeviceCheckEngine):
     """Graph-sharded batched checks; oracle fallback on the host."""
 
+    # sharded stacks have their own publish discipline: writes route to
+    # per-shard overlays and the escape hatch stays the sharded rebuild —
+    # no base-engine fold or background generation swap
+    supports_fold = False
+    supports_background_compaction = False
+
     def __init__(
         self,
         store,

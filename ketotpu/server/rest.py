@@ -65,7 +65,7 @@ _ADMISSION_EXEMPT = {
     # while shedding traffic would blind the operator exactly when the
     # surfaces matter most
     "/debug/flight-recorder", "/debug/waves", "/debug/compiles",
-    "/debug/profile",
+    "/debug/profile", "/debug/projection",
 }
 
 # REST paths that get the full stage decomposition (flightrec context);
@@ -713,6 +713,9 @@ def metrics_router(registry) -> Router:
         return 200, {
             "slowest": rec.snapshot(),
             "hot_keys": rc.hot_keys() if rc is not None else [],
+            # a slow-check investigation usually starts with "was a
+            # compaction in flight?" — ride the projection state along
+            "projection": registry.projection_stats(),
         }
 
     rt.add("GET", "/debug/flight-recorder", get_flight_recorder)
@@ -744,6 +747,15 @@ def metrics_router(registry) -> Router:
         return 200, registry.compile_watch().snapshot()
 
     rt.add("GET", "/debug/compiles", get_compiles)
+
+    def get_projection(req):
+        # projection/compaction observability (engine/tpu.py): snapshot
+        # generation, fold/rebuild/compaction counters, overlay occupancy
+        # and the cursor triple (snap <= served <= log); {} when the
+        # engine kind has no device projection
+        return 200, registry.projection_stats()
+
+    rt.add("GET", "/debug/projection", get_projection)
 
     def post_profile(req):
         # on-demand jax.profiler capture: config-gated (403 unarmed),

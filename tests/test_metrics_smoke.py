@@ -137,6 +137,7 @@ def scrape(server):
     return {
         "metrics_text": get(f"{metrics}/metrics/prometheus"),
         "flight": json.loads(get(f"{metrics}/debug/flight-recorder")),
+        "projection": json.loads(get(f"{metrics}/debug/projection")),
     }
 
 
@@ -198,3 +199,28 @@ def test_batch_and_wire_metric_vocabulary(scrape):
     for d in ("tx", "rx"):
         assert f'keto_wire_bytes_total{{dir="{d}"}}' in text, d
     assert 'keto_wire_calls_total{op="check"}' in text
+
+
+def test_projection_metric_vocabulary(scrape):
+    """ISSUE 8: projection/compaction observability — generation and
+    fold/rebuild/compaction counters as gauges, per-phase build seconds,
+    overlay occupancy, and the /debug/projection state endpoint."""
+    text = scrape["metrics_text"]
+    for g in (
+        "keto_projection_generation",
+        "keto_projection_rebuilds_total",
+        "keto_projection_folds_total",
+        "keto_projection_compactions_total",
+        "keto_projection_compaction_errors_total",
+        "keto_projection_compaction_in_flight",
+        "keto_projection_pending_changes",
+        "keto_projection_overlay_pairs",
+        "keto_projection_overlay_occupancy",
+        "keto_projection_phase_seconds",
+    ):
+        assert g in text, g
+    proj = scrape["projection"]
+    assert proj["generation"] >= 1
+    assert proj["rebuilds"] >= 1  # the boot projection
+    assert proj["served_cursor"] == proj["log_cursor"]
+    assert "build_phases" in proj and proj["build_phases"]
