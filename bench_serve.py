@@ -358,6 +358,14 @@ def run_batch_bench(
                 host, port, bodies,
                 concurrency=concurrency, duration=duration, batch_size=bs,
             )
+        # concurrency-1024 point at the mid batch size: the async loop
+        # holds 1024 sockets as file descriptors, so this probes whether
+        # the columnar path's throughput holds past the standard
+        # concurrency rather than queueing collapse
+        c1024 = _hammer_rest_batch(
+            host, port, [body_for(o * 97, 512) for o in range(8)],
+            concurrency=1024, duration=duration, batch_size=512,
+        )
         wstats = reg.wave_ledger().stats()
         eng = reg.check_engine()
         mid = per_size.get("512") or per_size[str(batch_sizes[0])]
@@ -370,7 +378,9 @@ def run_batch_bench(
             "serve_batch_verdict_divergence": divergence,
             "serve_batch_errors": sum(
                 v["errors"] for v in per_size.values()
-            ),
+            ) + c1024["errors"],
+            "serve_batch_c1024": c1024,
+            "serve_batch_c1024_checks_per_sec": c1024["checks_per_sec"],
             "serve_batch_ingested": int(getattr(eng, "batch_ingested", 0)),
             "serve_batch_wave_size_mean": wstats.get("wave_size_mean", 0),
             "serve_batch_wave_size_p95": wstats.get("wave_size_p95", 0),
@@ -380,6 +390,12 @@ def run_batch_bench(
             "serve_batch_hammer_compiles": (
                 compilewatch.get().compiles_total - compiles_before
             ),
+            # columnar stage decomposition (decode / encode_ids /
+            # wave_wait / respond ride keto_rpc_stage_seconds{op=check})
+            "serve_batch_stage_ms": _scrape_means(
+                reg.metrics(), "keto_rpc_stage_seconds", ("op", "stage")
+            ),
+            "serve_batch_block_waves": int(getattr(eng, "block_waves", 0)),
         }
     finally:
         srv.stop(grace=2.0)

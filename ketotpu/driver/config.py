@@ -124,6 +124,15 @@ def _defaults() -> Dict[str, Any]:
             # larger batches go straight to the device engine.  0 disables
             # batch ingestion (batches always pass through).
             "coalesce_batch_max": 256,
+            # columnar batch serving (engine/columns.py): batch check
+            # endpoints decode straight into string columns, bulk-encode
+            # ids, and answer through the engine's block surface.  false
+            # restores the per-item scalar path (parity/debug escape).
+            "columnar_batch": True,
+            # overlap host pack/encode of wave N+1 with device execution
+            # of wave N (engine/coalesce.py double-buffered dispatch);
+            # false serves each wave on the collector thread
+            "coalesce_pipeline": True,
             # worker-wire payloads at or above this many bytes ride a
             # shared-memory segment instead of the unix socket
             # (server/wire.py); 0 keeps everything on the socket
@@ -300,6 +309,7 @@ class Provider:
             for known in ("max_read_depth", "max_read_width", "mesh_devices",
                           "mesh_axis", "max_batch", "retry_scale",
                           "coalesce_ms", "coalesce_batch_max",
+                          "columnar_batch", "coalesce_pipeline",
                           "wire_shm_threshold", "experimental_strict_mode",
                           "max_inflight", "request_timeout_ms",
                           "sniff_timeout_ms", "accept_backlog",
@@ -514,7 +524,8 @@ class Provider:
             val = self.get(key)
             if not isinstance(val, int) or val < 1:
                 raise ConfigError(key, f"must be a positive integer, got {val!r}")
-        for key in ("engine.compaction.fold", "engine.compaction.background"):
+        for key in ("engine.compaction.fold", "engine.compaction.background",
+                    "engine.columnar_batch", "engine.coalesce_pipeline"):
             val = self.get(key)
             if not isinstance(val, bool):
                 raise ConfigError(key, f"must be a boolean, got {val!r}")

@@ -580,6 +580,11 @@ class Registry:
                             cache=self.result_cache(),
                             metrics=self.metrics(),
                             ledger=self.wave_ledger(),
+                            pipeline=bool(
+                                self.config.get(
+                                    "engine.coalesce_pipeline", True
+                                )
+                            ),
                         )
                         if ms > 0 else dev
                     )

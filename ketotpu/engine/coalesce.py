@@ -20,9 +20,12 @@ surface.
 from __future__ import annotations
 
 import contextlib
+import queue
 import threading
 import time
-from typing import List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
 
 from ketotpu import deadline, flightrec
 from ketotpu.api.types import (
@@ -33,6 +36,7 @@ from ketotpu.api.types import (
 )
 from ketotpu.cache import check_key as cache_check_key
 from ketotpu.cache import context as cache_context
+from ketotpu.engine import columns as colmod
 
 
 class _Slot:
@@ -55,6 +59,31 @@ class _Slot:
         self.followers = 0
 
 
+class _ColumnGroup:
+    """One whole columnar batch riding the wave as a single slot-group:
+    ONE event for the batch, verdicts come back as a bool array and typed
+    per-item errors as a row-indexed dict (engine/columns.py contract) —
+    no per-item futures, no per-item Python objects."""
+
+    __slots__ = ("block", "depth", "bypass", "event", "verdicts", "errors",
+                 "error", "t_enq", "t_dispatch", "wave", "traceparent",
+                 "followers")
+
+    def __init__(self, block, depth: int, bypass: bool = False):
+        self.block = block
+        self.depth = depth
+        self.bypass = bypass
+        self.event = threading.Event()
+        self.verdicts: Optional[np.ndarray] = None
+        self.errors: Dict[int, KetoAPIError] = {}
+        self.error: Optional[BaseException] = None
+        self.t_enq = time.perf_counter()
+        self.t_dispatch: Optional[float] = None
+        self.wave: Optional[int] = None
+        self.traceparent: Optional[str] = None
+        self.followers = 0  # groups never singleflight; ledger parity
+
+
 class CoalescingEngine:
     """check_is_member batching facade over a (device) check engine."""
 
@@ -62,7 +91,8 @@ class CoalescingEngine:
                  max_pending: int = 4096,
                  batch_max: int = 0,
                  default_timeout: float = 30.0,
-                 cache=None, metrics=None, ledger=None):
+                 cache=None, metrics=None, ledger=None,
+                 pipeline: bool = True):
         self.inner = inner
         self.window = window
         self.max_pending = max_pending
@@ -95,10 +125,26 @@ class CoalescingEngine:
         self.singleflight_collapsed = 0  # observability: follower joins
         self.cache_hits = 0  # observability: checks served pre-admission
         self.batch_ingested = 0  # observability: batch items ridden on waves
+        self.block_waves = 0  # observability: waves carrying column groups
+        # double-buffered dispatch: the collector thread cuts wave N+1 and
+        # does its host-side prep (grouping, merged-block build, vocab
+        # pre-encode) WHILE the dispatcher thread drives wave N through
+        # the device — host encode time leaves the wave cadence.  The
+        # depth-1 queue is the pair of staging buffers: one wave in
+        # flight, one staged.
+        self._stage: Optional[queue.Queue] = (
+            queue.Queue(maxsize=1) if pipeline else None
+        )
         self._worker = threading.Thread(
             target=self._run, name="keto-coalescer", daemon=True
         )
         self._worker.start()
+        if self._stage is not None:
+            self._dispatcher = threading.Thread(
+                target=self._run_dispatch, name="keto-wave-dispatch",
+                daemon=True,
+            )
+            self._dispatcher.start()
 
     # -- engine surface ------------------------------------------------------
 
@@ -297,6 +343,63 @@ class CoalescingEngine:
             flightrec.note(wave=wave_id)
         return [bool(v) for v in results]
 
+    def check_block(self, block, rest_depth: int = 0):
+        """Columnar batch admission: the whole block joins the wave as ONE
+        slot-group and the caller blocks on one event.  Returns
+        ``(verdicts bool array, {row: KetoAPIError})``.  Oversized blocks
+        (already device-sized), a closed coalescer, or a saturated backlog
+        dispatch directly — the front-door AdmissionController is the
+        shedding authority for batches, so no 429 is raised here."""
+        n = len(block)
+        if n == 0:
+            return np.zeros(0, bool), {}
+        if self.batch_max <= 0 or n > self.batch_max:
+            return self._block_direct(block, rest_depth)
+        bypass = cache_context.bypassed()
+        budget = deadline.remaining()
+        if budget is None:
+            budget = self.default_timeout if self.default_timeout > 0 else None
+        if budget is not None and budget <= 0:
+            self.deadline_exceeded += 1
+            flightrec.note_stage("deadline", 0.0)
+            raise DeadlineExceededError(
+                "deadline exceeded before batch was enqueued"
+            )
+        grp = _ColumnGroup(block, rest_depth, bypass=bypass)
+        grp.traceparent = flightrec.current_traceparent()
+        with self._wake:
+            if self._closed or len(self._pending) + n > self.max_pending:
+                direct = True
+            else:
+                self._pending.append(grp)
+                self.batch_ingested += n
+                self._wake.notify()
+                direct = False
+        if direct:
+            return self._block_direct(block, rest_depth)
+        if not grp.event.wait(budget):
+            waited = time.perf_counter() - grp.t_enq
+            self.deadline_exceeded += 1
+            flightrec.note_stage("deadline", waited)
+            raise DeadlineExceededError(
+                f"batch did not complete within {budget:.3f}s "
+                f"(waited {waited:.3f}s)"
+            )
+        done = time.perf_counter()
+        if grp.t_dispatch is not None:
+            flightrec.note_stage("coalesce_wait", grp.t_dispatch - grp.t_enq)
+            flightrec.note_stage("device_compute", done - grp.t_dispatch)
+            flightrec.note(wave=grp.wave)
+        if grp.error is not None:
+            raise grp.error
+        return grp.verdicts, grp.errors
+
+    def _block_direct(self, block, rest_depth: int):
+        bc = getattr(self.inner, "batch_check_block", None)
+        if bc is not None:
+            return bc(block, rest_depth)
+        return colmod.block_check_via_tuples(self.inner, block, rest_depth)
+
     def __getattr__(self, name):
         return getattr(self.inner, name)
 
@@ -319,6 +422,8 @@ class CoalescingEngine:
                 while not self._pending and not self._closed:
                     self._wake.wait()
                 if self._closed and not self._pending:
+                    if self._stage is not None:
+                        self._stage.put(None)  # retire the dispatcher
                     return
                 # wave window: let concurrent callers pile on for the FULL
                 # window (every enqueue notifies, so loop on the deadline
@@ -337,9 +442,59 @@ class CoalescingEngine:
                 # from here on start a fresh flight (the cache, refilled
                 # by this wave's dispatch, catches them instead)
                 self._inflight.clear()
-            self._serve(wave)
+            if self._stage is None:
+                self._serve(wave)
+            else:
+                # double-buffer handoff: prep (grouping + merged-block
+                # build + vocab pre-encode) runs here on the collector
+                # while the dispatcher drives the PREVIOUS wave; put()
+                # blocks only when a wave is staged AND one is in flight
+                prepared = self._prepare(wave)
+                self._stage.put((wave, prepared))
 
-    def _serve(self, wave: List[_Slot]) -> None:
+    def _run_dispatch(self) -> None:
+        while True:
+            item = self._stage.get()
+            if item is None:
+                return
+            wave, prepared = item
+            self._serve(wave, prepared)
+
+    def _prepare(self, wave) -> dict:
+        """Host-side wave prep, off the dispatch critical path: group by
+        (depth, bypass), split scalar slots from column groups, build the
+        merged block per group, and pre-encode it against the engine's
+        current vocabulary (append-only ids: anything resolved now is
+        still exact at dispatch; misses refresh then)."""
+        inner_bc = getattr(self.inner, "batch_check_block", None)
+        raw: dict = {}
+        for s in wave:
+            raw.setdefault((s.depth, s.bypass), []).append(s)
+        prepared = {}
+        for key, members in raw.items():
+            slots = [m for m in members if not isinstance(m, _ColumnGroup)]
+            cgroups = [m for m in members if isinstance(m, _ColumnGroup)]
+            merged = None
+            if cgroups and inner_bc is not None:
+                parts = []
+                if slots:
+                    # scalar singles ride the merged block: their tuples
+                    # ARE the pre-materialized items, so the fold is free
+                    parts.append(colmod.ColumnBlock.from_tuples(
+                        [s.tuple for s in slots]
+                    ))
+                parts.extend(g.block for g in cgroups)
+                merged = colmod.ColumnBlock.concat(parts)
+                vocab = getattr(self.inner, "_vocab", None)
+                if vocab is not None:
+                    try:
+                        merged.encode_for(vocab)
+                    except Exception:  # noqa: BLE001 - prep is advisory;
+                        pass  # the dispatch encode is the authority
+            prepared[key] = (slots, cgroups, merged)
+        return prepared
+
+    def _serve(self, wave, prepared: Optional[dict] = None) -> None:
         self.waves += 1
         # the ledger is the wave-id authority when present so flight
         # recorder entries (wave=) and /debug/waves join on the same id
@@ -347,22 +502,29 @@ class CoalescingEngine:
             self.ledger.next_wave_id() if self.ledger is not None
             else self.waves
         )
-        self.coalesced += len(wave)
-        # engine counter/phase deltas around the dispatches: only this
-        # worker thread dispatches waves, so the deltas attribute cleanly
+        self.coalesced += sum(
+            len(s.block) if isinstance(s, _ColumnGroup) else 1 for s in wave
+        )
+        # engine counter/phase deltas around the dispatches: only one
+        # thread dispatches waves (the collector, or the dispatcher when
+        # pipelining), so the deltas attribute cleanly
         inner = self.inner
         leo_before = int(getattr(inner, "leopard_answered", 0) or 0)
         fb_before = int(getattr(inner, "fallbacks", 0) or 0)
         phase_before = dict(getattr(inner, "phase_seconds", None) or {})
         device_s = 0.0
-        groups = {}
-        for s in wave:
-            groups.setdefault((s.depth, s.bypass), []).append(s)
-        for (depth, byp), slots in groups.items():
+        if prepared is None:
+            prepared = self._prepare(wave)
+        if any(cg for _, cg, _ in prepared.values()):
+            self.block_waves += 1
+        for (depth, byp), (slots, cgroups, merged) in prepared.items():
             t_dispatch = time.perf_counter()
             for s in slots:
                 s.t_dispatch = t_dispatch
                 s.wave = wave_id
+            for g in cgroups:
+                g.t_dispatch = t_dispatch
+                g.wave = wave_id
             # re-bind the escape hatch on THIS thread for bypass slots so
             # the inner engine's own cache probe/insert honor it (fresh
             # scope per entry — generator context managers are one-shot)
@@ -370,6 +532,15 @@ class CoalescingEngine:
                 return (cache_context.scope(bypass=True) if byp
                         else contextlib.nullcontext())
             try:
+                if merged is not None:
+                    self._dispatch_merged(slots, cgroups, merged, depth, _ctx)
+                    continue
+                for g in cgroups:
+                    # inner engine without a block surface (fakes, the CPU
+                    # oracle): serve each group through the item shim
+                    self._dispatch_group_via_tuples(g, depth, _ctx)
+                if not slots:
+                    continue
                 with _ctx():
                     # one bounded whole-batch retry: a transient device /
                     # runtime hiccup should not error up to max_pending
@@ -409,14 +580,90 @@ class CoalescingEngine:
                 device_s += time.perf_counter() - t_dispatch
                 for s in slots:
                     s.event.set()
+                for g in cgroups:
+                    g.event.set()
         if self.ledger is not None:
             try:
                 self._file_wave(
-                    wave_id, wave, len(groups), device_s,
+                    wave_id, wave, len(prepared), device_s,
                     leo_before, fb_before, phase_before,
                 )
             except Exception:  # noqa: BLE001 - diagnostics must never
                 pass  # take down the wave worker
+
+    def _dispatch_merged(self, slots, cgroups, merged, depth, _ctx) -> None:
+        """ONE columnar dispatch for a (depth, bypass) group's scalar
+        slots + column groups; verdicts and typed per-item errors scatter
+        back by row offset.  Never raises — failures land on the members
+        (scalar-slot semantics match the item-list path: typed batch-wide
+        errors re-dispatch singles individually; generic failures after
+        the bounded retry error every member)."""
+        try:
+            with _ctx():
+                for attempt in range(2):
+                    try:
+                        allowed, errs = self.inner.batch_check_block(
+                            merged, depth
+                        )
+                        break
+                    except KetoAPIError:
+                        raise
+                    except Exception:  # noqa: BLE001
+                        if attempt:
+                            raise
+            off = 0
+            for s in slots:
+                e = errs.get(off)
+                if e is not None:
+                    s.error = e
+                else:
+                    s.result = bool(allowed[off])
+                off += 1
+            for g in cgroups:
+                m = len(g.block)
+                g.verdicts = allowed[off:off + m].copy()
+                g.errors = {
+                    i - off: e for i, e in errs.items() if off <= i < off + m
+                }
+                off += m
+        except KetoAPIError as e:
+            # batch-wide typed error (deadline, shed): scalar slots retry
+            # individually (scalar-wave parity); groups surface the error
+            # to their caller, whose handler owns the per-item fan-out
+            with _ctx():
+                for s in slots:
+                    try:
+                        s.result = bool(
+                            self.inner.batch_check([s.tuple], depth)[0]
+                        )
+                    except Exception as e2:  # noqa: BLE001
+                        s.error = e2
+            for g in cgroups:
+                g.error = e
+        except Exception as e:  # noqa: BLE001
+            for s in slots:
+                s.error = e
+            for g in cgroups:
+                g.error = e
+
+    def _dispatch_group_via_tuples(self, g, depth, _ctx) -> None:
+        """Serve one column group on an inner engine that only speaks item
+        lists; same bounded retry as scalar waves.  Never raises."""
+        try:
+            with _ctx():
+                for attempt in range(2):
+                    try:
+                        g.verdicts, g.errors = colmod.block_check_via_tuples(
+                            self.inner, g.block, depth
+                        )
+                        return
+                    except KetoAPIError:
+                        raise
+                    except Exception:  # noqa: BLE001
+                        if attempt:
+                            raise
+        except Exception as e:  # noqa: BLE001
+            g.error = e
 
     def _file_wave(self, wave_id: int, wave: List[_Slot], n_groups: int,
                    device_s: float, leo_before: int, fb_before: int,
@@ -448,6 +695,11 @@ class CoalescingEngine:
         self.ledger.record({
             "wave": wave_id,
             "size": len(wave),
+            # items carried by column groups (a group occupies ONE wave
+            # slot however many rows it packs)
+            "block_items": sum(
+                len(s.block) for s in wave if isinstance(s, _ColumnGroup)
+            ),
             "groups": n_groups,
             "window_wait_ms_p50": round(
                 waits[len(waits) // 2] * 1000.0, 3

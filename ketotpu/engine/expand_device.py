@@ -365,22 +365,38 @@ def run_expand(
         rest_depth = max_depth
     t0 = time.perf_counter()
     R = len(roots)
-    r_ns = np.fromiter((vocab.namespaces.lookup(s.namespace) for s in roots),
-                       np.int32, R)
-    r_obj = np.fromiter((vocab.objects.lookup(s.object) for s in roots),
-                        np.int32, R)
-    r_rel = np.fromiter((vocab.relations.lookup(s.relation) for s in roots),
-                        np.int32, R)
-    r_subj = np.fromiter((vocab.subject_key(s) for s in roots), np.int32, R)
-    r_depth = np.full(R, rest_depth, np.int32)
-    sched = expand_schedule(R, fanout, rest_depth, cap)
-    with compilewatch.scope("expand", lambda: f"R={R} sched={sched}"):
+    # JIT-audit finding: the raw root count used to feed both the input
+    # array shapes and schedule[0], so EVERY distinct batch size compiled
+    # a fresh expand program.  Pad the encoded roots to a power-of-two
+    # bucket instead — padding rows carry node/subject -1 and the kernel
+    # already treats missing nodes as degree-0, so they are dead weight
+    # the walk never expands and `assemble` never visits (it enumerates
+    # only the first len(roots) level-0 slots).
+    Rp = 8
+    while Rp < R:
+        Rp <<= 1
+    r_ns = np.full(Rp, -1, np.int32)
+    r_obj = np.full(Rp, -1, np.int32)
+    r_rel = np.full(Rp, -1, np.int32)
+    r_subj = np.full(Rp, -1, np.int32)
+    r_depth = np.zeros(Rp, np.int32)
+    r_ns[:R] = np.fromiter(
+        (vocab.namespaces.lookup(s.namespace) for s in roots), np.int32, R)
+    r_obj[:R] = np.fromiter(
+        (vocab.objects.lookup(s.object) for s in roots), np.int32, R)
+    r_rel[:R] = np.fromiter(
+        (vocab.relations.lookup(s.relation) for s in roots), np.int32, R)
+    r_subj[:R] = np.fromiter(
+        (vocab.subject_key(s) for s in roots), np.int32, R)
+    r_depth[:R] = rest_depth
+    sched = expand_schedule(Rp, fanout, rest_depth, cap)
+    with compilewatch.scope("expand", lambda: f"R={Rp} sched={sched}"):
         levels, over = _run_expand(
             g, r_ns, r_obj, r_rel, r_subj, r_depth, schedule=sched
         )
     t1 = time.perf_counter()
     levels = [{k: np.asarray(v) for k, v in lvl.items()} for lvl in levels]
-    over = np.asarray(over)
+    over = np.asarray(over)[:R]
     t2 = time.perf_counter()
     trees = assemble(
         levels, (snap.sub_ns, snap.sub_obj, snap.sub_rel), vocab, roots,

@@ -411,6 +411,37 @@ def splice_table(
     return out
 
 
+def lookup_np(t: Dict, a: np.ndarray, b: np.ndarray) -> Tuple:
+    """Host-side numpy mirror of :func:`lookup`: (val_or_index, found).
+
+    One vectorized probe over a whole query column — the columnar batch
+    decode uses this to encode request strings to vocabulary ids without
+    a per-item Python dict walk.  Semantics match the device probe
+    exactly: negative queries never match, probing past a bucket's end
+    is safe (CSR-contiguous entries of other buckets can never equal the
+    query key), and the round count comes from the ``pw`` shape."""
+    probe = t["pw"].shape[0] if "pw" in t else PROBE
+    salt = _SALTS[min(int(t["meta"][0]), len(_SALTS) - 1)]
+    mask = np.uint32(int(t["meta"][1]))
+    a = np.asarray(a)
+    b = np.asarray(b)
+    h = (_mix_np(a, b, salt) & mask).astype(np.int64)
+    base = t["ptr"][h].astype(np.int64)
+    ka, kb = t["key_a"], t["key_b"]
+    cap = ka.shape[0]
+    ok = (a >= 0) & (b >= 0)
+    found = np.zeros(a.shape, bool)
+    res_j = np.zeros(a.shape, np.int64)
+    for i in range(probe):
+        j = np.minimum(base + i, cap - 1)
+        hit = ok & (ka[j] == a) & (kb[j] == b)
+        res_j = np.where(hit & ~found, j, res_j)
+        found |= hit
+    vals = t.get("val")
+    payload = vals[res_j] if vals is not None else res_j
+    return np.where(found, payload, -1).astype(np.int32), found
+
+
 def lookup(t: Dict, a, b, *, probe: int = PROBE) -> Tuple:
     """Device probe: (val_or_index, found).  Negative queries never match.
 

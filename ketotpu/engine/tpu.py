@@ -62,7 +62,11 @@ if _plat:
         pass
 
 from ketotpu import compilewatch, deadline, faults, flightrec
-from ketotpu.api.types import KetoAPIError, RelationTuple
+from ketotpu.api.types import (
+    DeadlineExceededError,
+    KetoAPIError,
+    RelationTuple,
+)
 from ketotpu.cache import check_key as cache_check_key
 from ketotpu.engine import algebra as alg
 from ketotpu.engine import delta as dl
@@ -997,23 +1001,37 @@ class DeviceCheckEngine:
 
     # -- query encoding -----------------------------------------------------
 
-    def _encode(self, snap: Snapshot, queries: Sequence[RelationTuple],
-                rest_depth: int):
+    def _encode(self, snap: Snapshot, queries, rest_depth: int):
         v = snap.vocab
         n = len(queries)
-        ns_look = v.namespaces.lookup
-        obj_look = v.objects.lookup
-        rel_look = v.relations.lookup
-        subj_look = v.subject_key
-        q_ns = np.fromiter((ns_look(q.namespace) for q in queries), np.int32, n)
-        q_obj = np.fromiter((obj_look(q.object) for q in queries), np.int32, n)
-        q_rel = np.fromiter((rel_look(q.relation) for q in queries), np.int32, n)
-        q_subj = np.fromiter((subj_look(q.subject) for q in queries), np.int32, n)
+        if hasattr(queries, "encode_for"):
+            # columnar batch (engine/columns.py): one vectorized hashtab
+            # probe per column instead of n scalar dict walks; repeat
+            # encodes against the same vocab only refresh prior misses
+            q_ns, q_obj, q_rel, q_subj = queries.encode_for(v)
+        else:
+            ns_look = v.namespaces.lookup
+            obj_look = v.objects.lookup
+            rel_look = v.relations.lookup
+            subj_look = v.subject_key
+            q_ns = np.fromiter((ns_look(q.namespace) for q in queries), np.int32, n)
+            q_obj = np.fromiter((obj_look(q.object) for q in queries), np.int32, n)
+            q_rel = np.fromiter((rel_look(q.relation) for q in queries), np.int32, n)
+            q_subj = np.fromiter((subj_look(q.subject) for q in queries), np.int32, n)
         # global max-depth precedence (engine.go:82-84)
         if rest_depth <= 0 or self.max_depth < rest_depth:
             rest_depth = self.max_depth
         q_depth = np.full(n, rest_depth, np.int32)
         return q_ns, q_obj, q_rel, q_subj, q_depth
+
+    @staticmethod
+    def _qkeys(queries, idx, rest_depth: int):
+        """Result-cache keys for rows ``idx`` — from columns when the batch
+        is a ColumnBlock (no Subject materialization), else per item."""
+        ck = getattr(queries, "cache_key", None)
+        if ck is not None:
+            return [ck(int(i), rest_depth) for i in idx]
+        return [cache_check_key(queries[i], rest_depth) for i in idx]
 
     def _classify(self, snap: Snapshot, q_ns, q_rel):
         """(err, general) masks from the snapshot's static tables.
@@ -1105,7 +1123,7 @@ class DeviceCheckEngine:
             handles = [self._dispatch(c, rest_depth) for c in chunks]
             out: List[bool] = []
             for c, h in zip(chunks, handles):
-                out.extend(self._finish_chunk(c, h, rest_depth))
+                out.extend(self._finish_chunk(c, h, rest_depth).tolist())
         except KetoAPIError:
             raise  # typed client errors (and deadline/shed) pass through
         except Exception:  # noqa: BLE001
@@ -1272,9 +1290,7 @@ class DeviceCheckEngine:
         if len(idx) == 0:
             return None
         t0 = time.perf_counter()
-        hits = rc.lookup_many(
-            [cache_check_key(queries[i], rest_depth) for i in idx]
-        )
+        hits = rc.lookup_many(self._qkeys(queries, idx, rest_depth))
         cached = np.zeros(err.shape[0], bool)
         vals = np.zeros(err.shape[0], bool)
         for i, h in zip(idx, hits):
@@ -1286,13 +1302,17 @@ class DeviceCheckEngine:
             return None
         return cached, vals
 
-    def _cache_fill(self, queries, handle, rest_depth, allowed) -> None:
+    def _cache_fill(self, queries, handle, rest_depth, allowed,
+                    skip=None) -> None:
         """Insert this chunk's freshly computed verdicts, stamped with the
         drain cursor captured with the dispatch's sync view.  Oracle-
         fallback verdicts are included — they were computed from the live
         store, which is at least as fresh as the stamp (the stamp is a
         lower bound, never an over-claim).  Leopard-answered queries are
-        skipped: the index answers them cheaper than a probe would."""
+        skipped: the index answers them cheaper than a probe would.
+        ``skip`` marks rows whose oracle fallback raised a typed error in
+        the per-item-capture path: their ``allowed`` slot is a stale
+        default, never a verdict."""
         rc = self.result_cache
         if rc is None:
             return
@@ -1304,15 +1324,15 @@ class DeviceCheckEngine:
             fresh &= ~leo_res[1]
         if cache_res is not None:
             fresh &= ~cache_res[0]
+        if skip is not None:
+            fresh &= ~skip
         idx = np.flatnonzero(fresh)
         if len(idx) == 0:
             return
         t0 = time.perf_counter()
-        for i in idx:
-            rc.insert(
-                cache_check_key(queries[i], rest_depth),
-                bool(allowed[i]), cursor,
-            )
+        keys = self._qkeys(queries, idx, rest_depth)
+        for i, key in zip(idx, keys):
+            rc.insert(key, bool(allowed[i]), cursor)
         self._phase("check_cache_fill", time.perf_counter() - t0)
 
     def _gen_schedule(self, q: int, boost: int):
@@ -1570,11 +1590,17 @@ class DeviceCheckEngine:
         return allowed, fallback
 
     def _finish_chunk(
-        self, queries: Sequence[RelationTuple], handle, rest_depth: int
-    ) -> List[bool]:
+        self, queries, handle, rest_depth: int, errs=None, base: int = 0
+    ) -> np.ndarray:
+        """Collect one chunk's verdicts as a bool array.  With ``errs``
+        (the columnar path's per-item contract) a typed oracle error is
+        captured into ``errs[base + i]`` instead of aborting the chunk;
+        deadline expiry still propagates — it is batch-wide by design and
+        the handler fans it out as per-item 504s."""
         if handle is None:
-            return []
+            return np.zeros(0, bool)
         allowed, fallback = self._collect(handle)
+        skip = None
         if fallback.any():
             t_fb = time.perf_counter()
             for i in np.flatnonzero(fallback):
@@ -1582,12 +1608,27 @@ class DeviceCheckEngine:
                 # long fallback tail must not outlive the request's budget
                 deadline.check("oracle fallback")
                 self.fallbacks += 1
-                allowed[i] = self.oracle.check_is_member(queries[i], rest_depth)
+                if errs is None:
+                    allowed[i] = self.oracle.check_is_member(
+                        queries[i], rest_depth
+                    )
+                    continue
+                try:
+                    allowed[i] = self.oracle.check_is_member(
+                        queries[i], rest_depth
+                    )
+                except DeadlineExceededError:
+                    raise
+                except KetoAPIError as e:
+                    errs[base + int(i)] = e
+                    if skip is None:
+                        skip = np.zeros(allowed.shape[0], bool)
+                    skip[i] = True
             dt = time.perf_counter() - t_fb
             self._phase("check_oracle_fallback", dt)
             self._rpc_fallback_stage("check", dt)
-        self._cache_fill(queries, handle, rest_depth, allowed)
-        return allowed.tolist()
+        self._cache_fill(queries, handle, rest_depth, allowed, skip=skip)
+        return allowed
 
     def batch_expand(
         self, subjects, rest_depth: int = 0, *, fanout: int = 16,
@@ -1694,6 +1735,66 @@ class DeviceCheckEngine:
             return [], []
         allowed, fallback = self._collect(handle, retry=retry)
         return allowed.tolist(), fallback.tolist()
+
+    def batch_check_block(self, block, rest_depth: int = 0):
+        """Columnar batch check (engine/columns.py ColumnBlock): the whole
+        batch stays id columns end to end — no per-item Python object on
+        the hot path.  Returns ``(allowed bool array, {row: KetoAPIError})``
+        with per-item error isolation: a typed oracle error lands in the
+        erroring row's slot, never aborts the block.  Deadline expiry
+        still raises batch-wide (one budget, handler fans out 504s)."""
+        t0 = time.perf_counter()
+        n = len(block)
+        errs: dict = {}
+        if n == 0:
+            return np.zeros(0, bool), errs
+        chunks = [
+            (lo, block.slice(lo, min(lo + self.max_batch, n)))
+            for lo in range(0, n, self.max_batch)
+        ]
+        watch = compilewatch.get()
+        compiles_before = watch.compiles_total
+        allowed = np.zeros(n, bool)
+        try:
+            # same dispatch-all-then-sync pipelining as batch_check
+            handles = [self._dispatch(c, rest_depth) for _, c in chunks]
+            for (lo, c), h in zip(chunks, handles):
+                allowed[lo:lo + len(c)] = self._finish_chunk(
+                    c, h, rest_depth, errs=errs, base=lo
+                )
+        except KetoAPIError:
+            raise  # typed client errors (and deadline/shed) pass through
+        except Exception:  # noqa: BLE001
+            self._device_failure()
+            errs.clear()
+            allowed = self._oracle_block(block, rest_depth, errs)
+        if watch.compiles_total == compiles_before:
+            self._clean_dispatches += 1
+            if self._clean_dispatches >= self.warm_after_clean and not watch.warm:
+                watch.declare_warm()
+        else:
+            self._clean_dispatches = 0
+        flightrec.note_stage("device_compute", time.perf_counter() - t0)
+        return allowed, errs
+
+    def _oracle_block(self, block, rest_depth: int, errs: dict) -> np.ndarray:
+        """Whole-block oracle fallback (device dispatch died) with the
+        columnar path's per-item error capture."""
+        t_fb = time.perf_counter()
+        out = np.zeros(len(block), bool)
+        for i in range(len(block)):
+            deadline.check("oracle fallback")
+            self.fallbacks += 1
+            try:
+                out[i] = bool(self.oracle.check_is_member(block[i], rest_depth))
+            except DeadlineExceededError:
+                raise
+            except KetoAPIError as e:
+                errs[i] = e
+        dt = time.perf_counter() - t_fb
+        self._phase("check_oracle_fallback", dt)
+        self._rpc_fallback_stage("check", dt)
+        return out
 
     # -- Leopard listing APIs ------------------------------------------------
     #

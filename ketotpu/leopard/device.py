@@ -7,9 +7,14 @@ single ``jnp.searchsorted`` over the sorted pairs — one binary search
 per query instead of an iterative frontier walk.
 
 Compile-variant discipline matches the rest of the engine: query blocks
-are padded to power-of-two buckets (`tpu._bucket`), so the jit sees one
-variant per (pairs_len, bucket) pair — pairs_len changes only at
-rebuild.  Device probing is worth the dispatch overhead for large
+are padded to power-of-two buckets (`tpu._bucket`) AND the shipped pair
+arrays are padded to power-of-two buckets with a +inf key sentinel, so
+the jit sees one variant per (pairs_bucket, query_bucket) pair — a
+closure rebuild whose pair count lands in the same bucket reuses the
+compiled probe.  (JIT-audit finding: before the pad, `pairs.shape[0]`
+was a raw compile axis and every incremental rebuild recompiled the
+probe ON THE SERVING PATH — the `leopard_probe` AFTER-WARM warning
+class.)  Device probing is worth the dispatch overhead for large
 batches; small batches stay on the host numpy path (`closure.py`), which
 returns bit-identical verdicts.  Any device failure degrades to the host
 path (never to a wrong answer).
@@ -38,15 +43,37 @@ except Exception:  # pragma: no cover
 DEVICE_PROBE_MIN = 2048
 
 
+#: pairs-array pad sentinel: sorts after every real packed key (set and
+#: element ids are non-negative int32, so real keys are < 2**62) and can
+#: never equal one, keeping the searchsorted hit test exact on padding
+_PAIR_PAD = np.iinfo(np.int64).max
+
+
+def _pair_bucket(n: int, floor: int = 1024) -> int:
+    """Power-of-two pad size for the shipped pairs: the probe's compile
+    signature then changes only when the closure doubles, not on every
+    incremental rebuild."""
+    b = floor
+    while b < n:
+        b <<= 1
+    return b
+
+
 def ship_pairs(index) -> Optional[dict]:
-    """Device-put the closure pair arrays; None when jax is unavailable
-    or the index is empty."""
+    """Device-put the closure pair arrays (padded to a power-of-two
+    bucket); None when jax is unavailable or the index is empty."""
     if not _HAS_JAX or index is None or len(index.elt_packed) == 0:
         return None
     try:
+        n = len(index.elt_packed)
+        cap = _pair_bucket(n)
+        pairs = np.full(cap, _PAIR_PAD, np.int64)
+        pairs[:n] = index.elt_packed
+        hops = np.zeros(cap, index.elt_hop.dtype)
+        hops[:n] = index.elt_hop
         return {
-            "pairs": jax.device_put(index.elt_packed),
-            "hops": jax.device_put(index.elt_hop),
+            "pairs": jax.device_put(pairs),
+            "hops": jax.device_put(hops),
         }
     except Exception:
         return None

@@ -45,7 +45,10 @@ SERVICES: Dict[str, Dict[str, Tuple[Type, Type]]] = {
         "Check": (check_service_pb2.CheckRequest, check_service_pb2.CheckResponse),
         # EXTENSION: first-class batched checks — one RPC, many verdicts,
         # one shared consistency mode + snaptoken for the whole batch
-        # (proto/ory/keto/relation_tuples/v1alpha2/batch_service.proto)
+        # (proto/ory/keto/relation_tuples/v1alpha2/batch_service.proto).
+        # Served on the columnar path by default (engine/columns.py): the
+        # parsed tuples become one ColumnBlock and the verdict array
+        # scatters back into per-item results.
         "BatchCheck": (
             batch_service_pb2.BatchCheckRequest,
             batch_service_pb2.BatchCheckResponse,

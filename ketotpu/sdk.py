@@ -35,6 +35,7 @@ from ketotpu.api.types import (
     RelationTuple,
     StaleSnapshotError,
     Subject,
+    SubjectID,
     SubjectSet,
     Tree,
     subject_from_string,
@@ -228,6 +229,62 @@ class KetoClient:
                 self._raise_for(
                     int(r.get("status", 500)), json.dumps(r)
                 )
+            out.append(bool(r["allowed"]))
+        return out
+
+    def batch_check_columns(
+        self,
+        namespaces: Sequence[str],
+        objects: Sequence[str],
+        relations: Sequence[str],
+        subjects: Sequence,
+        *,
+        max_depth: int = 0,
+        consistency: Optional[str] = None,
+        snaptoken: Optional[str] = None,
+        latest: bool = False,
+    ) -> List[bool]:
+        """Column-form convenience over the batch front door: four
+        parallel sequences build the wire payload in one pass, so a
+        caller already holding columnar data (a dataframe, a log scan)
+        never constructs RelationTuples.  ``subjects`` entries may be
+        subject-id strings, ``SubjectID``/``SubjectSet`` objects, or
+        ``{"namespace","object","relation"}`` dicts (subject sets).
+        The server answers on its columnar path; one verdict per row, a
+        per-item error raises its typed error."""
+        n = len(namespaces)
+        if not (len(objects) == n and len(relations) == n
+                and len(subjects) == n):
+            raise ValueError("column lengths differ")
+        items = []
+        for i in range(n):
+            s = subjects[i]
+            d = {
+                "namespace": namespaces[i],
+                "object": objects[i],
+                "relation": relations[i],
+            }
+            if isinstance(s, SubjectSet):
+                d["subject_set"] = {
+                    "namespace": s.namespace,
+                    "object": s.object,
+                    "relation": s.relation,
+                }
+            elif isinstance(s, SubjectID):
+                d["subject_id"] = s.id
+            elif isinstance(s, dict):
+                d["subject_set"] = s
+            else:
+                d["subject_id"] = str(s)
+            items.append(d)
+        results = self.batch_check_results(
+            items, max_depth=max_depth, consistency=consistency,
+            snaptoken=snaptoken, latest=latest,
+        )
+        out: List[bool] = []
+        for r in results:
+            if "error" in r:
+                self._raise_for(int(r.get("status", 500)), json.dumps(r))
             out.append(bool(r["allowed"]))
         return out
 

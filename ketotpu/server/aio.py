@@ -375,7 +375,10 @@ class AsyncHTTPServer:
                 ctype = "application/json"
             elif isinstance(payload, tuple):
                 ctype, text = payload
-                data = text.encode("utf-8")
+                # bytes pass through untouched: the columnar batch route
+                # renders its whole response frame pre-encoded
+                data = (text if isinstance(text, (bytes, bytearray))
+                        else text.encode("utf-8"))
             else:
                 ctype = "application/json"
                 data = json.dumps(payload).encode("utf-8")

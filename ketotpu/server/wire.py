@@ -76,6 +76,36 @@ def unpack_arrays(manifest, payload: bytes) -> Dict[str, np.ndarray]:
     return arrays
 
 
+def pack_strcol(arrays: Dict[str, np.ndarray], name: str, col) -> None:
+    """Pack a string column into ``arrays`` as two entries: ``<name>_b``
+    (one utf-8 blob) + ``<name>_o`` (int32 end offsets, len n+1).  A
+    4096-item column crosses the wire as two contiguous buffers instead
+    of 4096 JSON strings — the columnar check op's carrier."""
+    enc = [s.encode("utf-8") for s in col]
+    offs = np.zeros(len(enc) + 1, dtype=np.int32)
+    if enc:
+        offs[1:] = np.cumsum([len(b) for b in enc])
+    arrays[name + "_b"] = np.frombuffer(b"".join(enc), dtype=np.uint8)
+    arrays[name + "_o"] = offs
+
+
+def unpack_strcol(arrays: Dict[str, np.ndarray], name: str) -> list:
+    """Inverse of :func:`pack_strcol`; raises WireError on a malformed
+    offsets/blob pair (desynced or hostile peer)."""
+    blob = arrays.get(name + "_b")
+    offs = arrays.get(name + "_o")
+    if blob is None or offs is None or offs.ndim != 1 or len(offs) < 1:
+        raise WireError(f"string column {name!r} missing or misshapen")
+    raw = blob.tobytes()
+    offs = offs.astype(np.int64)
+    if offs[0] != 0 or offs[-1] != len(raw) or np.any(np.diff(offs) < 0):
+        raise WireError(f"string column {name!r} offsets are inconsistent")
+    return [
+        raw[offs[i]:offs[i + 1]].decode("utf-8")
+        for i in range(len(offs) - 1)
+    ]
+
+
 class ShmRing:
     """Sender-owned shared-memory segment for large frame payloads,
     reused (and grown) across calls; unlinked on close."""

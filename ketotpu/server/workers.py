@@ -51,6 +51,7 @@ from ketotpu import deadline, faults, flightrec
 from ketotpu.cache import SingleFlight
 from ketotpu.cache import check_key as cache_check_key
 from ketotpu.cache import context as cache_context
+from ketotpu.engine import columns as colmod
 from ketotpu.server import wire
 from ketotpu.api.types import (
     DeadlineExceededError,
@@ -359,6 +360,51 @@ class EngineHostServer:
                 if len(learn_pos):
                     out["learn_ids"] = learn_ids
                 return resp, out
+        if op == "check_cols":
+            # columnar batch: the worker's decoded string columns arrive
+            # as packed utf-8 blobs (wire.pack_strcol), become ONE
+            # ColumnBlock, and ride the owner's wave as a single column
+            # group — no per-item tuple materialization on the hot path
+            with flightrec.rpc_recording(
+                r, "check", traceparent=tp, detail="worker->owner check_cols"
+            ):
+                t0 = time.perf_counter()
+                cols = {
+                    k: wire.unpack_strcol(arrays, k)
+                    for k in ("ns", "obj", "rel", "sa", "sb", "sc")
+                }
+                skind_arr = arrays.get("skind")
+                if skind_arr is None:
+                    raise ValueError("check_cols frame missing skind")
+                skind = [int(v) for v in np.asarray(skind_arr).reshape(-1)]
+                block = colmod.ColumnBlock(
+                    cols["ns"], cols["obj"], cols["rel"], skind,
+                    cols["sa"], cols["sb"], cols["sc"],
+                )
+                flightrec.note_stage("parse", time.perf_counter() - t0)
+                flightrec.note(batch=len(block))
+                eng = r.check_engine()
+                depth = int(meta.get("depth", 0))
+                cur = r.store().log_head
+                # check_block FIRST: the coalescer facade forwards unknown
+                # attrs to its inner engine (see handlers._check_block_core)
+                cb = (getattr(eng, "check_block", None)
+                      or getattr(eng, "batch_check_block", None))
+                if cb is not None:
+                    allowed, errs = cb(block, depth)
+                else:
+                    allowed, errs = colmod.block_check_via_tuples(
+                        eng, block, depth
+                    )
+                resp = {
+                    "cursor": int(cur),
+                    "errs": [
+                        [int(i), str(e),
+                         int(getattr(e, "status_code", None) or 500)]
+                        for i, e in errs.items()
+                    ],
+                }
+                return resp, {"ok": np.asarray(allowed, dtype=np.uint8)}
         if op == "expand":
             with flightrec.rpc_recording(
                 r, "expand", traceparent=tp, detail="worker->owner expand"
@@ -765,6 +811,81 @@ class RemoteCheckEngine:
         for i, v in zip(miss, ok):
             results[i] = bool(v)
         return [bool(v) for v in results]
+
+    def batch_check_block(self, block, rest_depth: int = 0):
+        """Columnar check surface over the owner wire: the block's string
+        columns cross the socket as packed utf-8 blobs in ONE frame
+        (wire.pack_strcol) and the verdicts come back as a uint8 array —
+        no RelationTuple materialization on either side.
+
+        Same contract as the device engine's ``batch_check_block``:
+        ``(allowed bool array, {row: KetoAPIError})``, with the worker's
+        local result cache probed first (block.cache_key rows answered
+        here never cross the socket) and refilled from the owner's
+        piggybacked changelog cursor."""
+        n = len(block)
+        errs: dict = {}
+        allowed = np.zeros(n, dtype=bool)
+        if n == 0:
+            return allowed, errs
+        bypass = cache_context.bypassed()
+        cache = None if bypass else self.cache
+        miss = list(range(n))
+        if cache is not None:
+            hits = cache.lookup_many(
+                [block.cache_key(i, rest_depth) for i in range(n)]
+            )
+            miss = [i for i, h in enumerate(hits) if h is None]
+            for i, h in enumerate(hits):
+                if h is not None:
+                    allowed[i] = bool(h.value)
+            if not miss:
+                return allowed, errs
+        sub = block if len(miss) == n else block.take(miss)
+        meta = {"op": "check_cols", "depth": int(rest_depth), "n": len(sub)}
+        if bypass:
+            meta["cache_bypass"] = True
+        arrays = {"skind": np.asarray(sub.skind, dtype=np.uint8)}
+        for name, col in (("ns", sub.ns), ("obj", sub.obj),
+                          ("rel", sub.rel), ("sa", sub.sa),
+                          ("sb", sub.sb), ("sc", sub.sc)):
+            wire.pack_strcol(arrays, name, col)
+        try:
+            resp, resp_arrays = self._call(meta, arrays)
+        except DeadlineExceededError:
+            raise
+        except KetoAPIError as e:
+            if int(getattr(e, "status_code", 0) or 0) == 504:
+                # the owner's deadline expiry crossed the wire as a plain
+                # typed error; re-raise it as the batch-wide expiry the
+                # handler's per-item 504 fan-out expects
+                raise DeadlineExceededError(str(e)) from e
+            raise
+        ok = (resp_arrays or {}).get("ok")
+        if ok is None or len(np.asarray(ok).reshape(-1)) != len(sub):
+            raise ValueError(
+                f"owner answered {0 if ok is None else len(ok)} verdicts "
+                f"for {len(sub)} tuples"
+            )
+        ok = np.asarray(ok).reshape(-1)
+        sub_errs: dict = {}
+        for row, msg, status in resp.get("errs") or []:
+            e = KetoAPIError(str(msg))
+            e.status_code = int(status)
+            sub_errs[int(row)] = e
+        cur = resp.get("cursor")
+        if cache is not None and cur is not None:
+            cache.advance_fence(int(cur))
+        for j, i in enumerate(miss):
+            e = sub_errs.get(j)
+            if e is not None:
+                errs[i] = e  # errored rows never reach the cache
+                continue
+            v = bool(ok[j])
+            allowed[i] = v
+            if cache is not None and cur is not None:
+                cache.insert(block.cache_key(i, rest_depth), v, int(cur))
+        return allowed, errs
 
     def check(self, r: RelationTuple, rest_depth: int = 0) -> bool:
         return self.batch_check([r], rest_depth)[0]

@@ -201,6 +201,27 @@ def test_batch_and_wire_metric_vocabulary(scrape):
     assert 'keto_wire_calls_total{op="check"}' in text
 
 
+def test_columnar_metric_vocabulary(scrape):
+    """ISSUE 9: the columnar batch path publishes its vocabulary — the
+    columnar batch counter and the four stage timers on the check op
+    (decode / encode_ids / wave_wait / respond)."""
+    text = scrape["metrics_text"]
+    assert "keto_columnar_batches_total" in text
+    stages = set(
+        re.findall(
+            r'keto_rpc_stage_seconds_count\{[^}]*op="check"[^}]*'
+            r'stage="([^"]+)"',
+            text,
+        )
+        + re.findall(
+            r'keto_rpc_stage_seconds_count\{[^}]*stage="([^"]+)"[^}]*'
+            r'op="check"',
+            text,
+        )
+    )
+    assert {"decode", "encode_ids", "wave_wait", "respond"} <= stages, stages
+
+
 def test_projection_metric_vocabulary(scrape):
     """ISSUE 8: projection/compaction observability — generation and
     fold/rebuild/compaction counters as gauges, per-phase build seconds,
