@@ -401,6 +401,194 @@ def run_batch_bench(
         srv.stop(grace=2.0)
 
 
+def run_sharded_child(
+    shards: int,
+    *,
+    concurrency: int = 32,
+    duration: float = 6.0,
+    zipf: bool = False,
+    replicate: bool = True,
+) -> Dict[str, float]:
+    """One sharded serving leg in ONE process: boot the daemon with
+    ``engine.mesh_devices=<shards>`` (1 = the single-chip baseline),
+    hammer single Checks over gRPC, and report RPS/p50/p99 + verdict
+    divergence against the host oracle + steady-state compiles under the
+    ``_steady`` gate.  Run as a CHILD process by ``run_sharded_bench``:
+    the shard count needs ``--xla_force_host_platform_device_count`` in
+    XLA_FLAGS BEFORE jax imports, which only a fresh interpreter can
+    guarantee."""
+    import grpc
+
+    from ketotpu.driver import Provider, Registry
+    from ketotpu.proto.services import CheckServiceStub
+    from ketotpu.server import serve_all
+    from ketotpu.utils.synth import build_synth, synth_queries
+
+    graph = build_synth(
+        n_users=1024, n_groups=64, n_folders=1024, n_docs=8192, seed=0
+    )
+    cfg = Provider(
+        {
+            "serve": {
+                n: {"host": "127.0.0.1", "port": 0}
+                for n in ("read", "write", "metrics", "opl")
+            },
+            "engine": {
+                "kind": "tpu",
+                "mesh_devices": 0 if shards <= 1 else shards,
+                "frontier": 4096,
+                "arena": 16384,
+                "max_batch": 4096,
+                "coalesce_ms": 2,
+                "mesh": {
+                    "replicate_hot": bool(replicate),
+                    "hot_min": 32,
+                    "replica_max_keys": 8,
+                    "rebalance_skew": 2.5,
+                    # background controller live during the hammer:
+                    # hot keys replicate mid-run via (same-shape)
+                    # generation swaps — the _steady gate proves the
+                    # swaps stay compile-free
+                    "interval_ms": 250 if replicate else 0,
+                },
+            },
+            "limit": {"max_inflight": 0},
+            "log": {"request_log": False},
+        }
+    )
+    reg = Registry(
+        cfg, store=graph.store, namespace_manager=graph.manager
+    ).init()
+    srv = serve_all(reg)
+    try:
+        host, port = srv.addresses["read"]
+        target = f"{host}:{port}"
+        requests = _build_requests(graph, 2048)
+        if zipf:
+            # zipfian object popularity: duplicate request slots by a
+            # zipf(1.2) draw so _hammer's uniform sampler produces a
+            # hot-object-skewed stream (rank 0 hottest)
+            rng = np.random.default_rng(7)
+            idx = (rng.zipf(1.2, size=8192) - 1) % len(requests)
+            requests = [requests[int(i)] for i in idx]
+        with grpc.insecure_channel(target) as ch:
+            stub = CheckServiceStub(ch)
+            for r in requests[:8]:
+                stub.Check(r)
+
+        # divergence probe: served verdicts vs the host oracle, same state
+        eng = reg.check_engine()
+        inner = getattr(eng, "inner", eng)
+        sample = synth_queries(graph, 512, seed=9)
+        served = eng.batch_check(sample)
+        want = [inner.oracle.check_is_member(q) for q in sample]
+        divergence = sum(1 for g, w in zip(served, want) if g != w)
+
+        # warm pass at the EXACT hammer shapes (coalescer wave buckets),
+        # unmeasured; then the timed pass under the steady-compile gate
+        _hammer(
+            target, requests, concurrency=concurrency,
+            duration=max(2.0, duration * 0.4),
+        )
+        from bench import _steady
+
+        gate: Dict = {}
+        with _steady(gate, "serve_sharded"):
+            h = _hammer(
+                target, requests, concurrency=concurrency, duration=duration
+            )
+        steady = gate.get("steady_state_compiles", {}).get(
+            "serve_sharded", 0
+        )
+        res = {
+            "shards": shards,
+            "rps": h["rps"],
+            "p50_ms": h["p50_ms"],
+            "p99_ms": h["p99_ms"],
+            "errors": h["errors"],
+            "divergence": divergence,
+            "steady_state_compiles": int(steady),
+            "zipf": bool(zipf),
+            "replicate": bool(replicate),
+        }
+        mesh_fn = getattr(inner, "mesh_stats", None)
+        if mesh_fn is not None:
+            res["mesh"] = mesh_fn()
+        return res
+    finally:
+        srv.stop(grace=2.0)
+
+
+def run_sharded_bench(
+    *,
+    concurrency: int = 32,
+    duration: float = 6.0,
+    shard_counts=(1, 2, 4),
+) -> Dict[str, float]:
+    """Sharded serving scaling sweep (ISSUE 10): one subprocess per shard
+    count (XLA fixes the host device count at import time), uniform
+    workload for the RPS-vs-shards curve with zero-divergence and
+    zero-steady-compile gates, then a zipfian leg at the top shard count
+    with hot-key replication ON vs OFF for the p99 effect."""
+    import os
+    import subprocess
+
+    def child(shards: int, mode: str, rep: str) -> Dict:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = " ".join(
+            f for f in env.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f
+        )
+        env["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count="
+            f"{max(shards, 1)} --xla_cpu_parallel_codegen_split_count=1"
+        ).strip()
+        p = subprocess.run(
+            [
+                sys.executable, os.path.abspath(__file__),
+                str(concurrency), str(duration), "sharded_child",
+                str(shards), mode, rep,
+            ],
+            capture_output=True, text=True, timeout=1800, env=env,
+        )
+        line = (
+            p.stdout.strip().splitlines()[-1] if p.stdout.strip() else "{}"
+        )
+        try:
+            res = json.loads(line)
+        except json.JSONDecodeError:
+            res = {"error": (p.stderr or p.stdout)[-400:]}
+        res["exit_code"] = p.returncode
+        return res
+
+    legs = {str(n): child(n, "uniform", "rep") for n in shard_counts}
+    top = max(shard_counts)
+    zipf_on = child(top, "zipf", "rep")
+    zipf_off = child(top, "zipf", "norep")
+    rps = {k: float(v.get("rps", 0)) for k, v in legs.items()}
+    return {
+        "serve_sharded": legs,
+        "serve_sharded_rps": rps,
+        "serve_sharded_scaling_ok": (
+            rps.get("2", 0) > rps.get("1", 0)
+            if "1" in rps and "2" in rps else None
+        ),
+        "serve_sharded_divergence": sum(
+            int(v.get("divergence", 0)) for v in legs.values()
+        ),
+        "serve_sharded_steady_compiles": sum(
+            int(v.get("steady_state_compiles", 0)) for v in legs.values()
+        ),
+        "serve_sharded_zipf_replication_on": zipf_on,
+        "serve_sharded_zipf_replication_off": zipf_off,
+        "serve_sharded_zipf_p99_delta_ms": round(
+            float(zipf_off.get("p99_ms", -1.0))
+            - float(zipf_on.get("p99_ms", -1.0)), 2,
+        ),
+    }
+
+
 def _scrape_means(metrics, name: str, label_keys) -> Dict[str, float]:
     """Mean milliseconds per histogram series, keyed by the joined label
     values ("check.coalesce_wait") — the per-stage RPC breakdown the bench
@@ -613,7 +801,19 @@ def _reap(proc, pgid) -> None:
 if __name__ == "__main__":
     conc = int(sys.argv[1]) if len(sys.argv) > 1 else 64
     secs = float(sys.argv[2]) if len(sys.argv) > 2 else 10.0
-    if len(sys.argv) > 3 and sys.argv[3] == "workers":
+    if len(sys.argv) > 3 and sys.argv[3] == "sharded_child":
+        shards = int(sys.argv[4]) if len(sys.argv) > 4 else 1
+        mode = sys.argv[5] if len(sys.argv) > 5 else "uniform"
+        rep = sys.argv[6] != "norep" if len(sys.argv) > 6 else True
+        res = run_sharded_child(
+            shards, concurrency=conc, duration=secs,
+            zipf=(mode == "zipf"), replicate=rep,
+        )
+        print(json.dumps(res))
+        sys.exit(3 if res.get("steady_state_compiles") else 0)
+    elif len(sys.argv) > 3 and sys.argv[3] == "sharded":
+        print(json.dumps(run_sharded_bench(concurrency=conc, duration=secs)))
+    elif len(sys.argv) > 3 and sys.argv[3] == "workers":
         print(json.dumps(run_workers_bench(concurrency=conc, duration=secs)))
     elif len(sys.argv) > 3 and sys.argv[3] == "batch":
         print(json.dumps(run_batch_bench(concurrency=conc, duration=secs)))

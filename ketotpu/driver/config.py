@@ -140,6 +140,24 @@ def _defaults() -> Dict[str, Any]:
             # multi-chip: 0 = single device; n>0 = shard over an n-device mesh
             "mesh_devices": 0,
             "mesh_axis": "shard",
+            # sharded-serving policy (parallel/meshengine.py), active only
+            # with mesh_devices > 0: the replication controller copies the
+            # count-min sketch's hottest (ns, obj) closure/CSR segments
+            # onto extra shards (replicate_hot; hot_min = admission
+            # estimate, replica_max_keys = map cap), the rebalancer
+            # repartitions when routed-load skew crosses rebalance_skew
+            # (checked every interval_ms on a background thread; 0 keeps
+            # the controller manual/synchronous), and failover degrades a
+            # faulted shard to replicas / the host oracle instead of
+            # failing the wave.
+            "mesh": {
+                "replicate_hot": True,
+                "hot_min": 64,
+                "replica_max_keys": 32,
+                "rebalance_skew": 4.0,
+                "interval_ms": 0,
+                "failover": True,
+            },
             # optional projection checkpoint path: resumed at boot when it
             # matches the store version + namespace config; every full
             # rebuild refreshes it (engine/checkpoint.py)
@@ -315,6 +333,9 @@ class Provider:
                           "sniff_timeout_ms", "accept_backlog",
                           "http_workers", "device_error_rate",
                           "device_stall_ms", "socket_drop_rate",
+                          "shard_error_rate", "shard_id",
+                          "replicate_hot", "hot_min", "replica_max_keys",
+                          "rebalance_skew", "interval_ms",
                           "latency_ms", "latency_rate", "max_pairs",
                           "rebuild_delta_pairs", "rebuild_dirty_sets",
                           "barrier_timeout_ms", "barrier_poll_ms",
@@ -491,7 +512,7 @@ class Provider:
                     key, f"must be a non-negative integer, got {val!r}"
                 )
         for key in ("faults.device_error_rate", "faults.socket_drop_rate",
-                    "faults.latency_rate"):
+                    "faults.latency_rate", "faults.shard_error_rate"):
             val = self.get(key, 0)
             if not isinstance(val, (int, float)) or not (0 <= val <= 1):
                 raise ConfigError(key, f"must be a rate in [0, 1], got {val!r}")
@@ -536,6 +557,28 @@ class Provider:
                 raise ConfigError(
                     key, f"must be a positive integer, got {val!r}"
                 )
+        for key in ("engine.mesh.replicate_hot", "engine.mesh.failover"):
+            val = self.get(key)
+            if not isinstance(val, bool):
+                raise ConfigError(key, f"must be a boolean, got {val!r}")
+        for key in ("engine.mesh.hot_min", "engine.mesh.replica_max_keys"):
+            val = self.get(key)
+            if not isinstance(val, int) or val < 1:
+                raise ConfigError(
+                    key, f"must be a positive integer, got {val!r}"
+                )
+        val = self.get("engine.mesh.rebalance_skew")
+        if not isinstance(val, (int, float)) or val < 1:
+            raise ConfigError(
+                "engine.mesh.rebalance_skew",
+                f"must be a number >= 1, got {val!r}",
+            )
+        val = self.get("engine.mesh.interval_ms")
+        if not isinstance(val, (int, float)) or val < 0:
+            raise ConfigError(
+                "engine.mesh.interval_ms",
+                f"must be a non-negative number, got {val!r}",
+            )
         if not isinstance(self.get("leopard.enabled", True), bool):
             raise ConfigError(
                 "leopard.enabled",

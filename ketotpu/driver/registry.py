@@ -560,6 +560,24 @@ class Registry:
                             mesh_axis=str(
                                 self.config.get("engine.mesh_axis") or "shard"
                             ),
+                            replicate_hot=bool(self.config.get(
+                                "engine.mesh.replicate_hot", True
+                            )),
+                            hot_min=int(self.config.get(
+                                "engine.mesh.hot_min", 64
+                            )),
+                            replica_max_keys=int(self.config.get(
+                                "engine.mesh.replica_max_keys", 32
+                            )),
+                            rebalance_skew=float(self.config.get(
+                                "engine.mesh.rebalance_skew", 4.0
+                            )),
+                            rebalance_interval_ms=float(self.config.get(
+                                "engine.mesh.interval_ms", 0
+                            ) or 0),
+                            failover=bool(self.config.get(
+                                "engine.mesh.failover", True
+                            )),
                             **common,
                         )
                     else:
@@ -925,6 +943,26 @@ class Registry:
             m.gauge("keto_mesh_shard_gen_occupancy", row["gen_occupancy"],
                     help="last general dispatch's BFS occupancy partial",
                     shard=s)
+            m.gauge("keto_mesh_replica_keys", row.get("replica_keys", 0),
+                    help="hot keys replicated ONTO this shard", shard=s)
+            m.gauge("keto_mesh_shard_down", int(row.get("down", False)),
+                    help="1 while this shard is degraded to fallback "
+                         "serving after a device fault", shard=s)
+        # engine-level replication / rebalance / failover counters (the
+        # single-device engine reports the same names at zero so the
+        # vocabulary is scrape-stable across engine kinds)
+        mesh_fn = getattr(eng, "mesh_stats", None)
+        ms = mesh_fn() if mesh_fn is not None else {}
+        m.gauge("keto_mesh_replica_routed", ms.get("replica_routed", 0),
+                help="root queries served by a non-owner replica")
+        m.gauge("keto_mesh_replications", ms.get("replications", 0),
+                help="hot keys replicated by the controller")
+        m.gauge("keto_mesh_rebalances", ms.get("rebalances", 0),
+                help="skew-triggered repartition publishes")
+        m.gauge("keto_mesh_shard_recoveries", ms.get("shard_recoveries", 0),
+                help="faulted shards recovered and re-shipped")
+        m.gauge("keto_mesh_load_skew", ms.get("skew", 1.0),
+                help="max/mean per-shard routed-root load ratio")
 
     def health(self) -> Dict[str, str]:
         """Readiness probe results per check: "ok", a returned string

@@ -512,6 +512,10 @@ class CoalescingEngine:
         leo_before = int(getattr(inner, "leopard_answered", 0) or 0)
         fb_before = int(getattr(inner, "fallbacks", 0) or 0)
         phase_before = dict(getattr(inner, "phase_seconds", None) or {})
+        # per-shard wave accounting (mesh serving): routed-root deltas
+        # across this wave's dispatches land in the ledger entry
+        routes_fn = getattr(inner, "shard_route_counts", None)
+        shards_before = routes_fn() if routes_fn is not None else None
         device_s = 0.0
         if prepared is None:
             prepared = self._prepare(wave)
@@ -584,9 +588,18 @@ class CoalescingEngine:
                     g.event.set()
         if self.ledger is not None:
             try:
+                shard_delta = None
+                if shards_before is not None:
+                    after = routes_fn()
+                    shard_delta = {
+                        str(i): int(d)
+                        for i, d in enumerate(after - shards_before)
+                        if d > 0
+                    }
                 self._file_wave(
                     wave_id, wave, len(prepared), device_s,
                     leo_before, fb_before, phase_before,
+                    shards=shard_delta,
                 )
             except Exception:  # noqa: BLE001 - diagnostics must never
                 pass  # take down the wave worker
@@ -667,9 +680,11 @@ class CoalescingEngine:
 
     def _file_wave(self, wave_id: int, wave: List[_Slot], n_groups: int,
                    device_s: float, leo_before: int, fb_before: int,
-                   phase_before: dict) -> None:
+                   phase_before: dict, shards: Optional[dict] = None) -> None:
         """One ledger record per wave: occupancy, waits, device time,
-        short-circuit counts, engine phase deltas, slowest traceparents."""
+        short-circuit counts, engine phase deltas, slowest traceparents —
+        and, when the inner engine is sharded, the per-shard routed-root
+        deltas this wave produced."""
         inner = self.inner
         waits = sorted(
             (s.t_dispatch - s.t_enq) for s in wave
@@ -718,6 +733,7 @@ class CoalescingEngine:
                 0, int(getattr(inner, "fallbacks", 0) or 0) - fb_before
             ),
             "errors": sum(1 for s in wave if s.error is not None),
+            "shards": shards or {},
             "phase_ms": phase_ms,
             "slowest": [
                 {

@@ -424,7 +424,7 @@ class DeviceCheckEngine:
         self._snap_fingerprint = fingerprint
         self._overlay = dl.OverlayState()
         self._overlay_active = False
-        old_shapes = self._array_shapes(self._device_arrays)
+        old_shapes = self._swap_shape_signature()
         t0 = time.perf_counter()
         self._install_device_arrays()
         jax.block_until_ready(jax.tree_util.tree_leaves(self._device_arrays))
@@ -438,7 +438,7 @@ class DeviceCheckEngine:
         self._pending = []
         self.last_compaction_mode = "rebuild"
         self._projection_phases(ph)
-        new_shapes = self._array_shapes(self._device_arrays)
+        new_shapes = self._swap_shape_signature()
         if (
             old_shapes is not None and new_shapes is not None
             and new_shapes == old_shapes
@@ -615,6 +615,14 @@ class DeviceCheckEngine:
             for k, v in d.items()
         }
 
+    def _swap_shape_signature(self) -> Optional[dict]:
+        """Signature of the arrays a generation swap actually re-ships.
+        The mesh engine overrides this to sign its sharded stacks: its
+        replicated ``_device_arrays`` is a lazy expand-only copy that a
+        rebuild nulls, which would otherwise read as a shape change (and
+        re-arm the compile observatory) on every sharded rebuild."""
+        return self._array_shapes(self._device_arrays)
+
     def _projection_phases(self, ph: dict) -> None:
         """File per-phase build/fold seconds into the engine phase
         accumulators and the keto_projection_phase_seconds histogram."""
@@ -696,7 +704,7 @@ class DeviceCheckEngine:
         except dl.FoldRejected:
             return False
         self.projection_build_s = time.perf_counter() - t0
-        old_shapes = self._array_shapes(self._device_arrays)
+        old_shapes = self._swap_shape_signature()
         self._snap = snap
         self._snap_fingerprint = fingerprint
         self._snap_cursor = self._log_cursor
@@ -713,7 +721,7 @@ class DeviceCheckEngine:
         self.folds += 1
         self.last_compaction_mode = "fold"
         self._projection_phases(ph)
-        new_shapes = self._array_shapes(self._device_arrays)
+        new_shapes = self._swap_shape_signature()
         if old_shapes is None or new_shapes != old_shapes:
             self._gen_sched_cache.clear()
             self._clean_dispatches = 0
@@ -816,7 +824,7 @@ class DeviceCheckEngine:
                         self._log_cursor = head
                         self._note_since_base(tail)
                         self._leopard_fold(tail)
-                    old_shapes = self._array_shapes(self._device_arrays)
+                    old_shapes = self._swap_shape_signature()
                     self._snap = new_snap
                     self._snap_fingerprint = fingerprint
                     self._snap_cursor = pin_cursor
@@ -838,7 +846,7 @@ class DeviceCheckEngine:
                         self.rebuilds += 1
                     self.last_compaction_mode = mode
                     self._projection_phases(ph)
-                    new_shapes = self._array_shapes(self._device_arrays)
+                    new_shapes = self._swap_shape_signature()
                     if old_shapes is None or new_shapes != old_shapes:
                         self._gen_sched_cache.clear()
                         self._clean_dispatches = 0

@@ -26,6 +26,10 @@ Knobs (env var / ``configure`` kwarg):
 * ``KETO_FAULT_LATENCY_MS`` + ``KETO_FAULT_LATENCY_RATE`` /
   ``latency_ms``, ``latency_rate`` — latency spike (rate defaults to 1.0
   when a spike is configured);
+* ``KETO_FAULT_SHARD_ERROR_RATE`` + ``KETO_FAULT_SHARD_ID`` /
+  ``shard_error_rate``, ``shard_id`` — probability a single mesh shard's
+  device faults (``MeshCheckEngine`` degrades that shard to replica /
+  oracle serving instead of failing the wave; ``shard_id`` names which);
 * ``KETO_FAULT_SEED`` / ``seed`` — deterministic RNG seed.
 """
 
@@ -51,11 +55,15 @@ class FaultPlan:
         socket_drop_rate: float = 0.0,
         latency_ms: float = 0.0,
         latency_rate: Optional[float] = None,
+        shard_error_rate: float = 0.0,
+        shard_id: int = -1,
         seed: Optional[int] = None,
     ):
         self.device_error_rate = float(device_error_rate)
         self.device_stall_ms = float(device_stall_ms)
         self.socket_drop_rate = float(socket_drop_rate)
+        self.shard_error_rate = float(shard_error_rate)
+        self.shard_id = int(shard_id)
         self.latency_ms = float(latency_ms)
         if latency_rate is None:
             latency_rate = 1.0 if latency_ms > 0 else 0.0
@@ -73,6 +81,7 @@ class FaultPlan:
             self.device_error_rate
             or self.device_stall_ms
             or self.socket_drop_rate
+            or self.shard_error_rate
             or (self.latency_ms and self.latency_rate)
         )
 
@@ -101,12 +110,15 @@ class FaultPlan:
 
         seed_raw = env.get("KETO_FAULT_SEED", "")
         rate_raw = env.get("KETO_FAULT_LATENCY_RATE", "")
+        shard_raw = env.get("KETO_FAULT_SHARD_ID", "")
         return cls(
             device_error_rate=f("KETO_FAULT_DEVICE_ERROR_RATE"),
             device_stall_ms=f("KETO_FAULT_DEVICE_STALL_MS"),
             socket_drop_rate=f("KETO_FAULT_SOCKET_DROP_RATE"),
             latency_ms=f("KETO_FAULT_LATENCY_MS"),
             latency_rate=float(rate_raw) if rate_raw else None,
+            shard_error_rate=f("KETO_FAULT_SHARD_ERROR_RATE"),
+            shard_id=int(shard_raw) if shard_raw else -1,
             seed=int(seed_raw) if seed_raw else None,
         )
 
@@ -150,6 +162,8 @@ def configure_from_config(cfg) -> None:
         socket_drop_rate=block.get("socket_drop_rate", 0.0),
         latency_ms=block.get("latency_ms", 0.0),
         latency_rate=block.get("latency_rate") or None,
+        shard_error_rate=block.get("shard_error_rate", 0.0),
+        shard_id=block.get("shard_id", -1),
         seed=block.get("seed") or None,
     )
 
@@ -184,5 +198,27 @@ def should(kind: str) -> bool:
         return False
     if kind == "socket_drop" and p._roll(p.socket_drop_rate):
         p._count("socket_drop")
+        return True
+    return False
+
+
+def shard_faulted(shard: int) -> bool:
+    """True while the plan TARGETS this shard (no roll): the mesh engine
+    keeps a targeted shard marked down until the plan stops naming it —
+    recovery is the plan changing, not a lucky roll."""
+    p = _plan
+    return bool(
+        p.active and p.shard_error_rate > 0 and p.shard_id == int(shard)
+    )
+
+
+def shard_down(shard: int) -> bool:
+    """Roll for a device fault on one mesh shard.  Counted so chaos tests
+    can assert the storm actually fired."""
+    p = _plan
+    if not shard_faulted(shard):
+        return False
+    if p._roll(p.shard_error_rate):
+        p._count("shard_error")
         return True
     return False

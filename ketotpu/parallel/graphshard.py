@@ -70,6 +70,7 @@ def build_sharded_snapshot(
     n_shards: int,
     vocab: Optional[Vocab] = None,
     cols=None,
+    replicate: Optional[Dict[Tuple[int, int], Sequence[int]]] = None,
 ) -> Tuple[List[Snapshot], Dict[str, np.ndarray]]:
     """Partition the store by owner shard and build one snapshot per shard.
 
@@ -82,6 +83,15 @@ def build_sharded_snapshot(
     rebuild reuses its freshly synced mirror; built here otherwise), not a
     per-tuple Python loop: each shard's snapshot projects through the same
     `build_snapshot_cols` numpy path as the single-chip engine.
+
+    ``replicate`` maps hot (ns_id, obj_id) keys to extra shards that get a
+    COPY of those rows on top of their hash-owned partition.  The hash
+    owner always keeps its rows (replication copies, never moves), so
+    child routing by hash stays correct; a replicated root query may be
+    assigned to any of its replicas via `sharded_check`'s ``assign``
+    column.  Replica copies pad into the existing max-shard shapes in the
+    common case, so publishing a replica map usually keeps the stacked
+    signature — and the jit cache — warm.
     """
     from ketotpu.engine import delta as dl
 
@@ -104,11 +114,24 @@ def build_sharded_snapshot(
 
     live = np.flatnonzero(cols.alive[: cols.n])
     shard = shard_of_np(cols.ns[live], cols.obj[live], n_shards)
+    extra = [np.zeros(0, np.int64)] * n_shards
+    if replicate:
+        packed = (
+            np.asarray(cols.ns[live], np.int64) << 32
+        ) | (np.asarray(cols.obj[live], np.int64) & 0xFFFFFFFF)
+        for (ns_id, obj_id), shards_for in replicate.items():
+            key = (np.int64(ns_id) << 32) | (np.int64(obj_id) & 0xFFFFFFFF)
+            rows = live[packed == key]
+            if rows.size == 0:
+                continue
+            for s in shards_for:
+                extra[int(s)] = np.concatenate([extra[int(s)], rows])
     version = getattr(store, "version", -1)
     snaps: List[Snapshot] = []
     for s in range(n_shards):
         keep = np.zeros(cols.n, bool)
         keep[live[shard == s]] = True
+        keep[extra[s]] = True
         snaps.append(
             dl.build_snapshot_cols(
                 cols.masked(keep), manager, version=version
@@ -263,6 +286,72 @@ def _sharded_general_run(
     )(g, qp)
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "mesh", "axis", "n", "cap", "frontier", "arena", "max_width",
+        "max_depth",
+    ),
+)
+def _sharded_fast_run(
+    g, q_ns, q_obj, q_rel, q_subj, q_depth, act, assign, *,
+    mesh: Mesh, axis, n, cap, frontier, arena, max_width, max_depth
+):
+    # module-level jit: the per-call closure this replaces produced a new
+    # function object each dispatch, retracing + recompiling the sharded
+    # program on every wave — the root cause of the mesh engine's
+    # always-cold serving behavior noted in PR 8
+    def local(g, q_ns, q_obj, q_rel, q_subj, q_depth, act, assign):
+        # P(axis) leaves a leading block dim of 1 on this shard's slice
+        g = jax.tree_util.tree_map(lambda a: a[0], g)
+        NS, R = g["f_direct_ok"].shape
+        me = jax.lax.axis_index(axis)
+        # root activation follows the host-provided assignment column —
+        # the hash owner by default, a least-loaded replica for hot keys
+        mine = assign == me
+        s = fp._init_state(
+            q_ns, q_obj, q_rel, q_subj, q_depth, act & mine,
+            frontier=frontier,
+        )
+        for _ in range(max_depth):
+            children, q_found, q_over, q_dirty = fp.expand_phase(
+                g, s, arena=arena, max_width=max_width
+            )
+            # children always route to their HASH owner (replication
+            # copies rows, never moves them, so the owner has them)
+            children, q_over = _route(children, n, cap, q_over, axis)
+            # merge found bits across shards before packing so arrived
+            # children of already-found queries die immediately
+            q_found = (
+                jax.lax.psum(q_found.astype(jnp.int32), axis) > 0
+            )
+            # ns_dim/rel_dim unlock the linear hash-scatter dedup — the
+            # sort fallback was the dominant per-level cost on shards
+            nxt, q_over = fp.pack_phase(
+                children, q_found, q_over, frontier=frontier,
+                ns_dim=NS, rel_dim=R,
+            )
+            s = dict(nxt, q_found=q_found, q_over=q_over,
+                     q_dirty=q_dirty, q_subj=s["q_subj"])
+        q_found = jax.lax.psum(s["q_found"].astype(jnp.int32), axis) > 0
+        q_over = jax.lax.psum(s["q_over"].astype(jnp.int32), axis) > 0
+        # a dirty hit on ANY shard voids that query's device verdict
+        # (unless found: found-bits are overlay-exact and monotone)
+        q_dirty = jax.lax.psum(s["q_dirty"].astype(jnp.int32), axis) > 0
+        return q_found, q_over, q_dirty
+
+    return jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(
+            jax.tree_util.tree_map(lambda _: P(axis), g),
+            P(), P(), P(), P(), P(), P(), P(),
+        ),
+        out_specs=(P(), P(), P()),
+        check_vma=False,
+    )(g, q_ns, q_obj, q_rel, q_subj, q_depth, act, assign)
+
+
 def sharded_check(
     stacked_g: Dict[str, np.ndarray],
     queries: Sequence[np.ndarray],
@@ -274,12 +363,15 @@ def sharded_check(
     max_depth: int = 5,
     max_width: int = 100,
     active=None,
+    assign=None,
 ) -> fp.FastResult:
     """Check a replicated query batch against the sharded graph.
 
-    Queries are visible to every shard; each root item activates only on its
-    owner.  Found/overflow bits are psum-merged every level so short-circuit
-    masking works across shards.
+    Queries are visible to every shard; each root item activates only on
+    the shard named by its ``assign`` slot (the hash owner when ``assign``
+    is None — replica routing passes an explicit column so hot keys can
+    activate on a least-loaded replica instead).  Found/overflow bits are
+    psum-merged every level so short-circuit masking works across shards.
     """
     n = mesh.devices.size
     q_ns, q_obj, q_rel, q_subj, q_depth = (
@@ -289,65 +381,21 @@ def sharded_check(
     act = (
         jnp.ones((Q,), bool) if active is None else jnp.asarray(active, bool)
     )
+    if assign is None:
+        assign = shard_of_np(
+            np.clip(np.asarray(queries[0], np.int64), 0, None),
+            np.clip(np.asarray(queries[1], np.int64), 0, None), n,
+        )
+    assign = jnp.asarray(assign, jnp.int32)
     cap = max(arena // max(n, 1), 8)
-
-    @functools.partial(
-        jax.jit, static_argnames=("frontier", "arena", "max_width", "max_depth")
-    )
-    def run(g, q_ns, q_obj, q_rel, q_subj, q_depth, act, *, frontier, arena,
-            max_width, max_depth):
-        def local(g, q_ns, q_obj, q_rel, q_subj, q_depth, act):
-            # P(axis) leaves a leading block dim of 1 on this shard's slice
-            g = jax.tree_util.tree_map(lambda a: a[0], g)
-            NS, R = g["f_direct_ok"].shape
-            me = jax.lax.axis_index(axis)
-            mine = shard_of_device(q_ns, q_obj, n) == me
-            s = fp._init_state(
-                q_ns, q_obj, q_rel, q_subj, q_depth, act & mine,
-                frontier=frontier,
-            )
-            for _ in range(max_depth):
-                children, q_found, q_over, q_dirty = fp.expand_phase(
-                    g, s, arena=arena, max_width=max_width
-                )
-                children, q_over = _route(children, n, cap, q_over, axis)
-                # merge found bits across shards before packing so arrived
-                # children of already-found queries die immediately
-                q_found = (
-                    jax.lax.psum(q_found.astype(jnp.int32), axis) > 0
-                )
-                # ns_dim/rel_dim unlock the linear hash-scatter dedup — the
-                # sort fallback was the dominant per-level cost on shards
-                nxt, q_over = fp.pack_phase(
-                    children, q_found, q_over, frontier=frontier,
-                    ns_dim=NS, rel_dim=R,
-                )
-                s = dict(nxt, q_found=q_found, q_over=q_over,
-                         q_dirty=q_dirty, q_subj=s["q_subj"])
-            q_found = jax.lax.psum(s["q_found"].astype(jnp.int32), axis) > 0
-            q_over = jax.lax.psum(s["q_over"].astype(jnp.int32), axis) > 0
-            # a dirty hit on ANY shard voids that query's device verdict
-            # (unless found: found-bits are overlay-exact and monotone)
-            q_dirty = jax.lax.psum(s["q_dirty"].astype(jnp.int32), axis) > 0
-            return q_found, q_over, q_dirty
-
-        return jax.shard_map(
-            local,
-            mesh=mesh,
-            in_specs=(
-                jax.tree_util.tree_map(lambda _: P(axis), g),
-                P(), P(), P(), P(), P(), P(),
-            ),
-            out_specs=(P(), P(), P()),
-            check_vma=False,
-        )(g, q_ns, q_obj, q_rel, q_subj, q_depth, act)
 
     with compilewatch.scope(
         "sharded_check",
         lambda: f"Q={Q} n={n} frontier={frontier} arena={arena}",
     ):
-        found, over, dirty = run(
-            stacked_g, q_ns, q_obj, q_rel, q_subj, q_depth, act,
+        found, over, dirty = _sharded_fast_run(
+            stacked_g, q_ns, q_obj, q_rel, q_subj, q_depth, act, assign,
+            mesh=mesh, axis=axis, n=n, cap=cap,
             frontier=frontier, arena=arena, max_width=max_width,
             max_depth=max_depth,
         )

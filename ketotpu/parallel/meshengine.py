@@ -38,16 +38,26 @@ the fast-path dispatch.  Sharded differences:
 
 from __future__ import annotations
 
+import threading
 import time
-from typing import List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ketotpu import compilewatch, faults
+from ketotpu.cache.hotspot import HotSpotSketch
 from ketotpu.engine import delta as dl
 from ketotpu.engine.optable import R_ERR, R_IS
 from ketotpu.engine.tpu import DeviceCheckEngine, _bucket, _bucket15
 from ketotpu.parallel import graphshard
 from ketotpu.parallel.mesh import make_mesh
+
+
+def _pack_keys(ns_ids: np.ndarray, obj_ids: np.ndarray) -> np.ndarray:
+    """(ns, obj) id pairs packed into one int64 key (vectorized compare)."""
+    return (
+        np.clip(np.asarray(ns_ids, np.int64), 0, None) << 32
+    ) | (np.clip(np.asarray(obj_ids, np.int64), 0, None) & 0xFFFFFFFF)
 
 
 class MeshCheckEngine(DeviceCheckEngine):
@@ -67,6 +77,12 @@ class MeshCheckEngine(DeviceCheckEngine):
         mesh_devices: int,
         mesh_axis: str = "shard",
         replica_budget_mb: int = 8192,
+        replicate_hot: bool = True,
+        hot_min: int = 64,
+        replica_max_keys: int = 32,
+        rebalance_skew: float = 4.0,
+        rebalance_interval_ms: float = 0.0,
+        failover: bool = True,
         **kwargs,
     ):
         super().__init__(store, namespace_manager, **kwargs)
@@ -103,6 +119,43 @@ class MeshCheckEngine(DeviceCheckEngine):
         # per-shard Leopard closure segments (pair counts by owner set)
         self._leo_shard_pairs = np.zeros(mesh_devices, np.int64)
         self._leo_segments = None
+        # -- production serving state (hot replication / rebalance /
+        # failover) ----------------------------------------------------
+        self.replicate_hot = bool(replicate_hot)
+        self.hot_min = int(hot_min)
+        self.replica_max_keys = int(replica_max_keys)
+        self.rebalance_skew = float(rebalance_skew)
+        self.rebalance_interval_ms = float(rebalance_interval_ms)
+        self.failover_enabled = bool(failover)
+        # count-min sketch over root (ns, obj) keys: the replication
+        # controller's hot-key feed (same sketch the cache shield uses)
+        self._hot = HotSpotSketch(top_k=max(self.replica_max_keys, 16))
+        # (ns_id, obj_id) -> extra shards holding a COPY of the key's
+        # rows; published only via the generation-swap in
+        # _publish_replica_map, read lock-free on the dispatch path
+        self._replica_map: Dict[Tuple[int, int], Tuple[int, ...]] = {}
+        # per-shard routed-root counts: the skew signal, the least-loaded
+        # replica choice, and the per-shard wave accounting feed
+        self._shard_batches = np.zeros(mesh_devices, np.int64)
+        self._shard_down = np.zeros(mesh_devices, bool)
+        self.replica_routed = 0
+        self.replications = 0
+        self.rebalances = 0
+        self.shard_recoveries = 0
+        # collectives over ONE mesh cannot overlap: two in-flight
+        # executions of the sharded program interleave their all_to_all
+        # rendezvous on the host backend and starve each other, so every
+        # device launch (and the shared routing counters) serializes here
+        self._mesh_run_lock = threading.Lock()
+        self._rebal_stop = threading.Event()
+        self._rebal_thread: Optional[threading.Thread] = None
+        if self.rebalance_interval_ms > 0 and mesh_devices > 1:
+            t = threading.Thread(
+                target=self._rebal_worker, name="keto-mesh-rebalancer",
+                daemon=True,
+            )
+            self._rebal_thread = t
+            t.start()
 
     def _install_leopard(self) -> None:
         """Build the closure index, then partition its element pairs into
@@ -145,6 +198,7 @@ class MeshCheckEngine(DeviceCheckEngine):
             graphshard.build_sharded_snapshot(
                 self.store, self.namespace_manager, self.n_shards,
                 self._vocab, cols=self._cols,
+                replicate=self._replica_map,
             )
         )
         # overlay admission checks relation-level pairs against dyn_pairs;
@@ -162,6 +216,12 @@ class MeshCheckEngine(DeviceCheckEngine):
         self._stacked = dict(
             self._stacked_base, **self._overlay_stacks()
         )
+
+    def _swap_shape_signature(self):
+        """The mesh serves from the sharded STACKS — sign those across a
+        generation swap, not the lazily-built replicated expand copy
+        (which a rebuild nulls and would read as always-changed)."""
+        return self._array_shapes(self._stacked)
 
     def _overlay_stacks(self):
         """Per-shard overlay arrays, padded to common shapes and stacked
@@ -211,10 +271,18 @@ class MeshCheckEngine(DeviceCheckEngine):
                 s = int(graphshard.shard_of_np(
                     np.array([ns]), np.array([obj]), self.n_shards
                 )[0])
-                dl.apply_changes(
-                    self._shard_overlays[s], self._shard_snaps[s],
-                    self._vocab, [(op_, t)],
+                # replicated keys fold the change into EVERY copy's
+                # overlay too — a replica serving the key's roots must
+                # see the same write-visible verdicts as the hash owner
+                targets = {s}
+                targets.update(
+                    self._replica_map.get((int(ns), int(obj)), ())
                 )
+                for tgt in targets:
+                    dl.apply_changes(
+                        self._shard_overlays[tgt], self._shard_snaps[tgt],
+                        self._vocab, [(op_, t)],
+                    )
         except dl.OverlayRejected:
             return False
         pairs = sum(o.size()[0] for o in self._shard_overlays)
@@ -263,18 +331,28 @@ class MeshCheckEngine(DeviceCheckEngine):
         # exactly like the single-chip engine
         return super()._expand_arrays()
 
-    def _sharded_run(self, stacked, padded, active, boost: int = 1):
-        return graphshard.sharded_check(
-            stacked,
-            padded,
-            self.mesh,
-            axis=self.mesh_axis,
-            frontier=boost * self.frontier,
-            arena=boost * self.arena,
-            max_depth=self.max_depth,
-            max_width=self.max_width,
-            active=active,
-        )
+    def _sharded_run(self, stacked, padded, active, boost: int = 1,
+                     assign=None):
+        import jax
+
+        # collectives over one mesh must not overlap: launch AND finish
+        # under the run lock (two in-flight sharded programs interleave
+        # their all_to_all rendezvous on the host backend and starve)
+        with self._mesh_run_lock:
+            res = graphshard.sharded_check(
+                stacked,
+                padded,
+                self.mesh,
+                axis=self.mesh_axis,
+                frontier=boost * self.frontier,
+                arena=boost * self.arena,
+                max_depth=self.max_depth,
+                max_width=self.max_width,
+                active=active,
+                assign=assign,
+            )
+            jax.block_until_ready(res)
+        return res
 
     def _run_general_mesh(self, stacked, enc, gi, boost: int = 1):
         """One fused algebra dispatch over the SHARDED graph stacks for
@@ -292,17 +370,92 @@ class MeshCheckEngine(DeviceCheckEngine):
         qpack = np.stack([*genc, active.astype(np.int32)]).astype(np.int32)
         # GLOBAL shapes: the whole batch's skeleton lives on every shard
         sizes, fast_b, fast_sched, vcap = self._gen_schedule(qpad, boost)
-        codes, occ = graphshard.sharded_general_check(
-            stacked, qpack, self.mesh, axis=self.mesh_axis,
-            sizes=sizes, fast_b=fast_b, fast_sched=fast_sched,
-            max_width=self.max_width, vcap=vcap,
-        )
+        import jax
+
+        with self._mesh_run_lock:  # see _sharded_run: collectives serialize
+            codes, occ = graphshard.sharded_general_check(
+                stacked, qpack, self.mesh, axis=self.mesh_axis,
+                sizes=sizes, fast_b=fast_b, fast_sched=fast_sched,
+                max_width=self.max_width, vcap=vcap,
+            )
+            jax.block_until_ready((codes, occ))
         return codes, occ, n, fast_b
+
+    # -- routing / failover -------------------------------------------------
+
+    def _route_assign(self, ns_ids, obj_ids):
+        """Per-root serving-shard assignment.  Defaults to the (ns, obj)
+        hash owner; roots of replicated hot keys go to the least-loaded
+        live copy instead.  Returns (assign, owner) int32 arrays — owner
+        is the hash shard (what child routing and fallback attribution
+        use), assign is where the root actually activates."""
+        n = self.n_shards
+        ns = np.clip(np.asarray(ns_ids, np.int64), 0, None)
+        obj = np.clip(np.asarray(obj_ids, np.int64), 0, None)
+        owner = graphshard.shard_of_np(ns, obj, n)
+        assign = owner.copy()
+        rep = self._replica_map
+        if rep:
+            packed = _pack_keys(ns, obj)
+            load = self._shard_batches.astype(np.int64)
+            for (kns, kobj), extras in rep.items():
+                key = (np.int64(kns) << 32) | (
+                    np.int64(kobj) & 0xFFFFFFFF
+                )
+                m = packed == key
+                if not m.any():
+                    continue
+                kowner = int(graphshard.shard_of_np(
+                    np.array([kns]), np.array([kobj]), n
+                )[0])
+                cands = [
+                    s for s in dict.fromkeys((kowner, *extras))
+                    if not self._shard_down[s]
+                ]
+                if not cands:
+                    continue  # every copy down: stays owner -> oracle
+                best = min(cands, key=lambda s: int(load[s]))
+                if best != kowner:
+                    self.replica_routed += int(m.sum())
+                assign[m] = best
+        return assign, owner
+
+    def _poll_shard_faults(self) -> None:
+        """Advance per-shard up/down state from the fault plan: a rolled
+        shard fault marks the shard down (it degrades to replicas / the
+        host oracle — the wave keeps serving); a shard the plan stopped
+        targeting recovers on the next dispatch."""
+        if not self.failover_enabled:
+            return
+        for s in range(self.n_shards):
+            if self._shard_down[s]:
+                if not faults.shard_faulted(s):
+                    self._recover_shard(s)
+            elif faults.shard_down(s):
+                self._shard_down[s] = True
+                self._device_failure()
+
+    def _recover_shard(self, s: int) -> None:
+        """Bring a faulted shard back: re-ship its segments (the whole
+        stacked view refreshes — the per-shard slices are one device_put
+        away) and zero its fallback attribution so recovery is observable
+        as `keto_mesh_shard_fallbacks{shard=s}` returning to zero."""
+        with self._sync_lock:
+            if not self._shard_down[s]:
+                return
+            self._shard_down[s] = False
+            if self._stacked_base is not None:
+                self._stacked = dict(
+                    self._stacked_base, **self._overlay_stacks()
+                )
+            self._shard_fallbacks[s] = 0
+            self.shard_recoveries += 1
 
     def _dispatch(self, queries, rest_depth: int):
         n = len(queries)
         if n == 0:
             return None
+        faults.inject("device_dispatch")
         self.dispatches += 1
         t0 = time.perf_counter()
         with self._sync_lock:
@@ -326,22 +479,47 @@ class MeshCheckEngine(DeviceCheckEngine):
         if cache_res is not None:
             act &= ~cache_res[0]
             general = general & ~cache_res[0]
+        self._poll_shard_faults()
+        assign, owner = self._route_assign(enc[0], enc[1])
+        if self._shard_down.any():
+            # roots whose serving shard is down and that no live replica
+            # can absorb degrade to the host oracle; the wave itself keeps
+            # serving (general roots activate by hash owner on-device, so
+            # a down owner sends them to the oracle too)
+            down_fast = act & self._shard_down[assign]
+            down_gen = general & self._shard_down[owner]
+            act = act & ~down_fast
+            general = general & ~down_gen
+            err = err | down_fast | down_gen
+        if self.replicate_hot and act.any():
+            live = np.flatnonzero(act)
+            self._hot.observe_many(list(zip(
+                np.clip(np.asarray(enc[0])[live], 0, None).tolist(),
+                np.clip(np.asarray(enc[1])[live], 0, None).tolist(),
+            )))
+        # per-shard routed-root accounting: the skew/rebalance signal and
+        # the wave ledger's per-shard deltas
+        with self._mesh_run_lock:
+            np.add.at(self._shard_batches, assign[act], 1)
+            if general.any():
+                np.add.at(self._shard_batches, owner[general], 1)
         qpad = min(_bucket(n), self.frontier)
         padded = self._pad(enc, n, qpad)
         active = np.pad(act, (0, qpad - n))
+        passign = np.pad(assign, (0, qpad - n))
         self._phase("check_encode", time.perf_counter() - t0)
         t0 = time.perf_counter()
-        res = self._sharded_run(stacked, padded, active)
+        res = self._sharded_run(stacked, padded, active, assign=passign)
         gres = gi = None
         if general.any():
             gi = np.flatnonzero(general)
             gres = self._run_general_mesh(stacked, enc, gi)
         self._phase("check_mesh_dispatch", time.perf_counter() - t0)
-        return (enc, err, general, res, gi, gres, stacked, None, leo_res,
+        return (enc, err, general, res, gi, gres, stacked, assign, leo_res,
                 cache_res, cursor)
 
     def _collect(self, handle, retry: bool = True):
-        (enc, fallback_mask, general, res, gi, gres, stacked, replica,
+        (enc, fallback_mask, general, res, gi, gres, stacked, assign,
          leo_res, cache_res, _cursor) = handle
         n = fallback_mask.shape[0]
         allowed = np.zeros(n, bool)
@@ -404,8 +582,12 @@ class MeshCheckEngine(DeviceCheckEngine):
             renc = self._pad(tuple(a[ri] for a in enc), len(ri), rpad)
             self.retries += len(ri)
             ract = np.pad(np.ones(len(ri), bool), (0, rpad - len(ri)))
+            rassign = (
+                np.pad(assign[ri], (0, rpad - len(ri)))
+                if assign is not None else None
+            )
             rres = self._sharded_run(
-                stacked, renc, ract, boost=self.retry_scale
+                stacked, renc, ract, boost=self.retry_scale, assign=rassign,
             )
             rfound = np.asarray(rres.found)[: len(ri)]
             rover = np.asarray(rres.over)[: len(ri)]
@@ -451,6 +633,178 @@ class MeshCheckEngine(DeviceCheckEngine):
         with self._sync_lock:
             return (self._log_cursor,) * self.n_shards
 
+    # -- hot-shard replication + skew rebalancing ---------------------------
+
+    def hot_keys(self) -> List[Tuple[Tuple[int, int], int]]:
+        """Hottest (ns_id, obj_id) root keys from the count-min sketch,
+        hottest first, thresholded at ``hot_min`` estimated observations
+        and capped at ``replica_max_keys``."""
+        out = [
+            (key, est) for key, est in self._hot.top()
+            if est >= self.hot_min and isinstance(key, tuple)
+        ]
+        return out[: self.replica_max_keys]
+
+    def shard_skew(self) -> float:
+        """max/mean routed-root load ratio — the rebalance trigger."""
+        b = self._shard_batches.astype(float)
+        mean = float(b.mean())
+        return float(b.max() / mean) if mean > 0 else 1.0
+
+    def plan_replicas(self) -> Dict[Tuple[int, int], Tuple[int, ...]]:
+        """The replica map the controller would publish now: each hot key
+        keeps its existing copies (stability — no oscillation between
+        equally-loaded shards) and new hot keys get one copy on the
+        least-loaded live non-owner shard."""
+        n = self.n_shards
+        load = self._shard_batches.astype(np.int64)
+        new_map: Dict[Tuple[int, int], Tuple[int, ...]] = {}
+        for key, _est in self.hot_keys():
+            prev = self._replica_map.get(key)
+            if prev:
+                new_map[key] = prev
+                continue
+            owner = int(graphshard.shard_of_np(
+                np.array([key[0]]), np.array([key[1]]), n
+            )[0])
+            others = [
+                s for s in range(n)
+                if s != owner and not self._shard_down[s]
+            ]
+            if not others:
+                continue
+            new_map[key] = (min(others, key=lambda s: int(load[s])),)
+        return new_map
+
+    def replicate_now(self) -> int:
+        """Synchronously publish replicas for the current hot set.
+        Returns the number of newly replicated keys (0 = nothing hot, no
+        change, or the publish lost a race with a write)."""
+        if not self.replicate_hot or self.n_shards < 2:
+            return 0
+        new_map = self.plan_replicas()
+        fresh = [k for k in new_map if k not in self._replica_map]
+        if not fresh or not self._publish_replica_map(new_map):
+            return 0
+        self.replications += len(fresh)
+        return len(fresh)
+
+    def rebalance_now(self) -> bool:
+        """Skew-triggered repartition: when the routed-root skew crosses
+        ``rebalance_skew``, copy the hottest keys OWNED by the loaded
+        shard onto the least-loaded live shard and publish the new
+        sharding via generation pointer swap (zero verdict divergence:
+        replicas are copies, child routing stays by hash)."""
+        if self.n_shards < 2 or self.shard_skew() < self.rebalance_skew:
+            return False
+        b = self._shard_batches.astype(np.int64)
+        hot_shard = int(b.argmax())
+        cold = [
+            int(s) for s in np.argsort(b)
+            if int(s) != hot_shard and not self._shard_down[int(s)]
+        ]
+        if not cold:
+            return False
+        new_map = dict(self._replica_map)
+        moved = 0
+        for key, _est in self.hot_keys():
+            owner = int(graphshard.shard_of_np(
+                np.array([key[0]]), np.array([key[1]]), self.n_shards
+            )[0])
+            if owner != hot_shard or cold[0] in new_map.get(key, ()):
+                continue
+            if len(new_map) >= self.replica_max_keys and key not in new_map:
+                break
+            new_map[key] = tuple(new_map.get(key, ())) + (cold[0],)
+            moved += 1
+        if not moved or not self._publish_replica_map(new_map):
+            return False
+        self.rebalances += 1
+        return True
+
+    def _publish_replica_map(self, new_map) -> bool:
+        """Generation-swapped replica publish, modeled on the off-path
+        compactor: pin the column mirror under the sync lock, build the
+        re-replicated sharded snapshot OFF the lock (checks keep serving
+        the old sharding), then swap pointers under the lock only if no
+        write raced the build.  Same-shape swaps (the common case — the
+        replica copies pad into the existing max-shard shapes) keep the
+        compile observatory warm."""
+        with self._sync_lock:
+            self._snapshot_locked()  # drain the changelog first
+            if self._cols is None or self._shard_snaps is None:
+                return False
+            frozen = self._cols.freeze()
+            token = self._gen_token
+            pin_cursor = self._log_cursor
+            vocab = self._vocab
+        snaps, stacked_base = graphshard.build_sharded_snapshot(
+            self.store, self.namespace_manager, self.n_shards, vocab,
+            cols=frozen, replicate=new_map,
+        )
+        with self._sync_lock:
+            if token != self._gen_token or pin_cursor != self._log_cursor:
+                return False  # a write landed mid-build: next tick retries
+            old_sig = self._swap_shape_signature()
+            if self._snap is not None:
+                # overlay admission reads the GLOBAL pair set (see
+                # _install_device_arrays)
+                for sn in snaps:
+                    sn.dyn_pairs = self._snap.dyn_pairs
+            self._shard_snaps = snaps
+            self._stacked_base = stacked_base
+            # the rebuilt partitions already include every drained delta,
+            # so the per-shard overlays restart empty; the replicated
+            # overlay/_snap pair (expand + admission) is untouched
+            self._shard_overlays = [
+                dl.OverlayState() for _ in range(self.n_shards)
+            ]
+            self._stacked = dict(stacked_base, **self._overlay_stacks())
+            self._replica_map = dict(new_map)
+            self.generation += 1
+            new_sig = self._swap_shape_signature()
+            if old_sig is None or new_sig != old_sig:
+                self._gen_sched_cache.clear()
+                self._clean_dispatches = 0
+                compilewatch.get().declare_cold(
+                    "replica publish: stacked shapes changed"
+                )
+            return True
+
+    def _rebal_worker(self) -> None:
+        interval = max(self.rebalance_interval_ms, 1.0) / 1000.0
+        while not self._rebal_stop.wait(interval):
+            try:
+                if not self.rebalance_now() and self.replicate_hot:
+                    self.replicate_now()
+            except Exception:  # noqa: BLE001 - serving view must stay intact
+                self.compaction_errors += 1
+
+    def close(self) -> None:
+        self._rebal_stop.set()
+        t = self._rebal_thread
+        if t is not None and t.is_alive():
+            t.join(timeout=5.0)
+        super().close()
+
+    def shard_route_counts(self) -> np.ndarray:
+        """Cumulative per-shard routed-root counts (the coalescer diffs
+        consecutive reads for the wave ledger's per-shard accounting)."""
+        return self._shard_batches.copy()
+
+    def mesh_stats(self) -> dict:
+        """Engine-level replication / rebalance / failover counters for
+        the registry's mesh gauges."""
+        return {
+            "replica_keys": len(self._replica_map),
+            "replica_routed": int(self.replica_routed),
+            "replications": int(self.replications),
+            "rebalances": int(self.rebalances),
+            "shard_recoveries": int(self.shard_recoveries),
+            "shards_down": int(self._shard_down.sum()),
+            "skew": round(self.shard_skew(), 3),
+        }
+
     def shard_stats(self) -> List[dict]:
         """Per-shard serving counters for the registry's mesh gauges and
         `cli.py status`: overlay pressure, graph size, last general
@@ -458,6 +812,10 @@ class MeshCheckEngine(DeviceCheckEngine):
         fallbacks attributed by owner shard."""
         ovs = self._shard_overlays or []
         snaps = self._shard_snaps or []
+        replica_keys = np.zeros(self.n_shards, np.int64)
+        for extras in self._replica_map.values():
+            for s in extras:
+                replica_keys[int(s)] += 1
         out = []
         for i in range(self.n_shards):
             pairs, dirty = ovs[i].size() if i < len(ovs) else (0, 0)
@@ -466,8 +824,10 @@ class MeshCheckEngine(DeviceCheckEngine):
             )
             out.append({
                 "shard": i,
-                "batches": self.dispatches,
+                "batches": int(self._shard_batches[i]),
                 "fallbacks": int(self._shard_fallbacks[i]),
+                "replica_keys": int(replica_keys[i]),
+                "down": bool(self._shard_down[i]),
                 "overlay_pairs": int(pairs),
                 "overlay_dirty": int(dirty),
                 "nodes": nodes,

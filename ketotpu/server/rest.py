@@ -67,7 +67,7 @@ _ADMISSION_EXEMPT = {
     # while shedding traffic would blind the operator exactly when the
     # surfaces matter most
     "/debug/flight-recorder", "/debug/waves", "/debug/compiles",
-    "/debug/profile", "/debug/projection",
+    "/debug/profile", "/debug/projection", "/debug/mesh",
 }
 
 # REST paths that get the full stage decomposition (flightrec context);
@@ -779,6 +779,27 @@ def metrics_router(registry) -> Router:
         return 200, registry.projection_stats()
 
     rt.add("GET", "/debug/projection", get_projection)
+
+    def get_mesh(req):
+        # sharded-serving state (parallel/meshengine.py): per-shard
+        # batches/fallbacks/replica keys/down flags, the published
+        # replica map, and the replication/rebalance/failover counters;
+        # {} when the engine is not sharded
+        eng = registry.check_engine()
+        eng = getattr(eng, "inner", eng)
+        stats_fn = getattr(eng, "mesh_stats", None)
+        if stats_fn is None:
+            return 200, {}
+        return 200, {
+            **stats_fn(),
+            "shards": eng.shard_stats(),
+            "replica_map": [
+                {"ns": k[0], "obj": k[1], "replicas": list(v)}
+                for k, v in sorted(eng._replica_map.items())
+            ],
+        }
+
+    rt.add("GET", "/debug/mesh", get_mesh)
 
     def post_profile(req):
         # on-demand jax.profiler capture: config-gated (403 unarmed),

@@ -1029,3 +1029,90 @@ def test_acceptance_storm_against_worker_topology(tmp_path):
             proc.wait(timeout=15)
         except subprocess.TimeoutExpired:
             proc.kill()
+
+
+# -- ISSUE 10: shard-device fault storm against the mesh engine --------------
+
+
+class TestMeshShardFaultStorm:
+    """A shard-device fault mid-storm must not stop the wave: checks keep
+    answering exactly (surviving replicas / host oracle), the per-shard
+    fallback gauge moves ONLY on the faulted shard, and dropping the
+    plan restores zero-fallback serving with the victim gauge at zero."""
+
+    def test_storm_keeps_answering_and_recovers(self):
+        import numpy as np
+
+        from ketotpu.parallel import MeshCheckEngine
+        from ketotpu.parallel.graphshard import shard_of_np
+        from ketotpu.utils.synth import build_synth, synth_queries
+
+        graph = build_synth(n_users=128, n_groups=8, n_folders=64,
+                            n_docs=256, seed=7)
+        eng = MeshCheckEngine(
+            graph.store, graph.manager, mesh_devices=8,
+            frontier=1024, arena=4096, max_batch=512,
+        )
+        warm = synth_queries(graph, 128, seed=51)
+        assert eng.batch_check(warm) == [
+            eng.oracle.check_is_member(q) for q in warm
+        ]
+
+        rounds = [synth_queries(graph, 64, seed=100 + r) for r in range(6)]
+        wants = [
+            [eng.oracle.check_is_member(q) for q in qs] for qs in rounds
+        ]
+        v = eng._vocab
+        flat = [q for qs in rounds for q in qs]
+        owners = shard_of_np(
+            np.array([v.namespaces.lookup(q.namespace) for q in flat]),
+            np.array([v.objects.lookup(q.object) for q in flat]), 8,
+        )
+        victim = int(np.bincount(owners, minlength=8).argmax())
+        fb0 = np.array([r["fallbacks"] for r in eng.shard_stats()])
+
+        mismatches = []
+
+        def fire(qs, want):
+            got = eng.batch_check(qs)
+            if got != want:
+                mismatches.append((got, want))
+
+        faults.configure(shard_error_rate=1.0, shard_id=victim)
+        try:
+            threads = [
+                threading.Thread(target=fire, args=(qs, w), daemon=True)
+                for qs, w in zip(rounds, wants)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=180.0)
+            assert not any(t.is_alive() for t in threads), "storm wedged"
+            assert not mismatches, mismatches[:2]
+            assert eng.mesh_stats()["shards_down"] == 1
+            delta = np.array(
+                [r["fallbacks"] for r in eng.shard_stats()]
+            ) - fb0
+            assert delta[victim] > 0, "faulted shard took no fallbacks"
+            assert all(
+                int(d) == 0 for i, d in enumerate(delta) if i != victim
+            ), f"healthy shards took fallbacks: {delta.tolist()}"
+        finally:
+            faults.reset()
+
+        # recovery: the next dispatch polls the lifted plan, re-ships the
+        # shard, zeroes its gauge — and serving is fallback-free again
+        fb1 = np.array([r["fallbacks"] for r in eng.shard_stats()])
+        post = synth_queries(graph, 64, seed=200)
+        assert eng.batch_check(post) == [
+            eng.oracle.check_is_member(q) for q in post
+        ]
+        assert not eng._shard_down.any()
+        stats = eng.shard_stats()
+        assert stats[victim]["fallbacks"] == 0
+        assert eng.mesh_stats()["shard_recoveries"] >= 1
+        after = np.array([r["fallbacks"] for r in stats])
+        assert all(
+            int(after[i] - fb1[i]) == 0 for i in range(8) if i != victim
+        ), "recovered serving must add no fallbacks on healthy shards"

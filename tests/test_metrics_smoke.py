@@ -245,3 +245,20 @@ def test_projection_metric_vocabulary(scrape):
     assert proj["rebuilds"] >= 1  # the boot projection
     assert proj["served_cursor"] == proj["log_cursor"]
     assert "build_phases" in proj and proj["build_phases"]
+
+
+def test_mesh_serving_metric_vocabulary(scrape):
+    # ISSUE 10: replication / rebalance / failover gauges are part of the
+    # stable scrape vocabulary even on a single-device engine (zeros), so
+    # dashboards need one query either way
+    text = scrape["metrics_text"]
+    for g in ("keto_mesh_replica_keys", "keto_mesh_shard_down"):
+        assert f'{g}{{shard="0"}}' in text, g
+    for g in (
+        "keto_mesh_replica_routed",
+        "keto_mesh_replications",
+        "keto_mesh_rebalances",
+        "keto_mesh_shard_recoveries",
+        "keto_mesh_load_skew",
+    ):
+        assert g in text, g

@@ -244,6 +244,15 @@ class TestMeshCheckEngine:
                     f"&object=readme&relation=view&subject_id={subj}"
                 ) as resp:
                     assert _json.loads(resp.read())["allowed"] is want, subj
+            # the mesh debug surface rides the metrics port: per-shard
+            # rows + controller totals + the live replica map
+            maddr = "http://%s:%d" % tuple(srv.addresses["metrics"])
+            with urllib.request.urlopen(f"{maddr}/debug/mesh") as resp:
+                mesh = _json.loads(resp.read())
+            assert len(mesh["shards"]) == 8
+            assert mesh["replica_keys"] == 0
+            assert mesh["skew"] >= 1.0
+            assert mesh["replica_map"] == []
         finally:
             srv.stop()
 
@@ -488,3 +497,246 @@ def test_mesh_engine_general_synth_differential():
     )
     # full path stays exact for the fallback slice too
     assert eng.batch_check(queries) == want
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 10: production sharded serving — live waves, hot-shard replication,
+# skew rebalancing, failover
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_mesh_columnar_block_parity_bit_identical():
+    """batch_check_block through the mesh must be bit-identical to the
+    single-chip device engine over a randomized mixed workload whose
+    subject-set hops cross shards (the synth graph guarantees crossings —
+    see test_graph_sharded_parity_with_cross_shard_edges)."""
+    from ketotpu.engine import columns
+    from ketotpu.parallel import MeshCheckEngine
+    from ketotpu.utils.synth import synth_queries_mixed
+
+    graph = build_synth(n_users=128, n_groups=8, n_folders=64, n_docs=256,
+                        seed=5)
+    dev = DeviceCheckEngine(
+        graph.store, graph.manager, frontier=1024, arena=4096, max_batch=512,
+    )
+    mesh = MeshCheckEngine(
+        graph.store, graph.manager, mesh_devices=8,
+        frontier=1024, arena=4096, max_batch=512,
+    )
+    rng = np.random.default_rng(17)
+    for _trial in range(3):
+        qs = synth_queries_mixed(
+            graph, 96, seed=int(rng.integers(1 << 30)), general_frac=0.25
+        )
+        block = columns.ColumnBlock.from_tuples(qs)
+        a_dev, errs_dev = dev.batch_check_block(block, 0)
+        a_mesh, errs_mesh = mesh.batch_check_block(block, 0)
+        assert not errs_dev and not errs_mesh
+        assert np.array_equal(np.asarray(a_dev), np.asarray(a_mesh))
+
+
+@pytest.mark.slow
+def test_mesh_warm_gate_zero_compiles_across_replica_swap():
+    """ISSUE 10 satellite: a warmed mesh engine survives a same-shape
+    generation swap (replica publish re-ships the stacked partitions)
+    with ZERO new XLA compiles — the `_swap_shape_signature` gate."""
+    from ketotpu import compilewatch
+    from ketotpu.parallel import MeshCheckEngine
+
+    graph = build_synth(n_users=128, n_groups=8, n_folders=64, n_docs=256)
+    eng = MeshCheckEngine(
+        graph.store, graph.manager, mesh_devices=8,
+        frontier=1024, arena=4096, max_batch=512,
+    )
+    qs = synth_queries(graph, 128, seed=31)
+    want = [eng.oracle.check_is_member(q) for q in qs]
+    assert eng.batch_check(qs) == want  # warm-up: compiles steady shapes
+    qs2 = synth_queries(graph, 128, seed=32)
+    want2 = [eng.oracle.check_is_member(q) for q in qs2]
+    assert eng.batch_check(qs2) == want2  # same shapes, fresh cache keys
+    watch = compilewatch.get()
+    watch.declare_warm()
+    c0 = watch.compiles_total
+    gen0 = eng.generation
+
+    # copy a doc owned by the fullest shard onto the emptiest shard: the
+    # copy pads into the existing max-shard shapes, so the swap is
+    # signature-stable
+    rows = np.array([s.n_tuples for s in eng._shard_snaps])
+    target = int(rows.argmin())
+    v = eng._vocab
+    key = None
+    for t in graph.store.all_tuples():
+        ns_id = v.namespaces.lookup(t.namespace)
+        obj_id = v.objects.lookup(t.object)
+        s = int(shard_of_np(np.array([ns_id]), np.array([obj_id]), 8)[0])
+        if s == int(rows.argmax()):
+            key = (int(ns_id), int(obj_id))
+            break
+    assert key is not None
+    assert eng._publish_replica_map({key: (target,)})
+    assert eng.generation == gen0 + 1
+
+    qs3 = synth_queries(graph, 128, seed=33)
+    want3 = [eng.oracle.check_is_member(q) for q in qs3]
+    assert eng.batch_check(qs3) == want3
+    assert watch.compiles_total == c0, (
+        "XLA compiled across a same-shape replica publish"
+    )
+    assert watch.warm, "same-shape swap must not re-arm the observatory"
+
+
+@pytest.mark.slow
+def test_mesh_hot_replication_routes_and_write_visible():
+    """Hammering one object makes it sketch-hot; replicate_now publishes a
+    copy; subsequent roots route to the less-loaded replica; writes stay
+    visible through BOTH the owner and replica overlays."""
+    from ketotpu.parallel import MeshCheckEngine
+
+    graph = build_synth(n_users=128, n_groups=8, n_folders=64, n_docs=256)
+    eng = MeshCheckEngine(
+        graph.store, graph.manager, mesh_devices=8,
+        frontier=1024, arena=4096, max_batch=512,
+        hot_min=8, replica_max_keys=4,
+    )
+    users = graph.users[:32]
+    hammer = [RelationTuple.from_string(f"Doc:d7#view@{u}") for u in users]
+    want = [eng.oracle.check_is_member(q) for q in hammer]
+    assert eng.batch_check(hammer) == want
+    assert eng.hot_keys(), "sketch must surface the hammered object"
+
+    added = eng.replicate_now()
+    assert added >= 1
+    st = eng.mesh_stats()
+    assert st["replica_keys"] >= 1
+    assert st["replications"] >= 1
+    assert sum(r["replica_keys"] for r in eng.shard_stats()) >= 1
+
+    # routing now prefers the colder replica over the hammered owner
+    rr0 = eng.mesh_stats()["replica_routed"]
+    hammer2 = [
+        RelationTuple.from_string(f"Doc:d7#view@{u}")
+        for u in graph.users[32:64]
+    ]
+    want2 = [eng.oracle.check_is_member(q) for q in hammer2]
+    assert eng.batch_check(hammer2) == want2
+    assert eng.mesh_stats()["replica_routed"] > rr0
+
+    # a write on the replicated key folds into owner AND replica overlays:
+    # the routed read must see it without a reshard
+    rebuilds0 = eng.rebuilds
+    graph.store.write_relation_tuples(
+        RelationTuple.from_string("Doc:d7#viewers@replica-newbie")
+    )
+    assert eng.check(
+        RelationTuple.from_string("Doc:d7#view@replica-newbie")
+    ) is True
+    assert eng.rebuilds == rebuilds0
+
+    # broad workload stays oracle-exact after the publish
+    qs = synth_queries(graph, 96, seed=13)
+    assert eng.batch_check(qs) == [
+        eng.oracle.check_is_member(q) for q in qs
+    ]
+
+
+@pytest.mark.slow
+def test_mesh_rebalance_on_skew():
+    """A skewed routed-root distribution crosses `rebalance_skew`; the
+    rebalancer copies hot keys off the loaded shard and republishes via
+    generation swap with zero verdict divergence."""
+    from ketotpu.parallel import MeshCheckEngine
+
+    graph = build_synth(n_users=128, n_groups=8, n_folders=64, n_docs=256)
+    eng = MeshCheckEngine(
+        graph.store, graph.manager, mesh_devices=8,
+        frontier=1024, arena=4096, max_batch=512,
+        hot_min=4, rebalance_skew=2.0,
+    )
+    eng.snapshot()
+    v = eng._vocab
+    ns_id = v.namespaces.lookup("Doc")
+    by_shard = {}
+    for i in range(256):
+        obj_id = v.objects.lookup(f"d{i}")
+        s = int(shard_of_np(np.array([ns_id]), np.array([obj_id]), 8)[0])
+        by_shard.setdefault(s, []).append(i)
+    _, docs = max(by_shard.items(), key=lambda kv: len(kv[1]))
+
+    users = graph.users[:16]
+    hammer = [
+        RelationTuple.from_string(f"Doc:d{d}#view@{u}")
+        for d in docs[:4] for u in users
+    ]
+    want = [eng.oracle.check_is_member(q) for q in hammer]
+    assert eng.batch_check(hammer) == want
+    assert eng.shard_skew() >= 2.0
+
+    gen0 = eng.generation
+    assert eng.rebalance_now() is True
+    st = eng.mesh_stats()
+    assert st["rebalances"] == 1
+    assert st["replica_keys"] >= 1
+    assert eng.generation == gen0 + 1
+
+    qs = synth_queries(graph, 96, seed=19)
+    assert eng.batch_check(qs) == [
+        eng.oracle.check_is_member(q) for q in qs
+    ]
+
+
+@pytest.mark.slow
+def test_mesh_shard_failover_and_recovery():
+    """A faulted shard degrades its roots to the host oracle (verdicts
+    stay exact); fallback attribution moves ONLY on the faulted shard;
+    dropping the fault plan recovers the shard on the next dispatch and
+    the fallback gauge returns to zero."""
+    from ketotpu import faults
+    from ketotpu.parallel import MeshCheckEngine
+
+    graph = build_synth(n_users=128, n_groups=8, n_folders=64, n_docs=256)
+    eng = MeshCheckEngine(
+        graph.store, graph.manager, mesh_devices=8,
+        frontier=1024, arena=4096, max_batch=512,
+    )
+    qs = synth_queries(graph, 128, seed=9)
+    want = [eng.oracle.check_is_member(q) for q in qs]
+    assert eng.batch_check(qs) == want  # clean warm-up, no faults
+
+    # pick the shard that owns the most of a FRESH query set (cache-missing
+    # so the faulted batch really dispatches)
+    qs2 = synth_queries(graph, 128, seed=10)
+    v = eng._vocab
+    owners = shard_of_np(
+        np.array([v.namespaces.lookup(q.namespace) for q in qs2]),
+        np.array([v.objects.lookup(q.object) for q in qs2]), 8,
+    )
+    victim = int(np.bincount(owners, minlength=8).argmax())
+    want2 = [eng.oracle.check_is_member(q) for q in qs2]
+    fb_before = np.array([r["fallbacks"] for r in eng.shard_stats()])
+
+    faults.configure(shard_error_rate=1.0, shard_id=victim)
+    try:
+        assert eng.batch_check(qs2) == want2  # exact through the oracle
+        assert eng._shard_down[victim]
+        assert eng.mesh_stats()["shards_down"] == 1
+        fb_after = np.array([r["fallbacks"] for r in eng.shard_stats()])
+        delta = fb_after - fb_before
+        assert delta[victim] > 0, "faulted shard must attribute fallbacks"
+        others = [int(d) for i, d in enumerate(delta) if i != victim]
+        assert all(d == 0 for d in others), (
+            f"fallbacks moved on healthy shards: {delta.tolist()}"
+        )
+    finally:
+        faults.reset()
+
+    # recovery: the next dispatch polls the plan, re-ships the shard, and
+    # zeroes its fallback attribution
+    qs3 = synth_queries(graph, 64, seed=11)
+    assert eng.batch_check(qs3) == [
+        eng.oracle.check_is_member(q) for q in qs3
+    ]
+    assert not eng._shard_down.any()
+    assert eng.shard_stats()[victim]["fallbacks"] == 0
+    assert eng.mesh_stats()["shard_recoveries"] >= 1
