@@ -280,6 +280,7 @@ def main() -> int:
         run("leopard", _leopard, out, state)
         run("jit_shape_audit", _jit_shape_audit, out, state)
         run("serving", _serving, out, state)
+        run("serve_trace", _serve_trace, out, state)
         run("serve_batch", _serve_batch, out, state)
         run("cache_shield", _cache_shield, out, state)
         run("scale_10m", _scale_10m, out, state, baseline)
@@ -697,6 +698,19 @@ def _serving(out, state) -> None:
     from bench_serve import run_serving_bench
 
     out.update(run_serving_bench(state["graph"], concurrency=32, duration=10.0))
+
+
+def _serve_trace(out, state) -> None:
+    # request-anatomy observatory cost: the single-Check hammer with
+    # tail-sampled tracing + the shadow plane (1/50 sampling) ON vs
+    # tracing OFF — publishes serve_trace_overhead_pct (acceptance <= 5%)
+    # and shadow_divergence_total (must be 0: every serving tier must
+    # agree with the host oracle on live traffic)
+    from bench_serve import run_trace_overhead_bench
+
+    out.update(run_trace_overhead_bench(
+        state["graph"], concurrency=32, duration=6.0
+    ))
 
 
 def _serve_batch(out, state) -> None:

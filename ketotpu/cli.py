@@ -199,6 +199,16 @@ def _serve_multiprocess(args, workers: int) -> int:
             "--worker-of", sock,
         ])
 
+    # SIGTERM (systemd, k8s, supervisors) must tear the fleet down the
+    # same way ^C does: the default handler would kill only the owner
+    # and orphan N workers still holding the SO_REUSEPORT public ports
+    import signal
+
+    def _sigterm(signum, frame):
+        raise KeyboardInterrupt
+
+    signal.signal(signal.SIGTERM, _sigterm)
+
     sup = WorkerSupervisor(spawn, workers, log=log.warning)
     # the owner's health (served to workers over the socket's "health"
     # op) reports `degraded` while any worker is down/respawning, so
@@ -811,6 +821,73 @@ def _dump_projection(metrics_remote: str) -> int:
     return 0
 
 
+def _dump_traces(metrics_remote: str) -> int:
+    """Pretty-print the tail-sampled trace store (/debug/trace): newest
+    promoted request anatomies, each span with its owning pid so a
+    worker-routed request visibly spans both processes."""
+    payload = _fetch_debug(metrics_remote, "/debug/trace?n=8")
+    if payload is None:
+        return 1
+    if not payload.get("enabled", False):
+        print("traces: n/a (observability.trace.enabled is false)")
+        return 0
+    stats = payload.get("stats", {})
+    traces = payload.get("traces", [])
+    print(
+        f"traces: {len(traces)} promoted shown "
+        f"({stats.get('promotions', 0)} promoted "
+        f"of {stats.get('completions', 0)} completed, "
+        f"slow_ms={stats.get('slow_ms', 0)})"
+    )
+    for t in traces:
+        print(
+            f"  trace={t.get('trace_id')} {t.get('op', '?'):7s}"
+            f" {t.get('total_ms', 0.0):9.2f}ms"
+            f" promoted={','.join(t.get('promoted', []))}"
+            f" {t.get('detail', '')}"
+        )
+        for s in t.get("spans", []):
+            extra = {
+                k: v for k, v in s.items()
+                if k not in ("name", "pid", "t0", "t1", "ms")
+            }
+            kv = " ".join(f"{k}={v}" for k, v in sorted(extra.items()))
+            print(
+                f"    [pid {s.get('pid', 0)}] {s.get('name', '?'):18s}"
+                f" {s.get('ms', 0.0):9.3f}ms" + (f" {kv}" if kv else "")
+            )
+    return 0
+
+
+def _dump_divergence(metrics_remote: str) -> int:
+    """Pretty-print the shadow-verification plane (/debug/divergence):
+    sampler stats and every ledgered fast-path/oracle disagreement."""
+    payload = _fetch_debug(metrics_remote, "/debug/divergence")
+    if payload is None:
+        return 1
+    if not payload.get("enabled", False):
+        print("shadow: n/a (plane disabled or worker relay)")
+        return 0
+    stats = payload.get("stats", {})
+    divs = payload.get("divergences", [])
+    print(
+        f"shadow: {stats.get('checks', 0)} replayed"
+        f" (1/{stats.get('sample_rate', 0)} sampled),"
+        f" {stats.get('divergences', 0)} divergence(s),"
+        f" {stats.get('skipped', 0)} skipped,"
+        f" {stats.get('queued', 0)} queued"
+    )
+    for d in divs:
+        print(
+            f"  DIVERGED {d.get('tuple')} depth={d.get('depth')}"
+            f" served={d.get('served')} oracle={d.get('oracle')}"
+            f" tier={d.get('tier')} wave={d.get('wave')}"
+            f" generation={d.get('generation')}"
+            f" trace={d.get('trace_id')}"
+        )
+    return 0
+
+
 def cmd_status(args) -> int:
     import grpc
 
@@ -823,6 +900,8 @@ def cmd_status(args) -> int:
             _dump_waves(args.metrics_remote),
             _dump_compiles(args.metrics_remote),
             _dump_projection(args.metrics_remote),
+            _dump_traces(args.metrics_remote),
+            _dump_divergence(args.metrics_remote),
         ]
         return max(rcs)
 

@@ -254,6 +254,24 @@ def _defaults() -> Dict[str, Any]:
                 "dir": "",
                 "max_seconds": 60,
             },
+            # request-anatomy tracing: every request opens a cheap span
+            # buffer; only slow/errored/shed/deadline/divergent traces are
+            # promoted into the bounded store behind GET /debug/trace
+            "trace": {
+                "enabled": True,
+                "slow_ms": 25.0,
+                "store_size": 64,
+                "recent_size": 512,
+            },
+            # shadow-verification plane: re-evaluate ~1/sample_rate live
+            # checks on the host oracle at the same snapshot and ledger
+            # any divergence (GET /debug/divergence)
+            "shadow": {
+                "enabled": True,
+                "sample_rate": 1000,
+                "queue_cap": 1024,
+                "ledger_size": 256,
+            },
         },
         # fault injection (ketotpu/faults.py): all-zero = inactive.  The
         # KETO_FAULT_* environment knobs override this block entirely —
@@ -344,7 +362,9 @@ class Provider:
                           "hot_threshold", "top_k", "wave_ledger_size",
                           "flight_recorder_size",
                           "flight_recorder_max_age_s", "compile_log_size",
-                          "warm_compile_warning", "max_seconds"):
+                          "warm_compile_warning", "max_seconds",
+                          "slow_ms", "store_size", "recent_size",
+                          "sample_rate", "ledger_size"):
                 suffix = known.split("_")
                 if len(joined) > len(suffix) and joined[-len(suffix):] == suffix:
                     joined = joined[: -len(suffix)] + [known]
@@ -624,11 +644,29 @@ class Provider:
                     key, f"must be a positive number, got {val!r}"
                 )
         for key in ("observability.warm_compile_warning",
-                    "observability.profiler.enabled"):
+                    "observability.profiler.enabled",
+                    "observability.trace.enabled",
+                    "observability.shadow.enabled"):
             val = self.get(key)
             if not isinstance(val, bool):
                 raise ConfigError(key, f"must be a boolean, got {val!r}")
         if not isinstance(self.get("observability.profiler.dir", ""), str):
             raise ConfigError(
                 "observability.profiler.dir", "must be a string path"
+            )
+        for key in ("observability.trace.store_size",
+                    "observability.trace.recent_size",
+                    "observability.shadow.sample_rate",
+                    "observability.shadow.queue_cap",
+                    "observability.shadow.ledger_size"):
+            val = self.get(key)
+            if not isinstance(val, int) or val < 1:
+                raise ConfigError(
+                    key, f"must be a positive integer, got {val!r}"
+                )
+        val = self.get("observability.trace.slow_ms")
+        if not isinstance(val, (int, float)) or val < 0:
+            raise ConfigError(
+                "observability.trace.slow_ms",
+                f"must be a non-negative number, got {val!r}",
             )

@@ -83,6 +83,10 @@ class Registry:
         self._result_cache = None
         self._flight_recorder = None
         self._wave_ledger = None
+        self._trace_store = None
+        self._trace_store_built = False
+        self._shadow = None
+        self._shadow_built = False
         self._profiler = None
         self._compile_watch = None
         self._admission = None
@@ -190,6 +194,71 @@ class Registry:
                     ),
                 )
             return self._wave_ledger
+
+    def trace_store(self):
+        """Lazy tail-sampled trace store (ketotpu/tracing.py): promoted
+        request anatomies behind GET /debug/trace.  None when
+        ``observability.trace.enabled`` is false — flightrec then skips
+        the span buffer entirely."""
+        with self._lock:
+            if not self._trace_store_built:
+                self._trace_store_built = True
+                if bool(self.config.get("observability.trace.enabled", True)):
+                    from ketotpu.tracing import TraceStore
+
+                    self._trace_store = TraceStore(
+                        slow_ms=float(
+                            self.config.get("observability.trace.slow_ms", 25.0)
+                        ),
+                        store_size=int(
+                            self.config.get(
+                                "observability.trace.store_size", 64
+                            ) or 64
+                        ),
+                        recent_size=int(
+                            self.config.get(
+                                "observability.trace.recent_size", 512
+                            ) or 512
+                        ),
+                        metrics=self.metrics(),
+                        tracer=self.tracer(),
+                    )
+            return self._trace_store
+
+    def shadow(self):
+        """Lazy shadow-verification plane (ketotpu/shadow.py).  None when
+        disabled or when the engine is a worker-side relay (kind
+        ``remote``): workers forward checks to the owner, and the owner —
+        which holds the authoritative store + oracle — shadows them."""
+        with self._lock:
+            if not self._shadow_built:
+                self._shadow_built = True
+                enabled = bool(
+                    self.config.get("observability.shadow.enabled", True)
+                )
+                kind = str(self.config.get("engine.kind", "oracle"))
+                if enabled and kind != "remote":
+                    from ketotpu.shadow import ShadowVerifier
+
+                    self._shadow = ShadowVerifier(
+                        self,
+                        sample_rate=int(
+                            self.config.get(
+                                "observability.shadow.sample_rate", 1000
+                            ) or 1000
+                        ),
+                        queue_cap=int(
+                            self.config.get(
+                                "observability.shadow.queue_cap", 1024
+                            ) or 1024
+                        ),
+                        ledger_size=int(
+                            self.config.get(
+                                "observability.shadow.ledger_size", 256
+                            ) or 256
+                        ),
+                    )
+            return self._shadow
 
     def compile_watch(self):
         """The process-global XLA compile observatory
@@ -794,6 +863,24 @@ class Registry:
             m.gauge("keto_cache_hit_ratio", cs["hit_ratio"],
                     help="lifetime cache hit ratio (hits / probes)")
         with self._lock:
+            trace = self._trace_store
+            shadow = self._shadow
+        if trace is not None:
+            ts = trace.stats()
+            m = self.metrics()
+            m.gauge("keto_trace_store_promoted", ts["promoted_held"],
+                    help="traces currently held in the promoted store")
+            m.gauge("keto_trace_store_recent", ts["recent_held"],
+                    help="unpromoted traces parked in the recent ring")
+        if shadow is not None:
+            ss = shadow.stats()
+            m = self.metrics()
+            m.gauge("keto_shadow_queue_depth", ss["queued"],
+                    help="shadow samples awaiting oracle replay")
+            m.gauge("keto_shadow_divergence_ledger_size",
+                    len(shadow.ledger()),
+                    help="divergence records currently held")
+        with self._lock:
             ledger = self._wave_ledger
         if ledger is not None:
             ws = ledger.stats()
@@ -999,7 +1086,10 @@ class Registry:
             hubs = [self._watch_hub] + [
                 t._watch_hub for t in self._tenants.values()
             ]
-        for eng in engines + hubs:
+            shadows = [self._shadow] + [
+                t._shadow for t in self._tenants.values()
+            ]
+        for eng in engines + hubs + shadows:
             close = getattr(eng, "close", None)
             if close is not None:
                 try:

@@ -168,6 +168,7 @@ class CoalescingEngine:
             flightrec.note_stage("cache", time.perf_counter() - t_probe)
             if hit is not None:
                 self.cache_hits += 1
+                flightrec.note_tier("cache")
                 return bool(hit.value)
         budget = deadline.remaining()
         if budget is None:
@@ -262,6 +263,8 @@ class CoalescingEngine:
                     results[i] = bool(hit.value)
                 else:
                     todo.append(i)
+            if len(todo) < n:
+                flightrec.note_tier("cache", n - len(todo))
             if not todo:
                 return [bool(v) for v in results]
         # ONE budget shared by every item in the batch: read once here,

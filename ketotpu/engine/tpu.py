@@ -1597,6 +1597,37 @@ class DeviceCheckEngine:
         fallback |= unres
         return allowed, fallback
 
+    def _note_tiers(self, handle, fallback) -> np.ndarray:
+        """Attribute this chunk's verdicts to the tier that answered them
+        (request-anatomy tracing + shadow-plane provenance): cache hits,
+        Leopard closure answers, oracle fallbacks, and whatever remains on
+        the device fast path.  Best-effort — only a request context open
+        on the collecting thread receives the notes (the coalescer's
+        dispatch thread has none and skips the work entirely)."""
+        err, leo_res, cache_res = handle[1], handle[8], handle[9]
+        seen = np.zeros(err.shape[0], bool)
+        if flightrec.current() is None:
+            return seen
+        if cache_res is not None and cache_res[0].any():
+            flightrec.note_tier("cache", int(cache_res[0].sum()))
+            seen |= cache_res[0]
+        if leo_res is not None and leo_res[1].any():
+            flightrec.note_tier("leopard", int(leo_res[1].sum()))
+            seen |= leo_res[1]
+        orc = (fallback | err) & ~seen
+        if orc.any():
+            flightrec.note_tier("oracle", int(orc.sum()))
+            seen |= orc
+        rest = ~seen
+        if rest.any():
+            self._note_fast_tiers(rest, handle)
+        return seen
+
+    def _note_fast_tiers(self, mask, handle) -> None:
+        """Fast-path attribution hook; the mesh engine overrides this to
+        split the count by serving shard."""
+        flightrec.note_tier("fastpath", int(mask.sum()))
+
     def _finish_chunk(
         self, queries, handle, rest_depth: int, errs=None, base: int = 0
     ) -> np.ndarray:
@@ -1608,6 +1639,7 @@ class DeviceCheckEngine:
         if handle is None:
             return np.zeros(0, bool)
         allowed, fallback = self._collect(handle)
+        self._note_tiers(handle, fallback)
         skip = None
         if fallback.any():
             t_fb = time.perf_counter()

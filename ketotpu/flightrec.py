@@ -24,15 +24,23 @@ is the decomposition layer:
 
 from __future__ import annotations
 
+import os
+import secrets
 import threading
 import time
 from contextlib import contextmanager
 from typing import Dict, List, Optional
 
+from ketotpu.observability import format_traceparent, parse_traceparent
+
 _local = threading.local()
 
 STAGE_METRIC = "keto_rpc_stage_seconds"
 _STAGE_HELP = "per-RPC stage wall time decomposition"
+
+#: per-request span-buffer cap — a runaway fan-out must not grow an
+#: unbounded timeline; the rpc-level span is always appended last
+MAX_SPANS = 128
 
 
 class FlightRecorder:
@@ -76,9 +84,9 @@ class FlightRecorder:
 
 class _ReqCtx:
     __slots__ = ("op", "detail", "t0", "stages", "info", "metrics",
-                 "recorder", "tracer")
+                 "recorder", "tracer", "trace", "trace_id", "spans")
 
-    def __init__(self, op, detail, t0, metrics, recorder, tracer):
+    def __init__(self, op, detail, t0, metrics, recorder, tracer, trace):
         self.op = op
         self.detail = detail
         self.t0 = t0
@@ -87,6 +95,9 @@ class _ReqCtx:
         self.metrics = metrics
         self.recorder = recorder
         self.tracer = tracer
+        self.trace = trace  # TraceStore, or None when tracing is off
+        self.trace_id: Optional[str] = None
+        self.spans: List[Dict] = []
 
 
 def current() -> Optional[_ReqCtx]:
@@ -103,6 +114,17 @@ def note_stage(stage: str, seconds: float) -> None:
         ctx.metrics.observe(
             STAGE_METRIC, seconds, help=_STAGE_HELP, op=ctx.op, stage=stage,
         )
+    if ctx.trace is not None and len(ctx.spans) < MAX_SPANS:
+        # every stage note doubles as a timeline span (epoch-stamped so
+        # spans from different processes align on one clock)
+        t1 = time.time()
+        ctx.spans.append({
+            "name": stage,
+            "pid": os.getpid(),
+            "t0": round(t1 - seconds, 6),
+            "t1": round(t1, 6),
+            "ms": round(seconds * 1000.0, 3),
+        })
 
 
 def note(**info) -> None:
@@ -110,6 +132,77 @@ def note(**info) -> None:
     ctx = getattr(_local, "ctx", None)
     if ctx is not None:
         ctx.info.update(info)
+
+
+def note_span(name: str, t0: float, t1: float, **attrs) -> None:
+    """Append one explicit timeline span (epoch seconds) to the current
+    request's span buffer; no-op outside an RPC or with tracing off."""
+    ctx = getattr(_local, "ctx", None)
+    if ctx is None or ctx.trace is None or len(ctx.spans) >= MAX_SPANS:
+        return
+    span = {
+        "name": name,
+        "pid": os.getpid(),
+        "t0": round(t0, 6),
+        "t1": round(t1, 6),
+        "ms": round((t1 - t0) * 1000.0, 3),
+    }
+    span.update(attrs)
+    ctx.spans.append(span)
+
+
+def merge_spans(spans) -> None:
+    """Adopt spans shipped from another process (owner → worker over the
+    framed wire) into the current request's timeline."""
+    ctx = getattr(_local, "ctx", None)
+    if ctx is None or ctx.trace is None or not spans:
+        return
+    room = MAX_SPANS - len(ctx.spans)
+    for s in spans[:room]:
+        if isinstance(s, dict):
+            ctx.spans.append(dict(s))
+
+
+def export_spans() -> List[Dict]:
+    """Copy of the current request's span buffer plus a provisional
+    rpc-level span covering the open context — what the owner ships back
+    to the worker inside the wire response."""
+    ctx = getattr(_local, "ctx", None)
+    if ctx is None or ctx.trace is None:
+        return []
+    t1 = time.time()
+    total = time.perf_counter() - ctx.t0
+    out = [dict(s) for s in ctx.spans]
+    out.append({
+        "name": f"rpc.{ctx.op}",
+        "pid": os.getpid(),
+        "t0": round(t1 - total, 6),
+        "t1": round(t1, 6),
+        "ms": round(total * 1000.0, 3),
+    })
+    return out
+
+
+def note_tier(tier: str, n: int = 1) -> None:
+    """Attribute ``n`` verdicts of the current RPC to an answering tier
+    (cache / leopard / fastpath / mesh-shard-N / oracle).  The dominant
+    tier lands in ``info["tier"]`` — the shadow plane's provenance."""
+    if n <= 0:
+        return
+    ctx = getattr(_local, "ctx", None)
+    if ctx is None:
+        return
+    tiers = ctx.info.setdefault("tiers", {})
+    tiers[tier] = tiers.get(tier, 0) + int(n)
+    ctx.info["tier"] = max(tiers.items(), key=lambda kv: kv[1])[0]
+
+
+def force_promote(reason: str) -> None:
+    """Mark the current request's trace for promotion regardless of its
+    latency (e.g. a synchronous shadow divergence)."""
+    ctx = getattr(_local, "ctx", None)
+    if ctx is not None:
+        ctx.info["force_promote"] = reason
 
 
 def current_traceparent() -> Optional[str]:
@@ -146,8 +239,10 @@ def rpc_recording(registry, op: str, *, traceparent: Optional[str] = None,
     metrics = registry.metrics()
     recorder = registry.flight_recorder()
     tracer = registry.tracer()
+    trace_store = getattr(registry, "trace_store", None)
+    trace = trace_store() if trace_store is not None else None
     ctx = _ReqCtx(op, detail, t0 if t0 is not None else time.perf_counter(),
-                  metrics, recorder, tracer)
+                  metrics, recorder, tracer, trace)
     _local.ctx = ctx
     try:
         with tracer.span(f"rpc.{op}", _parent=traceparent, detail=detail):
@@ -157,8 +252,17 @@ def rpc_recording(registry, op: str, *, traceparent: Optional[str] = None,
             # one, else the caller's incoming header — either joins the
             # flight-recorder entry to its OTLP trace and wave record
             tp = tracer.current_traceparent() or traceparent
+            if not tp and trace is not None:
+                # the base tracer keeps no ids: mint one so the span
+                # buffer, the worker wire, and the wave ledger still join
+                # on a single trace id
+                tp = format_traceparent(
+                    secrets.token_hex(16), secrets.token_hex(8)
+                )
             if tp:
                 ctx.info.setdefault("traceparent", tp)
+            parsed = parse_traceparent(ctx.info.get("traceparent"))
+            ctx.trace_id = parsed[0] if parsed else None
             yield ctx
     finally:
         _local.ctx = None
@@ -173,3 +277,50 @@ def rpc_recording(registry, op: str, *, traceparent: Optional[str] = None,
             }
             entry.update(ctx.info)
             recorder.record(total, entry)
+        if trace is not None:
+            _complete_trace(ctx, trace, total)
+
+
+def _complete_trace(ctx: _ReqCtx, trace, total: float) -> None:
+    """Close the span buffer and hand it to the trace store: tail-based
+    sampling decides promotion (slow / errored / shed / deadline / forced);
+    fast traces park briefly in the recent ring so an async shadow
+    divergence can still force-promote them."""
+    t1 = time.time()
+    spans = ctx.spans
+    spans.append({
+        "name": f"rpc.{ctx.op}",
+        "pid": os.getpid(),
+        "t0": round(t1 - total, 6),
+        "t1": round(t1, 6),
+        "ms": round(total * 1000.0, 3),
+    })
+    reasons: List[str] = []
+    if total * 1000.0 >= trace.slow_ms:
+        reasons.append("slow")
+    status = ctx.info.get("status")
+    if isinstance(status, int):
+        if status == 429:
+            reasons.append("shed")
+        elif status == 504:
+            reasons.append("deadline")
+        elif status >= 500:
+            reasons.append("error")
+    forced = ctx.info.get("force_promote")
+    if forced:
+        reasons.append(str(forced))
+    entry = {
+        "trace_id": ctx.trace_id,
+        "op": ctx.op,
+        "detail": ctx.detail,
+        "total_ms": round(total * 1000.0, 3),
+        "ts": round(t1, 3),
+        "spans": spans,
+        "stages_ms": {
+            k: round(v * 1000.0, 3) for k, v in ctx.stages.items()
+        },
+        "info": {
+            k: v for k, v in ctx.info.items() if k != "force_promote"
+        },
+    }
+    trace.complete(entry, reasons)

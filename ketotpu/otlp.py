@@ -162,6 +162,44 @@ class OTLPTracer(Tracer):
             "events": [],
         })
 
+    def export_trace(self, entry: Dict) -> None:
+        """Ship one promoted trace-store entry (ketotpu/tracing.py) through
+        the ordinary flush path: the closing ``rpc.<op>`` span becomes the
+        root, every buffered stage/remote span a child, all sharing the
+        entry's trace id.  Epoch-second span stamps convert to unix nanos."""
+        tid = entry.get("trace_id")
+        spans = entry.get("spans") or []
+        if not tid or not spans:
+            return
+        root_sid = secrets.token_hex(8)
+        skip_keys = {"name", "pid", "t0", "t1", "ms"}
+        for i, s in enumerate(spans):
+            is_root = i == len(spans) - 1
+            attrs = [
+                _attr(k, v) for k, v in s.items() if k not in skip_keys
+            ]
+            attrs.append(_attr("pid", s.get("pid", 0)))
+            if is_root:
+                attrs.append(
+                    _attr("promoted", ",".join(entry.get("promoted", [])))
+                )
+                for k, v in (entry.get("info") or {}).items():
+                    if isinstance(v, (str, int, float, bool)):
+                        attrs.append(_attr(k, v))
+            rec = {
+                "traceId": tid,
+                "spanId": root_sid if is_root else secrets.token_hex(8),
+                "name": str(s.get("name", "span")),
+                "kind": 1,
+                "startTimeUnixNano": str(int(float(s.get("t0", 0.0)) * 1e9)),
+                "endTimeUnixNano": str(int(float(s.get("t1", 0.0)) * 1e9)),
+                "attributes": attrs,
+                "events": [],
+            }
+            if not is_root:
+                rec["parentSpanId"] = root_sid
+            self._enqueue(rec)
+
     # -- batching / export ---------------------------------------------------
 
     def _enqueue(self, rec: Dict) -> None:

@@ -44,7 +44,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ketotpu import compilewatch, faults
+from ketotpu import compilewatch, faults, flightrec
 from ketotpu.cache.hotspot import HotSpotSketch
 from ketotpu.engine import delta as dl
 from ketotpu.engine.optable import R_ERR, R_IS
@@ -517,6 +517,15 @@ class MeshCheckEngine(DeviceCheckEngine):
         self._phase("check_mesh_dispatch", time.perf_counter() - t0)
         return (enc, err, general, res, gi, gres, stacked, assign, leo_res,
                 cache_res, cursor)
+
+    def _note_fast_tiers(self, mask, handle) -> None:
+        # split the fast-path attribution by serving shard so a divergence
+        # record names the exact replica that answered
+        assign = handle[7]
+        for s in np.unique(assign[mask]):
+            flightrec.note_tier(
+                f"mesh-shard-{int(s)}", int((assign[mask] == s).sum())
+            )
 
     def _collect(self, handle, retry: bool = True):
         (enc, fallback_mask, general, res, gi, gres, stacked, assign,

@@ -361,6 +361,7 @@ class AsyncHTTPServer:
                     if ctl is not None:
                         ctl.release()
             flightrec.note_stage("compute", time.perf_counter() - t_parse)
+            flightrec.note(status=status)
             if (op == "check" and isinstance(payload, dict)
                     and "allowed" in payload):
                 flightrec.note(verdict=payload["allowed"])

@@ -160,10 +160,14 @@ class CheckHandler:
         (uuid_mapping.go:199 via GetNamespaceByName); raises NotFoundError
         for unknown namespaces — REST swallows it, gRPC propagates."""
         r = r if r is not None else self.r
+        shadow = r.shadow()
+        shadow_cur = shadow.reserve() if shadow is not None else None
         with r.tracer().span("check.Engine.CheckIsMember"):
             # ReadOnlyMapper: namespace checks + validation without interning
             r.read_only_mapper().from_tuple(tuple_)
             allowed = r.check_engine().check_is_member(tuple_, max_depth)
+        if shadow_cur is not None:
+            shadow.submit(tuple_, max_depth, allowed, cursor=shadow_cur)
         r.tracer().event(PERMISSIONS_CHECKED)
         r.metrics().counter(
             "keto_checks_total", 1, help="authorization checks served",
@@ -412,6 +416,11 @@ class CheckHandler:
         if rows:
             sub = block if len(rows) == len(block) else block.take(rows)
             engine = r.check_engine()
+            shadow = r.shadow()
+            shadow_row, shadow_cur = (
+                shadow.reserve_block(len(rows))
+                if shadow is not None else (None, 0)
+            )
             vocab = getattr(engine, "_vocab", None)
             if vocab is not None:
                 t1 = time.perf_counter()
@@ -485,6 +494,12 @@ class CheckHandler:
                     flightrec.note_stage(
                         "wave_wait", time.perf_counter() - t2
                     )
+            if (shadow_row is not None
+                    and orig[shadow_row] not in errors):
+                shadow.submit(
+                    sub[shadow_row], max_depth,
+                    bool(allowed[orig[shadow_row]]), cursor=shadow_cur,
+                )
         answered = np.ones(n, dtype=bool)
         for i in errors:
             answered[i] = False

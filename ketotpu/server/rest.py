@@ -33,6 +33,7 @@ from ketotpu.engine import columns
 from ketotpu.api.types import (
     BadRequestError,
     KetoAPIError,
+    NotFoundError,
     RelationQuery,
     RelationTuple,
     SubjectSet,
@@ -68,6 +69,7 @@ _ADMISSION_EXEMPT = {
     # surfaces matter most
     "/debug/flight-recorder", "/debug/waves", "/debug/compiles",
     "/debug/profile", "/debug/projection", "/debug/mesh",
+    "/debug", "/debug/trace", "/debug/divergence",
 }
 
 # REST paths that get the full stage decomposition (flightrec context);
@@ -819,6 +821,73 @@ def metrics_router(registry) -> Router:
         return 200, artifact
 
     rt.add("POST", "/debug/profile", post_profile)
+
+    def get_debug_index(req):
+        # one stop for "what can I look at?": every debug surface on this
+        # port with a one-liner, so an operator paging through an incident
+        # doesn't need the README open to find the next probe
+        return 200, {"surfaces": {
+            "/debug/flight-recorder":
+                "N slowest recent requests with stage vectors + hot keys",
+            "/debug/trace":
+                "tail-sampled promoted traces (?trace=<id> for one "
+                "stitched timeline)",
+            "/debug/divergence":
+                "shadow-verification divergence ledger + sampler stats",
+            "/debug/waves":
+                "wave ledger: recent device dispatch windows (?wave=<id>)",
+            "/debug/compiles":
+                "XLA compile observatory: totals + bounded event log",
+            "/debug/projection":
+                "device projection: generation, folds, overlay, cursors",
+            "/debug/mesh":
+                "sharded serving: per-shard state + replica map",
+            "/debug/profile":
+                "POST: on-demand jax.profiler capture (config-gated)",
+        }}
+
+    rt.add("GET", "/debug", get_debug_index)
+
+    def get_trace(req):
+        # the request-anatomy observatory's read side: newest promoted
+        # traces (tail-sampled: slow/shed/deadline/error/divergence), or
+        # one stitched cross-process timeline via ?trace=<id>
+        ts = registry.trace_store()
+        if ts is None:
+            return 200, {"enabled": False, "traces": []}
+        tid = req.query.get("trace")
+        if tid:
+            ent = ts.get(tid)
+            if ent is None:
+                raise NotFoundError(f"trace {tid!r} not held")
+            return 200, ent
+        n = req.query.get("n")
+        try:
+            n = int(n) if n is not None else 0
+        except ValueError:
+            raise BadRequestError("n must be an integer")
+        return 200, {
+            "enabled": True,
+            "stats": ts.stats(),
+            "traces": ts.promoted(n=n),
+        }
+
+    rt.add("GET", "/debug/trace", get_trace)
+
+    def get_divergence(req):
+        # shadow-verification plane: the divergence ledger (each record
+        # names the lying tier, wave, generation, and trace id) + sampler
+        # stats; {} stats when the plane is off (workers, config)
+        sh = registry.shadow()
+        if sh is None:
+            return 200, {"enabled": False, "divergences": [], "stats": {}}
+        return 200, {
+            "enabled": True,
+            "stats": sh.stats(),
+            "divergences": sh.ledger(),
+        }
+
+    rt.add("GET", "/debug/divergence", get_divergence)
     return rt
 
 

@@ -334,6 +334,14 @@ class EngineHostServer:
                 # Workers stamp their cache entries with it and advance
                 # their staleness fence.
                 cur = r.store().log_head
+                # the shadow plane lives owner-side only (workers relay):
+                # sample worker-routed traffic here, where the verdict and
+                # the authoritative store are both in-process
+                shadow = r.shadow()
+                srow, scur = (
+                    shadow.reserve_block(len(tuples))
+                    if shadow is not None else (None, 0)
+                )
                 if len(tuples) == 1:
                     # single-check RPCs from the workers MUST go through
                     # check_is_member: that is the coalescer's enqueue point,
@@ -350,11 +358,16 @@ class EngineHostServer:
                             bool(eng.check_is_member(t, depth))
                             for t in tuples
                         ]
+                if srow is not None:
+                    shadow.submit(tuples[srow], depth, ok[srow], cursor=scur)
                 learn_pos, learn_ids = self._learn_rows(meta, vepoch)
                 resp = {
                     "cursor": int(cur),
                     "vepoch": vepoch,
                     "learn_pos": learn_pos,
+                    # owner-side span buffer rides home so the worker's
+                    # request context shows both processes in one trace
+                    "spans": flightrec.export_spans(),
                 }
                 out = {"ok": np.asarray(ok, dtype=np.uint8)}
                 if len(learn_pos):
@@ -386,6 +399,11 @@ class EngineHostServer:
                 eng = r.check_engine()
                 depth = int(meta.get("depth", 0))
                 cur = r.store().log_head
+                shadow = r.shadow()
+                srow, scur = (
+                    shadow.reserve_block(len(block))
+                    if shadow is not None else (None, 0)
+                )
                 # check_block FIRST: the coalescer facade forwards unknown
                 # attrs to its inner engine (see handlers._check_block_core)
                 cb = (getattr(eng, "check_block", None)
@@ -396,6 +414,10 @@ class EngineHostServer:
                     allowed, errs = colmod.block_check_via_tuples(
                         eng, block, depth
                     )
+                if srow is not None and srow not in errs:
+                    shadow.submit(
+                        block[srow], depth, bool(allowed[srow]), cursor=scur
+                    )
                 resp = {
                     "cursor": int(cur),
                     "errs": [
@@ -403,6 +425,7 @@ class EngineHostServer:
                          int(getattr(e, "status_code", None) or 500)]
                         for i, e in errs.items()
                     ],
+                    "spans": flightrec.export_spans(),
                 }
                 return resp, {"ok": np.asarray(allowed, dtype=np.uint8)}
         if op == "expand":
@@ -641,7 +664,17 @@ class RemoteCheckEngine:
                     if faults.should("socket_drop"):
                         self._discard()
                         raise ConnectionError("injected owner-socket drop")
-                    return self._conn().call(meta, arrays, timeout=timeout)
+                    resp, resp_arrays = self._conn().call(
+                        meta, arrays, timeout=timeout
+                    )
+                    if isinstance(resp, dict):
+                        # owner-side span buffer piggybacks on the reply:
+                        # fold it into THIS request's trace so one trace id
+                        # covers both processes
+                        spans = resp.pop("spans", None)
+                        if spans:
+                            flightrec.merge_spans(spans)
+                    return resp, resp_arrays
                 except KetoAPIError:
                     raise
                 except TimeoutError:
@@ -797,6 +830,8 @@ class RemoteCheckEngine:
             for i, h in enumerate(hits):
                 if h is not None:
                     results[i] = bool(h.value)
+            if len(miss) < len(queries):
+                flightrec.note_tier("cache", len(queries) - len(miss))
             if not miss:
                 return [bool(v) for v in results]
         ok, cur = self._wire_check(
@@ -839,6 +874,8 @@ class RemoteCheckEngine:
             for i, h in enumerate(hits):
                 if h is not None:
                     allowed[i] = bool(h.value)
+            if len(miss) < n:
+                flightrec.note_tier("cache", n - len(miss))
             if not miss:
                 return allowed, errs
         sub = block if len(miss) == n else block.take(miss)

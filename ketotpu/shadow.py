@@ -1,0 +1,261 @@
+"""Always-on shadow-verification plane (Zanzibar-style live verification).
+
+With the cache, Leopard closure index, columnar fast path, and the mesh
+all able to answer a Check, "the fast path still agrees with the
+authoritative evaluator" must be *continuously measured*, not assumed.
+This module samples ~1/``observability.shadow.sample_rate`` of live check
+traffic at the serving edge, captures the inputs + the changelog cursor
+they were answered against, and re-evaluates them asynchronously on the
+host oracle:
+
+* **same-snapshot guard** — the replay only scores a sample while the
+  store's ``log_head`` still equals the cursor captured *before* the
+  check ran; anything else (a write raced the sample) is skipped and
+  counted, never misfiled as a divergence.  This is what keeps the plane
+  at exactly zero false positives under write storms.
+* **divergence ledger** — a mismatch files a bounded record carrying the
+  answering tier (cache/leopard/fastpath/mesh-shard-N/oracle), wave id,
+  trace id, projection generation, and routing decision, increments
+  ``keto_shadow_divergence_total``, and force-promotes the request's
+  trace in the trace store so the full anatomy of the lying request is
+  preserved.  Served at ``GET /debug/divergence``.
+
+The sampling fast path is one lock-guarded counter increment; unsampled
+requests pay nothing else.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from ketotpu import flightrec
+
+CHECKS_METRIC = "keto_shadow_checks_total"
+DIVERGENCE_METRIC = "keto_shadow_divergence_total"
+SKIPPED_METRIC = "keto_shadow_skipped_total"
+
+
+class ShadowVerifier:
+    """Sampler + async oracle replayer + divergence ledger."""
+
+    def __init__(
+        self,
+        registry,
+        *,
+        sample_rate: int = 1000,
+        queue_cap: int = 1024,
+        ledger_size: int = 256,
+    ):
+        self._r = registry
+        self.sample_rate = max(1, int(sample_rate))
+        self.queue_cap = int(queue_cap)
+        self._count = 0
+        self._clock = threading.Lock()
+        self._cond = threading.Condition()
+        self._q: deque = deque()
+        self._inflight = 0
+        self._ledger: deque = deque(maxlen=int(ledger_size))
+        self._closed = False
+        self.checks = 0
+        self.divergences = 0
+        self.skipped = 0
+        metrics = registry.metrics()
+        if metrics is not None:
+            # pre-register the vocabulary so `== 0` is provable on any scrape
+            metrics.counter(
+                CHECKS_METRIC, 0, help="live checks replayed on the oracle",
+            )
+            metrics.counter(
+                DIVERGENCE_METRIC, 0,
+                help="fast-path verdicts that disagreed with the oracle",
+            )
+            metrics.counter(
+                SKIPPED_METRIC, 0,
+                help="shadow samples skipped (stale cursor / full queue)",
+                reason="stale",
+            )
+        self._worker = threading.Thread(
+            target=self._run, name="shadow-verifier", daemon=True
+        )
+        self._worker.start()
+
+    # -- sampling fast path --------------------------------------------------
+
+    def reserve(self) -> Optional[int]:
+        """One-check sample roll: the captured ``log_head`` cursor when
+        this check is sampled, else None.  Call BEFORE the check runs so
+        the cursor brackets the verdict from the left."""
+        idx = self._advance(1)
+        if idx is None:
+            return None
+        return int(self._r.store().log_head)
+
+    def reserve_block(self, n: int) -> Tuple[Optional[int], int]:
+        """Block sample roll: (sampled row index or None, cursor)."""
+        if n <= 0:
+            return None, 0
+        idx = self._advance(n)
+        if idx is None:
+            return None, 0
+        return idx, int(self._r.store().log_head)
+
+    def _advance(self, n: int) -> Optional[int]:
+        with self._clock:
+            c0 = self._count
+            self._count += n
+        first = self.sample_rate - 1 - (c0 % self.sample_rate)
+        return first if first < n else None
+
+    # -- capture -------------------------------------------------------------
+
+    def submit(self, tuple_, rest_depth: int, verdict: bool, *,
+               cursor: int) -> None:
+        """Enqueue a sampled check for oracle replay.  Provenance (tier,
+        wave, trace id) rides from the current request context; generation
+        from the device engine.  Never blocks the serving thread."""
+        ctx = flightrec.current()
+        info = ctx.info if ctx is not None else {}
+        dev = None
+        try:
+            dev = self._r._device_engine()
+        except Exception:  # noqa: BLE001 - engine kinds without a device
+            dev = None
+        job = {
+            "tuple": tuple_,
+            "tuple_str": str(tuple_),
+            "depth": int(rest_depth),
+            "served": bool(verdict),
+            "cursor": int(cursor),
+            "tier": info.get("tier", "fastpath"),
+            "tiers": dict(info.get("tiers") or {}),
+            "wave": info.get("wave", -1),
+            "trace_id": getattr(ctx, "trace_id", None) if ctx else None,
+            "traceparent": info.get("traceparent"),
+            "generation": int(getattr(dev, "generation", -1)),
+            "op": getattr(ctx, "op", "check") if ctx else "check",
+        }
+        with self._cond:
+            if self._closed or len(self._q) >= self.queue_cap:
+                self._skip("queue_full")
+                return
+            self._q.append(job)
+            self._cond.notify()
+
+    # -- replay --------------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while not self._q and not self._closed:
+                    self._cond.wait(0.5)
+                if self._closed and not self._q:
+                    return
+                if not self._q:
+                    continue
+                job = self._q.popleft()
+                self._inflight += 1
+            try:
+                self._replay(job)
+            except Exception:  # noqa: BLE001 - the plane must never crash
+                self._skip("replay_error")
+            finally:
+                with self._cond:
+                    self._inflight -= 1
+                    self._cond.notify_all()
+
+    def _replay(self, job: Dict) -> None:
+        head = int(self._r.store().log_head)
+        if head != job["cursor"]:
+            # a write raced the sample: the verdict was computed against a
+            # state the live store no longer holds — not scoreable
+            self._skip("stale")
+            return
+        oracle = self._r.oracle_engine()
+        want = bool(oracle.check_is_member(job["tuple"], job["depth"]))
+        if int(self._r.store().log_head) != job["cursor"]:
+            # a write landed DURING the replay; same rule
+            self._skip("stale")
+            return
+        metrics = self._r.metrics()
+        self.checks += 1
+        if metrics is not None:
+            metrics.counter(CHECKS_METRIC, 1)
+        if want == job["served"]:
+            return
+        record = {
+            "ts": round(time.time(), 3),
+            "tuple": job["tuple_str"],
+            "depth": job["depth"],
+            "served": job["served"],
+            "oracle": want,
+            "tier": job["tier"],
+            "tiers": job["tiers"],
+            "wave": job["wave"],
+            "trace_id": job["trace_id"],
+            "generation": job["generation"],
+            "cursor": job["cursor"],
+            "op": job["op"],
+        }
+        self.divergences += 1
+        self._ledger.append(record)
+        if metrics is not None:
+            metrics.counter(DIVERGENCE_METRIC, 1)
+        trace_store = None
+        try:
+            trace_store = self._r.trace_store()
+        except Exception:  # noqa: BLE001
+            trace_store = None
+        if trace_store is not None and job["trace_id"]:
+            trace_store.force_promote(job["trace_id"], "divergence")
+        log = getattr(self._r, "logger", None)
+        logger = log() if callable(log) else None
+        if logger is not None:
+            logger.error(
+                "shadow divergence: %s served=%s oracle=%s tier=%s wave=%s "
+                "generation=%s trace=%s",
+                job["tuple_str"], job["served"], want, job["tier"],
+                job["wave"], job["generation"], job["trace_id"],
+            )
+
+    def _skip(self, reason: str) -> None:
+        self.skipped += 1
+        metrics = self._r.metrics()
+        if metrics is not None:
+            metrics.counter(SKIPPED_METRIC, 1, reason=reason)
+
+    # -- read side / lifecycle ----------------------------------------------
+
+    def ledger(self) -> List[Dict]:
+        return list(self._ledger)
+
+    def stats(self) -> Dict:
+        with self._cond:
+            queued = len(self._q)
+        return {
+            "sample_rate": self.sample_rate,
+            "checks": self.checks,
+            "divergences": self.divergences,
+            "skipped": self.skipped,
+            "queued": queued,
+        }
+
+    def drain(self, timeout: float = 10.0) -> bool:
+        """Block until the replay queue is empty and idle (tests/benches).
+        True when fully drained inside the timeout."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while self._q or self._inflight:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._cond.wait(min(left, 0.25))
+        return True
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        self._worker.join(timeout=5.0)

@@ -1,0 +1,140 @@
+"""Tail-sampled trace store: the request-anatomy observatory's archive.
+
+Every request opens a cheap span buffer (flightrec.py); at completion the
+buffer reaches :meth:`TraceStore.complete`, which drops the overwhelming
+majority on the floor and keeps only the anatomy worth reading:
+
+* **promoted** — slow (past ``observability.trace.slow_ms``), errored,
+  shed, deadline-exceeded, or force-promoted (shadow divergence) traces,
+  in a bounded newest-wins store served at ``GET /debug/trace``;
+* **recent** — a short ring of completed-but-unpromoted traces, kept only
+  so the asynchronous shadow plane can still :meth:`force_promote` a
+  trace whose divergence is discovered after the response went out.
+
+Promoted traces also flow out through the OTLP exporter when one is
+configured (``tracing.provider: otlp``) — the same ``/v1/traces`` flush
+path the live spans use, so a collector sees the full stitched timeline.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+
+class TraceStore:
+    """Bounded promoted-trace store + recent ring; all methods threadsafe."""
+
+    def __init__(
+        self,
+        *,
+        slow_ms: float = 25.0,
+        store_size: int = 64,
+        recent_size: int = 512,
+        metrics=None,
+        tracer=None,
+    ):
+        self.slow_ms = float(slow_ms)
+        self.store_size = int(store_size)
+        self.recent_size = int(recent_size)
+        self._metrics = metrics
+        self._tracer = tracer
+        self._lock = threading.Lock()
+        self._promoted: "OrderedDict[str, Dict]" = OrderedDict()
+        self._recent: "OrderedDict[str, Dict]" = OrderedDict()
+        self.completions = 0
+        self.promotions = 0
+        self.force_promotions = 0
+        if metrics is not None:
+            # pre-register so the vocabulary is on the first scrape
+            metrics.counter(
+                "keto_trace_completed_total", 0,
+                help="requests that closed a span buffer",
+            )
+            metrics.counter(
+                "keto_trace_promoted_total", 0,
+                help="traces promoted into the trace store", reason="slow",
+            )
+
+    # -- completion / promotion ---------------------------------------------
+
+    def complete(self, entry: Dict, reasons: Optional[List[str]]) -> None:
+        """File one finished request.  ``reasons`` non-empty promotes;
+        empty parks it in the recent ring (droppable, force-promotable)."""
+        tid = entry.get("trace_id")
+        if not tid:
+            return
+        with self._lock:
+            self.completions += 1
+            if reasons:
+                self._promote_locked(tid, entry, list(reasons))
+            else:
+                self._recent[tid] = entry
+                while len(self._recent) > self.recent_size:
+                    self._recent.popitem(last=False)
+        if self._metrics is not None:
+            self._metrics.counter("keto_trace_completed_total", 1)
+
+    def _promote_locked(self, tid: str, entry: Dict, reasons: List[str]):
+        prior = self._promoted.pop(tid, None)
+        if prior is not None:
+            # same trace id promoted twice (owner + worker legs in one
+            # process, or a re-promotion): merge reasons, keep newest body
+            reasons = sorted(set(prior.get("promoted", [])) | set(reasons))
+        entry["promoted"] = reasons
+        self._promoted[tid] = entry
+        while len(self._promoted) > self.store_size:
+            self._promoted.popitem(last=False)
+        self.promotions += 1
+        if self._metrics is not None:
+            for r in reasons:
+                self._metrics.counter(
+                    "keto_trace_promoted_total", 1,
+                    help="traces promoted into the trace store", reason=r,
+                )
+        if self._tracer is not None:
+            export = getattr(self._tracer, "export_trace", None)
+            if export is not None:
+                export(entry)
+
+    def force_promote(self, trace_id: str, reason: str) -> bool:
+        """Promote a trace after the fact (shadow divergence found
+        asynchronously).  True when the trace was still findable."""
+        with self._lock:
+            if trace_id in self._promoted:
+                ent = self._promoted[trace_id]
+                if reason not in ent.get("promoted", []):
+                    ent.setdefault("promoted", []).append(reason)
+                self.force_promotions += 1
+                return True
+            ent = self._recent.pop(trace_id, None)
+            if ent is None:
+                return False
+            self._promote_locked(trace_id, ent, [reason])
+            self.force_promotions += 1
+            return True
+
+    # -- read side -----------------------------------------------------------
+
+    def promoted(self, n: int = 0) -> List[Dict]:
+        """Newest-first promoted traces (summaries include full spans)."""
+        with self._lock:
+            out = [dict(e) for e in reversed(self._promoted.values())]
+        return out[:n] if n > 0 else out
+
+    def get(self, trace_id: str) -> Optional[Dict]:
+        with self._lock:
+            e = self._promoted.get(trace_id) or self._recent.get(trace_id)
+            return dict(e) if e is not None else None
+
+    def stats(self) -> Dict:
+        with self._lock:
+            return {
+                "completions": self.completions,
+                "promotions": self.promotions,
+                "force_promotions": self.force_promotions,
+                "promoted_held": len(self._promoted),
+                "recent_held": len(self._recent),
+                "slow_ms": self.slow_ms,
+            }

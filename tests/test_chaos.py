@@ -1116,3 +1116,139 @@ class TestMeshShardFaultStorm:
         assert all(
             int(after[i] - fb1[i]) == 0 for i in range(8) if i != victim
         ), "recovered serving must add no fallbacks on healthy shards"
+
+
+# -- shadow-verification plane under chaos -----------------------------------
+
+
+class TestShadowZeroDivergenceUnderChaos:
+    """The always-on shadow plane must stay at exactly zero divergence
+    while the system is being actively hurt: shard/device faults push
+    checks onto the oracle fallback (same verdicts, different tier) and
+    write storms race the sampler (the same-snapshot guard skips raced
+    samples instead of misfiling them as divergences)."""
+
+    def _server(self):
+        cfg = Provider({
+            "serve": {
+                n: {"host": "127.0.0.1", "port": 0}
+                for n in ("read", "write", "metrics", "opl")
+            },
+            "namespaces": {
+                "location": str(FIXTURES / "rewrites_namespaces.keto.ts")
+            },
+            "engine": {"kind": "tpu", "frontier": 512, "arena": 2048,
+                       "max_batch": 128},
+            # shadow EVERY check so the storm itself is the sample set
+            "observability": {"shadow": {"sample_rate": 1}},
+            "log": {"request_log": False},
+        })
+        reg = Registry(cfg).init()
+        reg.store().write_relation_tuples(
+            *[RelationTuple.from_string(s) for s in SEED_TUPLES]
+        )
+        return serve_all(reg)
+
+    def _storm(self, read, n, threads=6):
+        results = []
+        lock = threading.Lock()
+
+        def one(i):
+            case, want = CASES[i % len(CASES)]
+            try:
+                status, body, _ = _http(
+                    "GET", _check_url(read, case),
+                    headers={"X-Request-Timeout": "10s"}, timeout=20.0,
+                )
+                ok = status in (429, 503, 504) or (
+                    status == 200 and json.loads(body)["allowed"] is want
+                )
+                with lock:
+                    results.append((i, status, ok))
+            except Exception as e:  # noqa: BLE001 - a hang IS the failure
+                with lock:
+                    results.append((i, f"exc:{e}", False))
+
+        for base in range(0, n, threads):
+            batch = [
+                threading.Thread(target=one, args=(i,), daemon=True)
+                for i in range(base, min(base + threads, n))
+            ]
+            for t in batch:
+                t.start()
+            for t in batch:
+                t.join(timeout=60.0)
+        assert len(results) == n, "every request must resolve (zero hangs)"
+        bad = [r for r in results if not r[2]]
+        assert not bad, f"wrong verdicts/statuses: {bad[:10]}"
+
+    def _assert_clean(self, srv):
+        sh = srv.registry.shadow()
+        assert sh is not None
+        assert sh.drain(timeout=120.0), "shadow replay queue never drained"
+        st = sh.stats()
+        assert st["divergences"] == 0, sh.ledger()
+        assert sh.ledger() == []
+        m = srv.registry.metrics()
+        assert m.get_counter("keto_shadow_divergence_total") == 0
+        return st
+
+    def test_device_fault_storm_zero_divergence(self):
+        """Device/shard dispatch faults mid-storm: verdicts keep matching
+        the oracle (fallback tier), so the shadow plane — sampling every
+        one of them — scores agreement across the board."""
+        srv = self._server()
+        read = "http://%s:%d" % tuple(srv.addresses["read"])
+        try:
+            status, body, _ = _http("GET", _check_url(read, CASES[0][0]))
+            assert status == 200, body  # warm before hurting the device
+            faults.configure(device_error_rate=0.4, latency_ms=2.0,
+                             latency_rate=0.2, shard_error_rate=1.0,
+                             shard_id=0, seed=9)
+            try:
+                self._storm(read, n=48)
+            finally:
+                faults.reset()
+            st = self._assert_clean(srv)
+            # the storm's checks were actually scored (store is quiet:
+            # nothing to go stale against)
+            assert st["checks"] >= 40, st
+        finally:
+            faults.reset()
+            srv.stop()
+
+    def test_write_storm_zero_false_divergence(self):
+        """A write storm racing the sampler: raced samples are skipped by
+        the same-snapshot guard (counted, not scored) and the scored rest
+        diverges exactly zero times — no false positives from snapshot
+        skew."""
+        srv = self._server()
+        read = "http://%s:%d" % tuple(srv.addresses["read"])
+        reg = srv.registry
+        stop = threading.Event()
+
+        def writer():
+            i = 0
+            while not stop.is_set():
+                # unrelated tuples: log_head churns, CASES verdicts don't
+                reg.store().write_relation_tuples(
+                    RelationTuple.from_string(f"File:junk{i}#owners@nobody")
+                )
+                i += 1
+                time.sleep(0.002)
+
+        w = threading.Thread(target=writer, daemon=True)
+        try:
+            status, body, _ = _http("GET", _check_url(read, CASES[0][0]))
+            assert status == 200, body
+            w.start()
+            self._storm(read, n=60)
+            stop.set()
+            w.join(timeout=30.0)
+            st = self._assert_clean(srv)
+            # the plane did real work under the storm: samples were taken,
+            # and every one was either scored clean or skipped as stale
+            assert st["checks"] + st["skipped"] >= 50, st
+        finally:
+            stop.set()
+            srv.stop()

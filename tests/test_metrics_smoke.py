@@ -247,6 +247,61 @@ def test_projection_metric_vocabulary(scrape):
     assert "build_phases" in proj and proj["build_phases"]
 
 
+def test_metric_vocabulary_documented_in_readme(scrape):
+    """Vocabulary drift gate: every ``keto_*`` metric name a live daemon
+    exposes must appear in README.md's metric table (wildcard rows like
+    ``keto_engine_*`` cover their whole prefix).  A new metric that ships
+    without documentation fails here, listing the missing names."""
+    import os
+
+    names = set()
+    for line in scrape["metrics_text"].splitlines():
+        if not line.startswith("keto_"):
+            continue
+        name = re.match(r"keto_[a-z0-9_]+", line).group(0)
+        for suffix in ("_bucket", "_count", "_sum"):
+            if name.endswith(suffix):
+                name = name[: -len(suffix)]
+                break
+        names.add(name)
+    assert names, "scrape produced no keto_* series"
+    readme_path = os.path.join(
+        os.path.dirname(__file__), "..", "README.md"
+    )
+    with open(readme_path, encoding="utf-8") as f:
+        readme = f.read()
+    wildcards = [
+        w[:-1] for w in re.findall(r"keto_[a-z0-9_]+_\*", readme)
+    ]
+    missing = sorted(
+        n for n in names
+        if n not in readme and not any(n.startswith(p) for p in wildcards)
+    )
+    assert not missing, (
+        f"metrics exposed by a live daemon but absent from README.md's "
+        f"vocabulary table: {missing}"
+    )
+
+
+def test_trace_and_shadow_metric_vocabulary(scrape):
+    """The request-anatomy observatory's vocabulary is live on a fresh
+    daemon: trace-store counters (pre-registered at 0) and the shadow
+    plane's checks/divergence/skip counters + sampled gauges."""
+    text = scrape["metrics_text"]
+    for m in (
+        "keto_trace_completed_total",
+        "keto_trace_promoted_total",
+        "keto_trace_store_promoted",
+        "keto_trace_store_recent",
+        "keto_shadow_checks_total",
+        "keto_shadow_divergence_total",
+        "keto_shadow_skipped_total",
+        "keto_shadow_queue_depth",
+        "keto_shadow_divergence_ledger_size",
+    ):
+        assert m in text, m
+
+
 def test_mesh_serving_metric_vocabulary(scrape):
     # ISSUE 10: replication / rebalance / failover gauges are part of the
     # stable scrape vocabulary even on a single-device engine (zeros), so

@@ -1,0 +1,625 @@
+"""Request-anatomy observatory + shadow-verification plane.
+
+The observability acceptance gate for the tracing/shadow plane:
+
+* unit: the tail-sampled TraceStore (promote on slow/error/shed/forced,
+  park fast traces in the recent ring, force-promote after the fact,
+  bounded stores, reason merging) and the ShadowVerifier's sampling
+  cadence, oracle agreement scoring, and same-snapshot stale guard;
+* transport edge: ``flightrec.rpc_recording`` feeding the store — a fast
+  request is dropped, a slow/errored/shed one is promoted with its span
+  timeline intact, and a caller-supplied W3C traceparent becomes the
+  trace id;
+* e2e (in-process daemon): ``GET /debug`` index, ``GET /debug/trace``
+  (+ ``?trace=<id>`` / ``?n=``), ``GET /debug/divergence``, and a
+  deliberately-injected wrong-verdict engine producing a divergence
+  record that names the answering tier, wave id, and projection
+  generation — and force-promotes the lying request's trace;
+* e2e (slow): one batch check through ``serve --workers 2`` leaves ONE
+  promoted trace whose spans come from BOTH processes (worker transport
+  + device-owner engine legs over the framed wire), with span timings
+  consistent with the observed latency.
+"""
+
+import json
+import os
+import pathlib
+import socket
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from ketotpu import flightrec
+from ketotpu.api.types import RelationTuple
+from ketotpu.driver import Provider, Registry
+from ketotpu.server import serve_all
+from ketotpu.server.handlers import CheckHandler
+from ketotpu.tracing import TraceStore
+
+TUPLES = [
+    "Group:admin#members@alice",
+    "Doc:readme#viewers@Group:admin#members",
+]
+
+TIERS = {"cache", "leopard", "fastpath", "oracle"}
+
+
+def _registry(observability=None, engine=None):
+    cfg = Provider({
+        "namespaces": [{"name": "Group"}, {"name": "Doc"}],
+        "engine": engine or {"kind": "oracle"},
+        "observability": observability or {},
+        "log": {"request_log": False},
+    })
+    reg = Registry(cfg).init()
+    reg.store().write_relation_tuples(
+        *[RelationTuple.from_string(s) for s in TUPLES]
+    )
+    return reg
+
+
+def _entry(tid, **extra):
+    e = {"trace_id": tid, "op": "check", "detail": "", "total_ms": 1.0,
+         "ts": 0.0, "spans": [], "stages_ms": {}, "info": {}}
+    e.update(extra)
+    return e
+
+
+def _http(method, url, body=None, headers=None, timeout=30.0):
+    req = urllib.request.Request(
+        url, data=body, method=method, headers=headers or {}
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+# -- TraceStore unit ---------------------------------------------------------
+
+
+class TestTraceStore:
+    def test_fast_trace_parks_in_recent_not_promoted(self):
+        ts = TraceStore(slow_ms=1000.0)
+        ts.complete(_entry("t1"), [])
+        assert ts.promoted() == []
+        assert ts.get("t1")["trace_id"] == "t1"
+        st = ts.stats()
+        assert st["completions"] == 1 and st["promotions"] == 0
+        assert st["recent_held"] == 1
+
+    def test_promote_and_newest_first(self):
+        ts = TraceStore(slow_ms=0.0, store_size=2)
+        for tid in ("a", "b", "c"):
+            ts.complete(_entry(tid), ["slow"])
+        held = [e["trace_id"] for e in ts.promoted()]
+        assert held == ["c", "b"]  # bounded, newest wins, newest first
+        assert ts.promoted(n=1)[0]["trace_id"] == "c"
+        assert all(e["promoted"] == ["slow"] for e in ts.promoted())
+
+    def test_repromotion_merges_reasons(self):
+        ts = TraceStore(slow_ms=0.0)
+        ts.complete(_entry("t"), ["slow"])
+        ts.complete(_entry("t"), ["error"])
+        assert ts.promoted()[0]["promoted"] == ["error", "slow"]
+
+    def test_force_promote_rescues_from_recent(self):
+        ts = TraceStore(slow_ms=1000.0, recent_size=4)
+        ts.complete(_entry("t"), [])
+        assert ts.force_promote("t", "divergence") is True
+        assert ts.promoted()[0]["promoted"] == ["divergence"]
+        assert ts.force_promote("nope", "divergence") is False
+
+    def test_recent_ring_is_bounded(self):
+        ts = TraceStore(slow_ms=1000.0, recent_size=3)
+        for i in range(10):
+            ts.complete(_entry(f"t{i}"), [])
+        assert ts.stats()["recent_held"] == 3
+        assert ts.get("t0") is None  # evicted: no longer force-promotable
+        assert ts.get("t9") is not None
+
+
+# -- transport edge: rpc_recording -> tail sampling --------------------------
+
+
+class TestTailSampling:
+    def test_fast_trace_is_dropped_slow_is_promoted(self):
+        reg = _registry({"trace": {"slow_ms": 10000.0},
+                         "shadow": {"enabled": False}})
+        try:
+            ts = reg.trace_store()
+            with flightrec.rpc_recording(reg, "check", detail="fast") as ctx:
+                flightrec.note_stage("compute", 0.001)
+                fast_tid = ctx.trace_id
+            assert fast_tid
+            assert ts.get(fast_tid) is not None  # parked, force-promotable
+            assert all(e["trace_id"] != fast_tid for e in ts.promoted())
+
+            ts.slow_ms = 0.0  # now everything is "slow"
+            with flightrec.rpc_recording(reg, "check", detail="slow") as ctx:
+                flightrec.note_stage("compute", 0.002)
+                slow_tid = ctx.trace_id
+            ent = ts.get(slow_tid)
+            assert "slow" in ent["promoted"]
+            # the span timeline rode along: the stage note and the closing
+            # rpc-level span, all stamped with this process's pid
+            names = [s["name"] for s in ent["spans"]]
+            assert names == ["compute", "rpc.check"]
+            assert all(s["pid"] == os.getpid() for s in ent["spans"])
+            assert ent["stages_ms"]["compute"] >= 1.0
+        finally:
+            reg.close_engines()
+
+    def test_error_statuses_promote(self):
+        reg = _registry({"trace": {"slow_ms": 10000.0},
+                         "shadow": {"enabled": False}})
+        try:
+            ts = reg.trace_store()
+            for status, reason in ((429, "shed"), (504, "deadline"),
+                                   (500, "error")):
+                with flightrec.rpc_recording(reg, "check") as ctx:
+                    flightrec.note(status=status)
+                    tid = ctx.trace_id
+                assert reason in ts.get(tid)["promoted"], (status, reason)
+        finally:
+            reg.close_engines()
+
+    def test_force_promote_from_inside_the_request(self):
+        reg = _registry({"trace": {"slow_ms": 10000.0},
+                         "shadow": {"enabled": False}})
+        try:
+            with flightrec.rpc_recording(reg, "check") as ctx:
+                flightrec.force_promote("divergence")
+                tid = ctx.trace_id
+            ent = reg.trace_store().get(tid)
+            assert ent["promoted"] == ["divergence"]
+            assert "force_promote" not in ent["info"]
+        finally:
+            reg.close_engines()
+
+    def test_caller_traceparent_becomes_the_trace_id(self):
+        reg = _registry({"trace": {"slow_ms": 0.0},
+                         "shadow": {"enabled": False}})
+        try:
+            tid = "00112233445566778899aabbccddeeff"
+            tp = f"00-{tid}-0123456789abcdef-01"
+            with flightrec.rpc_recording(reg, "check", traceparent=tp) as c:
+                assert c.trace_id == tid
+            assert reg.trace_store().get(tid) is not None
+        finally:
+            reg.close_engines()
+
+    def test_disabled_tracing_means_no_store_and_no_spans(self):
+        reg = _registry({"trace": {"enabled": False},
+                         "shadow": {"enabled": False}})
+        try:
+            assert reg.trace_store() is None
+            with flightrec.rpc_recording(reg, "check") as ctx:
+                flightrec.note_stage("compute", 0.001)
+                assert ctx.trace is None
+                assert ctx.spans == []  # span buffer entirely skipped
+        finally:
+            reg.close_engines()
+
+
+# -- ShadowVerifier unit -----------------------------------------------------
+
+
+class TestShadowSampler:
+    def test_sampling_cadence(self):
+        reg = _registry({"shadow": {"sample_rate": 4}})
+        try:
+            sh = reg.shadow()
+            rolls = [sh.reserve() for _ in range(8)]
+            hits = [i for i, c in enumerate(rolls) if c is not None]
+            assert hits == [3, 7]  # exactly 1-in-4, deterministic cadence
+        finally:
+            reg.close_engines()
+
+    def test_block_reserve_picks_one_row(self):
+        reg = _registry({"shadow": {"sample_rate": 4}})
+        try:
+            sh = reg.shadow()
+            row, cur = sh.reserve_block(4)
+            assert row == 3 and cur == int(reg.store().log_head)
+            assert sh.reserve_block(2) == (None, 0)
+            row, _ = sh.reserve_block(2)
+            assert row == 1  # the 8th check overall
+        finally:
+            reg.close_engines()
+
+    def test_agreement_scores_without_divergence(self):
+        reg = _registry({"shadow": {"sample_rate": 1}})
+        try:
+            sh = reg.shadow()
+            t = RelationTuple.from_string("Group:admin#members@alice")
+            cur = sh.reserve()
+            assert cur is not None
+            sh.submit(t, 8, True, cursor=cur)
+            assert sh.drain(timeout=30.0)
+            st = sh.stats()
+            assert st["checks"] == 1 and st["divergences"] == 0
+            assert sh.ledger() == []
+            m = reg.metrics()
+            assert m.get_counter("keto_shadow_checks_total") == 1
+            assert m.get_counter("keto_shadow_divergence_total") == 0
+        finally:
+            reg.close_engines()
+
+    def test_wrong_verdict_files_a_divergence_record(self):
+        reg = _registry({"shadow": {"sample_rate": 1}})
+        try:
+            sh = reg.shadow()
+            t = RelationTuple.from_string("Group:admin#members@alice")
+            cur = sh.reserve()
+            sh.submit(t, 8, False, cursor=cur)  # oracle says True
+            assert sh.drain(timeout=30.0)
+            assert sh.stats()["divergences"] == 1
+            (rec,) = sh.ledger()
+            assert rec["tuple"] == "Group:admin#members@alice"
+            assert rec["served"] is False and rec["oracle"] is True
+            assert rec["tier"] in TIERS
+            assert reg.metrics().get_counter(
+                "keto_shadow_divergence_total") == 1
+        finally:
+            reg.close_engines()
+
+    def test_same_snapshot_guard_skips_raced_samples(self):
+        reg = _registry({"shadow": {"sample_rate": 1}})
+        try:
+            sh = reg.shadow()
+            t = RelationTuple.from_string("Group:admin#members@alice")
+            cur = sh.reserve()
+            # a write lands between the sample and the replay: the cursor
+            # is stale, the sample must be skipped — NEVER misfiled as a
+            # divergence (even with a wrong verdict riding it)
+            reg.store().write_relation_tuples(
+                RelationTuple.from_string("Group:dev#members@bob")
+            )
+            sh.submit(t, 8, False, cursor=cur)
+            assert sh.drain(timeout=30.0)
+            st = sh.stats()
+            assert st["skipped"] >= 1
+            assert st["checks"] == 0 and st["divergences"] == 0
+            assert reg.metrics().get_counter(
+                "keto_shadow_skipped_total", reason="stale") == 1
+        finally:
+            reg.close_engines()
+
+    def test_workers_do_not_shadow(self):
+        cfg = Provider({
+            "engine": {"kind": "remote", "socket": "/tmp/nope.sock"},
+        })
+        # worker-side relays forward checks to the owner, which holds the
+        # authoritative store — the owner shadows them instead
+        assert Registry(cfg).shadow() is None
+
+
+# -- acceptance: injected wrong-verdict engine through the serving edge ------
+
+
+class _LyingEngine:
+    """Wraps the real engine; flips every single-check verdict AFTER the
+    real wave ran (so wave ids, tiers, and the projection generation are
+    the real plumbing's, only the answer lies)."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def check_is_member(self, tuple_, rest_depth=0):
+        return not self._inner.check_is_member(tuple_, rest_depth)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+class TestDivergenceInjection:
+    def test_lying_fast_path_is_caught_with_full_provenance(self):
+        reg = _registry(
+            observability={"trace": {"slow_ms": 10000.0},
+                           "shadow": {"sample_rate": 1}},
+            engine={"kind": "tpu", "frontier": 512, "arena": 2048,
+                    "max_batch": 128, "coalesce_ms": 2},
+        )
+        try:
+            handler = CheckHandler(reg)
+            sh = reg.shadow()
+            ts = reg.trace_store()
+            # warm pass: truthful engine, shadow agrees
+            with flightrec.rpc_recording(reg, "check"):
+                assert handler.check_core(
+                    RelationTuple.from_string("Group:admin#members@alice"), 8
+                ) is True
+            assert sh.drain(timeout=60.0)
+            assert sh.stats()["divergences"] == 0
+
+            reg.check_engine = lambda: _LyingEngine(Registry.check_engine(reg))
+            with flightrec.rpc_recording(reg, "check") as ctx:
+                tid = ctx.trace_id
+                got = handler.check_core(
+                    RelationTuple.from_string("Doc:readme#viewers@alice"), 8
+                )
+            assert got is False  # the lie (oracle: True via Group:admin)
+
+            assert sh.drain(timeout=60.0)
+            assert sh.stats()["divergences"] == 1
+            (rec,) = sh.ledger()
+            assert rec["tuple"] == "Doc:readme#viewers@alice"
+            assert rec["served"] is False and rec["oracle"] is True
+            # full provenance: answering tier, the real wave the check
+            # rode, the projection generation it was answered against,
+            # and the trace id joining back to the promoted anatomy
+            assert rec["tier"] in TIERS or rec["tier"].startswith("mesh-shard-")
+            assert rec["wave"] >= 1
+            assert rec["generation"] >= 1
+            assert rec["trace_id"] == tid
+
+            # the lying request was fast (slow_ms=10000) — ONLY the
+            # divergence promoted its trace out of the recent ring
+            ent = ts.get(tid)
+            assert ent["promoted"] == ["divergence"]
+            m = reg.metrics()
+            assert m.get_counter("keto_shadow_divergence_total") == 1
+            assert m.get_counter("keto_trace_promoted_total",
+                                 reason="divergence") == 1
+        finally:
+            del reg.check_engine
+            reg.close_engines()
+
+
+# -- e2e: the debug surfaces on a live daemon --------------------------------
+
+
+KNOWN_TID = "5ca1ab1e5ca1ab1e5ca1ab1e5ca1ab1e"
+
+
+@pytest.fixture(scope="module")
+def debug_server():
+    cfg = Provider({
+        "serve": {
+            n: {"host": "127.0.0.1", "port": 0}
+            for n in ("read", "write", "metrics", "opl")
+        },
+        "namespaces": [{"name": "Group"}, {"name": "Doc"}],
+        "engine": {"kind": "tpu", "frontier": 1024, "arena": 4096,
+                   "max_batch": 256, "coalesce_ms": 2},
+        "observability": {"trace": {"slow_ms": 0.0},
+                          "shadow": {"sample_rate": 1}},
+        "log": {"request_log": False},
+    })
+    reg = Registry(cfg).init()
+    reg.store().write_relation_tuples(
+        *[RelationTuple.from_string(s) for s in TUPLES]
+    )
+    srv = serve_all(reg)
+    read = "http://%s:%d" % tuple(srv.addresses["read"])
+    # traffic: one check with a caller-supplied traceparent (its trace id
+    # must be adopted), one anonymous check, one batch
+    _http(
+        "GET",
+        f"{read}/relation-tuples/check/openapi?namespace=Doc&object=readme"
+        "&relation=viewers&subject_id=alice",
+        headers={"traceparent": f"00-{KNOWN_TID}-0123456789abcdef-01"},
+    )
+    _http(
+        "GET",
+        f"{read}/relation-tuples/check/openapi?namespace=Doc&object=readme"
+        "&relation=viewers&subject_id=mallory",
+    )
+    _http(
+        "POST", f"{read}/relation-tuples/batch/check",
+        body=json.dumps({"tuples": [
+            {"namespace": "Doc", "object": "readme", "relation": "viewers",
+             "subject_id": s} for s in ("alice", "bob", "mallory")
+        ]}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture(scope="module")
+def metrics_addr(debug_server):
+    return "http://%s:%d" % tuple(debug_server.addresses["metrics"])
+
+
+class TestDebugSurfaces:
+    def test_debug_index_enumerates_every_surface(self, metrics_addr):
+        status, body = _http("GET", f"{metrics_addr}/debug")
+        assert status == 200
+        surfaces = json.loads(body)["surfaces"]
+        assert set(surfaces) == {
+            "/debug/flight-recorder", "/debug/trace", "/debug/divergence",
+            "/debug/waves", "/debug/compiles", "/debug/projection",
+            "/debug/mesh", "/debug/profile",
+        }
+        assert all(isinstance(v, str) and v for v in surfaces.values())
+
+    def test_trace_listing_and_single_lookup(self, metrics_addr):
+        status, body = _http("GET", f"{metrics_addr}/debug/trace")
+        assert status == 200
+        payload = json.loads(body)
+        assert payload["enabled"] is True
+        assert payload["stats"]["promotions"] >= 3  # slow_ms=0: all promote
+        traces = payload["traces"]
+        assert traces
+        for e in traces:
+            assert e["trace_id"] and e["spans"] and "slow" in e["promoted"]
+            assert e["spans"][-1]["name"].startswith("rpc.")
+
+        # the caller-supplied traceparent's trace id is queryable
+        status, body = _http(
+            "GET", f"{metrics_addr}/debug/trace?trace={KNOWN_TID}"
+        )
+        assert status == 200
+        ent = json.loads(body)
+        assert ent["trace_id"] == KNOWN_TID
+        assert ent["info"]["traceparent"].startswith(f"00-{KNOWN_TID}-")
+
+        status, _ = _http(
+            "GET", f"{metrics_addr}/debug/trace?trace={'0' * 32}"
+        )
+        assert status == 404
+
+        status, body = _http("GET", f"{metrics_addr}/debug/trace?n=1")
+        assert status == 200 and len(json.loads(body)["traces"]) == 1
+
+    def test_divergence_surface_is_clean(self, metrics_addr, debug_server):
+        sh = debug_server.registry.shadow()
+        assert sh.drain(timeout=60.0)
+        status, body = _http("GET", f"{metrics_addr}/debug/divergence")
+        assert status == 200
+        payload = json.loads(body)
+        assert payload["enabled"] is True
+        assert payload["stats"]["checks"] >= 1
+        assert payload["stats"]["divergences"] == 0
+        assert payload["divergences"] == []
+
+    def test_trace_vocabulary_on_the_scrape(self, metrics_addr):
+        _, text = _http("GET", f"{metrics_addr}/metrics/prometheus")
+        assert 'keto_trace_promoted_total{reason="slow"}' in text
+        assert "keto_shadow_divergence_total 0" in text
+
+
+# -- e2e (slow): one trace id stitched across owner + worker processes -------
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.slow
+def test_cross_process_trace_stitching_through_workers(tmp_path):
+    """A worker-routed batch check through ``serve --workers 2`` promotes
+    ONE trace: the caller's trace id, spans from BOTH the worker process
+    (transport + remote-engine legs) and the device-owner process (engine
+    host legs shipped back over the framed wire), with span timings
+    consistent with the client-observed latency."""
+    db = tmp_path / "trace.db"
+    seed_reg = Registry(Provider({"dsn": f"sqlite://{db}"}))
+    seed_reg.store().migrate_up()
+    seed_reg.store().write_relation_tuples(
+        *[RelationTuple.from_string(s) for s in TUPLES]
+    )
+
+    ports = {n: _free_port() for n in ("read", "write", "metrics", "opl")}
+    config = {
+        "dsn": f"sqlite://{db}",
+        "serve": {
+            n: {"host": "127.0.0.1", "port": p} for n, p in ports.items()
+        },
+        "namespaces": [{"name": "Group"}, {"name": "Doc"}],
+        "engine": {"kind": "tpu", "frontier": 512, "arena": 2048,
+                   "max_batch": 128},
+        # slow_ms=0: every request promotes, so the one batch check below
+        # is guaranteed queryable; shadow samples everything it can
+        "observability": {"trace": {"slow_ms": 0.0},
+                          "shadow": {"sample_rate": 1}},
+        "log": {"request_log": False},
+    }
+    cfg_path = tmp_path / "trace.json"
+    cfg_path.write_text(json.dumps(config))
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ketotpu.cli", "serve",
+         "-c", str(cfg_path), "--workers", "2"],
+        env=env, cwd=str(pathlib.Path(__file__).parent.parent),
+    )
+    read = f"http://127.0.0.1:{ports['read']}"
+    metrics = f"http://127.0.0.1:{ports['metrics']}"
+    tid = "feedfacefeedfacefeedfacefeedface"
+    try:
+        ready_by = time.monotonic() + 180.0
+        while True:
+            assert proc.poll() is None, "serve --workers died during boot"
+            try:
+                status, _ = _http("GET", f"{metrics}/health/ready",
+                                  timeout=2.0)
+                if status == 200:
+                    break
+            except OSError:
+                pass
+            assert time.monotonic() < ready_by, "topology never became ready"
+            time.sleep(0.5)
+
+        t0 = time.monotonic()
+        status, body = _http(
+            "POST", f"{read}/relation-tuples/batch/check",
+            body=json.dumps({"tuples": [
+                {"namespace": "Doc", "object": "readme",
+                 "relation": "viewers", "subject_id": s}
+                for s in ("alice", "bob", "carol", "mallory")
+            ]}).encode(),
+            headers={"Content-Type": "application/json",
+                     "traceparent": f"00-{tid}-00f067aa0ba902b7-01"},
+            timeout=60.0,
+        )
+        elapsed_ms = (time.monotonic() - t0) * 1000.0
+        assert status == 200, body
+
+        # the trace lives in whichever SO_REUSEPORT worker served the
+        # POST; each GET is a fresh connection, so retry until the kernel
+        # hashes one onto that worker
+        ent = None
+        for _ in range(120):
+            status, body = _http(
+                "GET", f"{metrics}/debug/trace?trace={tid}", timeout=10.0
+            )
+            if status == 200:
+                ent = json.loads(body)
+                break
+            time.sleep(0.25)
+        assert ent is not None, "trace never found on any worker"
+
+        assert ent["trace_id"] == tid
+        spans = ent["spans"]
+        pids = {s["pid"] for s in spans}
+        assert len(pids) >= 2, (
+            f"spans from one process only (pids={pids}): {spans}"
+        )
+        # the worker's closing rpc span is the timeline root; the owner's
+        # engine-host leg (shipped back over the framed wire) is a
+        # DIFFERENT process's rpc.* span inside it
+        root = spans[-1]
+        worker_pid = root["pid"]
+        assert root["name"] == "rpc.check"
+        owner_rpc = [s for s in spans
+                     if s["pid"] != worker_pid and s["name"].startswith("rpc.")]
+        assert owner_rpc, f"no engine-host rpc leg in {spans}"
+
+        # timings are coherent: the root span ≈ the stored total, every
+        # span fits inside the client-observed wall time (+slack for the
+        # response leg), and the owner's leg fits inside the worker's
+        assert abs(root["ms"] - ent["total_ms"]) < 5.0
+        assert ent["total_ms"] <= elapsed_ms + 250.0
+        assert max(o["ms"] for o in owner_rpc) <= ent["total_ms"] + 50.0
+        # the worker-side stage spans decompose the request: their sum
+        # lands within slack of the stored total latency (generous slack —
+        # on a loaded CI box scheduling gaps between stages are untracked
+        # time that widens the difference)
+        stage_sum = sum(
+            s["ms"] for s in spans
+            if s["pid"] == worker_pid and not s["name"].startswith("rpc.")
+        )
+        assert stage_sum > 0.0
+        assert abs(stage_sum - ent["total_ms"]) <= max(
+            0.75 * ent["total_ms"], 50.0
+        ), (stage_sum, ent["total_ms"])
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
