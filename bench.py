@@ -243,7 +243,7 @@ def main() -> int:
         "cache_shield",
         "scale_10m",
         "scale_10m_mixed", "scale_10m_expand", "leopard_10m",
-        "write_visibility",
+        "write_visibility", "durability",
     }
 
     def run(name, fn, *a):
@@ -288,6 +288,7 @@ def main() -> int:
         run("scale_10m_expand", _scale_10m_expand, out, state)
         run("leopard_10m", _leopard_10m, out, state)
         run("write_visibility", _write_visibility, out, state)
+        run("durability", _durability, out, state)
 
     _publish_phases(out, state)
     try:
@@ -1020,6 +1021,124 @@ def _write_visibility(out, state) -> None:
             )
     finally:
         weng.close()
+
+
+def _durability(out, state) -> None:
+    """ISSUE 12: the warm-standby durability plane at 10M.  Measures the
+    replication bootstrap stream (owner capture -> wire roundtrip ->
+    replica adopt), the standby's recovery-to-first-verdict after
+    adopting (the kill -9 takeover cost floor: projection shipped, no
+    rebuild), and the write-path cost of semi-sync acks vs async."""
+    import socket as socket_mod
+    import threading
+
+    from ketotpu.api.types import RelationTuple
+    from ketotpu.engine import checkpoint as ckpt
+    from ketotpu.engine.tpu import DeviceCheckEngine
+    from ketotpu.server import wire
+    from ketotpu.server.workers import ReplicationGate
+    from ketotpu.storage.memory import InMemoryTupleStore
+    from ketotpu.utils.synth import synth_queries
+
+    big, beng = state["big"], state["beng"]
+
+    # -- bootstrap stream: one frame carries snapshot + scan + tail ------
+    t0 = time.perf_counter()
+    (snap, cursor, fingerprint, rows, tail, head,
+     version) = beng.replication_snapshot()
+    capture_s = time.perf_counter() - t0
+    arrays = ckpt.snapshot_to_arrays(
+        snap, extra={"fingerprint": fingerprint},
+        cursor=cursor, head=head, store_version=version,
+    )
+    wire.pack_tuplecols(arrays, "st", rows)
+    wire.pack_changes(arrays, "tl", tail)
+    a_sock, b_sock = socket_mod.socketpair()
+    sent = {}
+
+    def _send():
+        sent["n"] = wire.send_frame(a_sock, {"op": "repl_bootstrap"}, arrays)
+
+    t0 = time.perf_counter()
+    tx = threading.Thread(target=_send, daemon=True)
+    tx.start()
+    rfile = b_sock.makefile("rb")
+    meta2, arrays2, nread = wire.recv_frame(rfile)
+    tx.join()
+    stream_s = time.perf_counter() - t0
+    rfile.close()
+    a_sock.close()
+    b_sock.close()
+
+    # -- replica adopt: store coordinates + device projection ------------
+    t0 = time.perf_counter()
+    snap2 = ckpt.snapshot_from_arrays(arrays2, {"fingerprint": fingerprint})
+    rows2 = wire.unpack_tuplecols(arrays2, "st")
+    tail2 = wire.unpack_changes(arrays2, "tl")
+    rstore = InMemoryTupleStore()
+    rstore.adopt_replica(rows2, head, version, log=tail2, log_start=cursor)
+    reng = DeviceCheckEngine(
+        rstore, big.manager, frontier=6 * BATCH, arena=12 * BATCH,
+        cap=65536, gen_arena=65536, vcap=32768, max_batch=BATCH // 2,
+    )
+    reng.adopt_snapshot(snap2, cursor=cursor, fingerprint=fingerprint)
+    adopt_s = time.perf_counter() - t0
+    try:
+        # -- recovery-to-first-verdict on the adopted replica ------------
+        qs = synth_queries(big, 256, seed=31)
+        t0 = time.perf_counter()
+        reng.batch_check(qs[:1])
+        first_verdict_s = time.perf_counter() - t0
+        assert reng.rebuilds == 0, "takeover paid a projection rebuild"
+        total_s = capture_s + stream_s + adopt_s
+        out.update(
+            durability_capture_s=round(capture_s, 2),
+            durability_stream_s=round(stream_s, 2),
+            durability_stream_mb=round(nread / 1e6, 1),
+            durability_stream_mb_s=round(nread / 1e6 / max(stream_s, 1e-9), 1),
+            durability_adopt_s=round(adopt_s, 2),
+            durability_bootstrap_tuples_per_s=round(
+                len(rows2) / max(total_s, 1e-9), 1
+            ),
+            durability_recovery_first_verdict_s=round(first_verdict_s, 3),
+        )
+    finally:
+        reng.close()
+
+    # -- semi-sync vs async write p99 ------------------------------------
+    # an in-process follower acks at a tail-poll cadence; the spread
+    # between the two modes is the durability premium a write pays
+    def _write_p99(mode: str) -> float:
+        store = InMemoryTupleStore()
+        gate = ReplicationGate(mode, ack_timeout_ms=2000)
+        stop = threading.Event()
+
+        def _acker():
+            while not stop.is_set():
+                gate.ack(store.log_head)
+                time.sleep(0.001)  # durability.poll_ms floor
+
+        t = None
+        if mode == "semi-sync":
+            gate.ack(0)
+            t = threading.Thread(target=_acker, daemon=True)
+            t.start()
+        lat = []
+        for i in range(800):
+            tup = RelationTuple.from_string(f"Doc:dura#viewers@w{i}")
+            t0 = time.perf_counter()
+            store.write_relation_tuples(tup)
+            gate.wait_replicated(store.log_head)
+            lat.append((time.perf_counter() - t0) * 1000.0)
+        stop.set()
+        if t is not None:
+            t.join(5)
+        return float(np.percentile(lat, 99))
+
+    out["durability_write_p99_ms_async"] = round(_write_p99("async"), 3)
+    out["durability_write_p99_ms_semi_sync"] = round(
+        _write_p99("semi-sync"), 3
+    )
 
 
 if __name__ == "__main__":

@@ -135,6 +135,8 @@ def cmd_serve(args) -> int:
 
     if getattr(args, "worker_of", ""):
         return cmd_serve_worker(args)
+    if getattr(args, "standby", False):
+        return cmd_serve_standby(args)
     workers = int(getattr(args, "workers", 0) or 0)
     if workers > 0:
         return _serve_multiprocess(args, workers)
@@ -184,12 +186,17 @@ def _serve_multiprocess(args, workers: int) -> int:
     log = reg.logger()
     log.info("initializing device owner (engine warmup)")
     reg.init()
-    # the socket lives in a fresh 0700 directory: a bare mktemp name in
-    # world-writable /tmp is squattable between name pick and bind, and
-    # the directory mode (not the umask-dependent socket mode) is what
-    # actually gates connect permission
-    sockdir = tempfile.mkdtemp(prefix="keto-engine-")
-    sock = os.path.join(sockdir, "engine.sock")
+    # durability.socket pins the engine-host path (so a warm standby can
+    # find the owner); otherwise the socket lives in a fresh 0700
+    # directory: a bare mktemp name in world-writable /tmp is squattable
+    # between name pick and bind, and the directory mode (not the
+    # umask-dependent socket mode) is what actually gates connect
+    # permission
+    sock = str(cfg.get("durability.socket") or "")
+    sockdir = ""
+    if not sock:
+        sockdir = tempfile.mkdtemp(prefix="keto-engine-")
+        sock = os.path.join(sockdir, "engine.sock")
     host = EngineHostServer(reg, sock, health_fn=reg.health).start()
 
     def spawn(i: int) -> "subprocess.Popen":
@@ -239,10 +246,11 @@ def _serve_multiprocess(args, workers: int) -> int:
         sup.terminate()
     finally:
         host.stop()
-        try:
-            os.rmdir(sockdir)
-        except OSError:
-            pass
+        if sockdir:
+            try:
+                os.rmdir(sockdir)
+            except OSError:
+                pass
     return rc
 
 
@@ -271,6 +279,104 @@ def cmd_serve_worker(args) -> int:
         srv.wait()
     except KeyboardInterrupt:
         srv.stop()
+    return 0
+
+
+def cmd_serve_standby(args) -> int:
+    """--standby: warm follower beside a live owner (ketotpu/standby.py).
+
+    Replicates the owner's changelog into a LOCAL in-memory replica (the
+    constructor dsn override below: the follower must not share the
+    owner's durable store — it mirrors it through the wire), stays warm,
+    and on owner death or POST /debug/handoff binds the same public
+    ports via SO_REUSEPORT and serves — snaptoken-exact."""
+    from ketotpu import faults
+    from ketotpu.driver import Provider, Registry
+    from ketotpu.server import rest, serve_all
+    from ketotpu.standby import StandbyError, StandbyFollower
+
+    cfg = Provider({"dsn": "memory"}, config_file=args.config) \
+        if args.config else Provider({"dsn": "memory"})
+    faults.configure_from_config(cfg)
+    sock = str(cfg.get("durability.socket") or "")
+    if not sock:
+        print(
+            "serve --standby needs durability.socket pointing at the "
+            "owner's engine-host socket",
+            file=sys.stderr,
+        )
+        return 2
+    reg = Registry(cfg)
+    log = reg.logger()
+    follower = StandbyFollower(reg, sock)
+    # pre-promotion observability: the follower's own metrics HTTP port
+    # (durability.standby_port) serves the standby gauges, the standby
+    # row in /debug/projection, and the POST /debug/handoff trigger —
+    # the public 4-port front door still belongs to the owner
+    pre_http = None
+    standby_port = int(cfg.get("durability.standby_port", 4470) or 0)
+    if standby_port:
+        import threading as _threading
+
+        host = cfg.listen_on("metrics")[0]
+        pre_http = rest.make_http_server(
+            rest.metrics_router(reg), host, standby_port
+        )
+        _threading.Thread(
+            target=pre_http.serve_forever, daemon=True,
+            name="standby-metrics",
+        ).start()
+        log.info("standby metrics on %s:%d", host, standby_port)
+    import signal
+
+    def _sigterm(signum, frame):
+        raise KeyboardInterrupt
+
+    signal.signal(signal.SIGTERM, _sigterm)
+    log.info("standby following owner at %s", sock)
+    try:
+        reason = follower.run()
+    except StandbyError as e:
+        print(f"standby: {e}", file=sys.stderr)
+        return 2
+    except KeyboardInterrupt:
+        log.info("standby shutting down (never promoted)")
+        follower.close()
+        if pre_http is not None:
+            pre_http.shutdown()
+            pre_http.server_close()
+        return 0
+    if pre_http is not None:
+        # the daemon below owns the real metrics port; drop the
+        # pre-promotion listener first so nothing double-serves
+        pre_http.shutdown()
+        pre_http.server_close()
+    log.info("standby promoting (reason=%s); binding front door", reason)
+    # become the next owner end-to-end: re-host the engine socket on the
+    # same path (EngineHostServer unlinks the dead owner's stale bind;
+    # during a deliberate handoff the unlink steals new connections from
+    # the draining old owner) so the NEXT standby in a rolling-restart
+    # chain has something to attach to
+    from ketotpu.server.workers import EngineHostServer
+
+    host_srv = None
+    try:
+        host_srv = EngineHostServer(reg, sock, health_fn=reg.health).start()
+        log.info("serving engine host (replication wire) on %s", sock)
+    except OSError as e:
+        log.warning("could not re-host engine socket %s: %s", sock, e)
+    # SO_REUSEPORT: binds even while the old owner still holds the ports
+    # during a deliberate rolling restart; after owner death it simply
+    # binds fresh
+    srv = serve_all(reg, reuse_port=True)
+    try:
+        srv.wait()
+    except KeyboardInterrupt:
+        log.info("shutting down gracefully")
+        srv.stop()
+    finally:
+        if host_srv is not None:
+            host_srv.stop()
     return 0
 
 
@@ -818,6 +924,28 @@ def _dump_projection(metrics_remote: str) -> int:
         f" {payload.get('projection_upload_s', 0.0)}s upload"
         + (f" [{phases}]" if phases else "")
     )
+    repl = payload.get("replication")
+    if repl:
+        print(
+            f"  replication: mode={repl.get('mode', 'async')}"
+            f" attached={repl.get('attached', False)}"
+            f" acked={repl.get('acked_cursor', -1)}"
+            f" waits={repl.get('semi_sync_waits', 0)}"
+            f" timeouts={repl.get('ack_timeouts', 0)}"
+        )
+    stby = payload.get("standby")
+    if stby:
+        print(
+            f"  standby: state={stby.get('state', '?')}"
+            f" cursor={stby.get('cursor', 0)}"
+            f" owner_head={stby.get('owner_head', -1)}"
+            f" lag={stby.get('lag_entries', 0)}"
+            f" misses={stby.get('misses', 0)}"
+            f"/{stby.get('miss_budget', 0)}"
+            f" resyncs={stby.get('resyncs', 0)}"
+            f" bootstraps={stby.get('bootstraps', 0)}"
+            f" applied={stby.get('applied_entries', 0)}"
+        )
     return 0
 
 
@@ -1064,6 +1192,12 @@ def build_parser() -> argparse.ArgumentParser:
         "--worker-of", metavar="SOCKET", default="",
         help="internal: run as a worker forwarding to the device owner "
              "at SOCKET",
+    )
+    serve.add_argument(
+        "--standby", action="store_true",
+        help="run as a warm standby following the owner at "
+             "durability.socket; takes over the public ports on owner "
+             "death or POST /debug/handoff",
     )
     serve.set_defaults(fn=cmd_serve)
 

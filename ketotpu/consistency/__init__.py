@@ -14,7 +14,7 @@ real for this stack:
   ``WatchService.Watch`` stream and REST SSE ``GET /relation-tuples/watch``.
 """
 
-from ketotpu.consistency.barrier import ensure_fresh
+from ketotpu.consistency.barrier import ensure_fresh, satisfies_token
 from ketotpu.consistency.tokens import Snaptoken, decode, mint, try_decode
 from ketotpu.consistency.watch import (
     DELTA,
@@ -36,5 +36,6 @@ __all__ = [
     "decode",
     "ensure_fresh",
     "mint",
+    "satisfies_token",
     "try_decode",
 ]

@@ -118,6 +118,17 @@ def satisfies_cursor(token: Snaptoken, cursor: int) -> bool:
     return False
 
 
+def satisfies_token(token: Snaptoken, *, cursor: int, version: int) -> bool:
+    """True when state at (changelog ``cursor``, store ``version``) is at
+    least as fresh as ``token`` — the takeover invariant a warm standby
+    must hold for every snaptoken the old owner ever minted.  Cursor-ful
+    tokens compare by cursor (the replicated changelog coordinate);
+    legacy version-only tokens compare by store version."""
+    if token.cursor >= 0 or token.shards:
+        return satisfies_cursor(token, cursor)
+    return version >= token.version
+
+
 def _satisfied(token: Snaptoken, engine, store) -> bool:
     if engine is not None:
         cursors = getattr(engine, "consistency_cursors", None)

@@ -91,8 +91,14 @@ def mint(store, engine=None) -> Snaptoken:
     """Mint a token for the store's current state.  ``engine`` is the local
     device engine when this process owns one (contributes snapshot epoch +
     shard vector); worker processes mint from the shared store alone."""
-    version = store.version
-    cursor = store.log_head
+    if hasattr(store, "version_and_head"):
+        # one lock window: a write landing between separate version/head
+        # reads would mint a token whose cursor claims entries of a
+        # version it doesn't — fatal to snaptoken-exact standby takeover
+        version, cursor = store.version_and_head()
+    else:
+        version = store.version
+        cursor = store.log_head
     epoch = 0
     shards: Tuple[int, ...] = ()
     if engine is not None:

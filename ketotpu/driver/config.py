@@ -273,6 +273,25 @@ def _defaults() -> Dict[str, Any]:
                 "ledger_size": 256,
             },
         },
+        # warm-standby durability (ketotpu/standby.py + server/workers.py):
+        # `socket` publishes the owner's engine-host unix socket (the
+        # replication channel a standby bootstraps/tails over, and the
+        # worker wire in --workers mode); `replication` picks how hard the
+        # write path couples to the follower (async = ack on local commit,
+        # semi-sync = ack after the standby's tail covers the commit,
+        # degrading to async per-write after ack_timeout_ms); the standby
+        # polls every poll_ms and promotes itself after heartbeat_misses
+        # consecutive failed polls spaced heartbeat_ms apart.  standby_port
+        # is the follower's pre-promotion observability HTTP port.
+        "durability": {
+            "replication": "async",
+            "socket": "",
+            "heartbeat_ms": 500,
+            "heartbeat_misses": 3,
+            "poll_ms": 50,
+            "ack_timeout_ms": 2000,
+            "standby_port": 4470,
+        },
         # fault injection (ketotpu/faults.py): all-zero = inactive.  The
         # KETO_FAULT_* environment knobs override this block entirely —
         # that is how the chaos CI job drives subprocesses.
@@ -280,6 +299,7 @@ def _defaults() -> Dict[str, Any]:
             "device_error_rate": 0.0,
             "device_stall_ms": 0.0,
             "socket_drop_rate": 0.0,
+            "tail_drop_rate": 0.0,
             "latency_ms": 0.0,
             "latency_rate": 0.0,
             "seed": 0,
@@ -364,7 +384,9 @@ class Provider:
                           "flight_recorder_max_age_s", "compile_log_size",
                           "warm_compile_warning", "max_seconds",
                           "slow_ms", "store_size", "recent_size",
-                          "sample_rate", "ledger_size"):
+                          "sample_rate", "ledger_size", "poll_ms",
+                          "heartbeat_misses", "ack_timeout_ms",
+                          "standby_port", "tail_drop_rate"):
                 suffix = known.split("_")
                 if len(joined) > len(suffix) and joined[-len(suffix):] == suffix:
                     joined = joined[: -len(suffix)] + [known]
@@ -531,8 +553,37 @@ class Provider:
                 raise ConfigError(
                     key, f"must be a non-negative integer, got {val!r}"
                 )
+        mode = self.get("durability.replication")
+        if mode not in ("async", "semi-sync"):
+            raise ConfigError(
+                "durability.replication",
+                f"must be 'async' or 'semi-sync', got {mode!r}",
+            )
+        if not isinstance(self.get("durability.socket", ""), str):
+            raise ConfigError(
+                "durability.socket", "must be a string path"
+            )
+        for key in ("durability.heartbeat_ms", "durability.poll_ms",
+                    "durability.ack_timeout_ms"):
+            val = self.get(key)
+            if not isinstance(val, int) or val < 1:
+                raise ConfigError(
+                    key, f"must be a positive integer, got {val!r}"
+                )
+        val = self.get("durability.heartbeat_misses")
+        if not isinstance(val, int) or val < 1:
+            raise ConfigError(
+                "durability.heartbeat_misses",
+                f"must be a positive integer, got {val!r}",
+            )
+        val = self.get("durability.standby_port")
+        if not isinstance(val, int) or not (0 <= val < 65536):
+            raise ConfigError(
+                "durability.standby_port", f"invalid port {val!r}"
+            )
         for key in ("faults.device_error_rate", "faults.socket_drop_rate",
-                    "faults.latency_rate", "faults.shard_error_rate"):
+                    "faults.tail_drop_rate", "faults.latency_rate",
+                    "faults.shard_error_rate"):
             val = self.get(key, 0)
             if not isinstance(val, (int, float)) or not (0 <= val <= 1):
                 raise ConfigError(key, f"must be a rate in [0, 1], got {val!r}")

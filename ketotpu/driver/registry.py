@@ -93,6 +93,13 @@ class Registry:
         self._mapper = None
         self._ro_mapper = None
         self._uuid_mapper = None
+        self._durability_gate = None
+        # warm-standby seams (ketotpu/standby.py): the follower installs
+        # its state snapshot here so /debug/projection and status --debug
+        # show standby rows; the REST /debug/handoff route triggers a
+        # deliberate takeover through handoff_fn (409 when unset)
+        self.standby_state_fn: Optional[Callable[[], dict]] = None
+        self.handoff_fn: Optional[Callable[[str], dict]] = None
         self.network_id = network_id
         self.readiness_checks = dict(readiness_checks or {})
         self.readiness_checks.update(self.options.readiness_checks)
@@ -707,10 +714,43 @@ class Registry:
 
     def projection_stats(self) -> dict:
         """Projection/compaction counters for /debug/projection and
-        `status --debug`; {} for engine kinds without a device snapshot."""
+        `status --debug`; {} for engine kinds without a device snapshot.
+        When this process replicates (owner with a gate engaged, or a
+        warm standby), a ``replication`` / ``standby`` sub-dict rides
+        along so the same surfaces show the follower's lag and state."""
         dev = self._device_engine()
         fn = getattr(dev, "projection_stats", None) if dev is not None else None
-        return fn() if callable(fn) else {}
+        out = fn() if callable(fn) else {}
+        with self._lock:
+            gate = self._durability_gate
+            standby_fn = self.standby_state_fn
+        if gate is not None:
+            out = dict(out, replication=gate.stats())
+        if standby_fn is not None:
+            try:
+                out = dict(out, standby=standby_fn())
+            except Exception:  # noqa: BLE001 - debug surface must not 500
+                pass
+        return out
+
+    def durability_gate(self):
+        """Lazy write-path replication gate (server/workers.py
+        ReplicationGate).  Built on first use — the standby's tail poll
+        acks through it, and semi-sync writes wait on it."""
+        with self._lock:
+            if self._durability_gate is None:
+                from ketotpu.server.workers import ReplicationGate
+
+                self._durability_gate = ReplicationGate(
+                    str(self.config.get("durability.replication", "async")
+                        or "async"),
+                    ack_timeout_ms=float(
+                        self.config.get("durability.ack_timeout_ms", 2000)
+                        or 2000
+                    ),
+                    metrics=self.metrics(),
+                )
+            return self._durability_gate
 
     def oracle_engine(self) -> CheckEngine:
         with self._lock:

@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
-from typing import Dict
+from typing import Dict, Mapping, Optional, Tuple
 
 import numpy as np
 
@@ -56,13 +56,31 @@ class SnapshotFormatError(KetoAPIError):
     status_code = 400
 
 
-def save_snapshot(snap: Snapshot, path: str, extra: Dict[str, int] = None) -> None:
-    """One .npz with every array, the vocab string tables, and scalars.
-    ``extra`` lets callers stamp environment facts (e.g. the namespace
-    config fingerprint) that gate a load's validity."""
+def snapshot_to_arrays(
+    snap: Snapshot,
+    extra: Dict[str, int] = None,
+    cursor: Optional[int] = None,
+    head: Optional[int] = None,
+    store_version: Optional[int] = None,
+) -> Dict[str, np.ndarray]:
+    """The checkpoint as one flat dict of plain-dtype arrays.  This is the
+    single serialized form: ``save_snapshot`` writes it to an .npz and the
+    replication wire op ships it verbatim through ``wire.pack_arrays`` to
+    a warm-standby follower.  ``cursor``/``head``/``store_version`` stamp
+    the changelog position the base snapshot was built at and the store
+    (head, version) observed in the same capture window — they let a load
+    replay the overlay tail a background-compacting engine had NOT folded
+    into the base at save time (additive v5 keys; absent in older files,
+    which were head-exact by construction)."""
     data: Dict[str, np.ndarray] = {
         "format": np.int64(SNAPSHOT_FORMAT),
     }
+    if cursor is not None:
+        data["ckpt_cursor"] = np.int64(cursor)
+    if head is not None:
+        data["ckpt_head"] = np.int64(head)
+    if store_version is not None:
+        data["ckpt_store_version"] = np.int64(store_version)
     for k, v in (extra or {}).items():
         data[f"x_{k}"] = np.int64(v)
     for name in _SCALARS:
@@ -89,6 +107,24 @@ def save_snapshot(snap: Snapshot, path: str, extra: Dict[str, int] = None) -> No
     data["dyn_pairs"] = np.array(
         sorted(snap.dyn_pairs), dtype=np.int64
     ).reshape(-1, 4) if snap.dyn_pairs else np.zeros((0, 4), np.int64)
+    return data
+
+
+def save_snapshot(
+    snap: Snapshot,
+    path: str,
+    extra: Dict[str, int] = None,
+    cursor: Optional[int] = None,
+    head: Optional[int] = None,
+    store_version: Optional[int] = None,
+) -> None:
+    """One .npz with every array, the vocab string tables, and scalars.
+    ``extra`` lets callers stamp environment facts (e.g. the namespace
+    config fingerprint) that gate a load's validity."""
+    data = snapshot_to_arrays(
+        snap, extra=extra, cursor=cursor, head=head,
+        store_version=store_version,
+    )
     # atomic publish: a crash mid-write must not leave a truncated file at
     # the path the next boot will read
     tmp = f"{path}.tmp"
@@ -104,41 +140,46 @@ def _interner_from(strings) -> Interner:
     return it
 
 
-def load_snapshot(path: str, want_extra: Dict[str, int] = None) -> Snapshot:
-    """Load a checkpoint; raises SnapshotFormatError on format mismatch or
-    when a ``want_extra`` stamp differs from what was saved."""
-    with np.load(path) as z:  # no pickle: all arrays are plain dtypes
-        if "format" not in z or int(z["format"]) != SNAPSHOT_FORMAT:
-            got = int(z["format"]) if "format" in z else None
+def snapshot_from_arrays(
+    z: Mapping[str, np.ndarray], want_extra: Dict[str, int] = None
+) -> Snapshot:
+    """Reconstruct a Snapshot from the flat array dict (an open .npz or a
+    dict unpacked off the replication wire); raises SnapshotFormatError on
+    format mismatch or when a ``want_extra`` stamp differs."""
+    files = getattr(z, "files", None)
+    if files is None:
+        files = list(z.keys())
+    if "format" not in files or int(z["format"]) != SNAPSHOT_FORMAT:
+        got = int(z["format"]) if "format" in files else None
+        raise SnapshotFormatError(
+            f"snapshot checkpoint format {got!r} does not match "
+            f"supported format {SNAPSHOT_FORMAT}; rebuild from the store"
+        )
+    for k, want in (want_extra or {}).items():
+        have = int(z[f"x_{k}"]) if f"x_{k}" in files else None
+        if have != int(want):
             raise SnapshotFormatError(
-                f"snapshot checkpoint format {got!r} does not match "
-                f"supported format {SNAPSHOT_FORMAT}; rebuild from the store"
+                f"snapshot checkpoint stamp {k}={have!r} does not match "
+                f"the current environment ({int(want)}); rebuild"
             )
-        for k, want in (want_extra or {}).items():
-            have = int(z[f"x_{k}"]) if f"x_{k}" in z else None
-            if have != int(want):
-                raise SnapshotFormatError(
-                    f"snapshot checkpoint stamp {k}={have!r} does not match "
-                    f"the current environment ({int(want)}); rebuild"
-                )
-        vocab = Vocab()
-        for name in _VOCABS:
-            setattr(vocab, name, _interner_from(z[f"v_{name}"]))
-        op = OpTable(**{
-            f.name: z[f"op_{f.name}"] for f in dataclasses.fields(OpTable)
-        })
-        flat = FlatTables(**{
-            f.name: z[f"fl_{f.name}"] for f in dataclasses.fields(FlatTables)
-        })
-        kw = {name: z[name] for name in _ARRAYS}
-        scalars = {name: int(z[f"s_{name}"]) for name in _SCALARS}
-        node_tab = {
-            k[3:]: z[k] for k in z.files if k.startswith("nt_")
-        }
-        mem_tab = {
-            k[3:]: z[k] for k in z.files if k.startswith("mt_")
-        }
-        dyn_pairs = {tuple(int(x) for x in row) for row in z["dyn_pairs"]}
+    vocab = Vocab()
+    for name in _VOCABS:
+        setattr(vocab, name, _interner_from(z[f"v_{name}"]))
+    op = OpTable(**{
+        f.name: z[f"op_{f.name}"] for f in dataclasses.fields(OpTable)
+    })
+    flat = FlatTables(**{
+        f.name: z[f"fl_{f.name}"] for f in dataclasses.fields(FlatTables)
+    })
+    kw = {name: z[name] for name in _ARRAYS}
+    scalars = {name: int(z[f"s_{name}"]) for name in _SCALARS}
+    node_tab = {
+        k[3:]: z[k] for k in files if k.startswith("nt_")
+    }
+    mem_tab = {
+        k[3:]: z[k] for k in files if k.startswith("mt_")
+    }
+    dyn_pairs = {tuple(int(x) for x in row) for row in z["dyn_pairs"]}
     snap = Snapshot(
         vocab=vocab, op=op, flat=flat,
         node_tab=node_tab, mem_tab=mem_tab,
@@ -146,3 +187,39 @@ def load_snapshot(path: str, want_extra: Dict[str, int] = None) -> Snapshot:
     )
     snap.dyn_pairs = dyn_pairs
     return snap
+
+
+def arrays_cursor(
+    z: Mapping[str, np.ndarray]
+) -> Tuple[Optional[int], Optional[int], Optional[int]]:
+    """(cursor, head, store_version) stamps of a serialized checkpoint, or
+    Nones when the file predates them (pre-cursor checkpoints are
+    head-exact by construction: saves forced a refresh first)."""
+    files = getattr(z, "files", None)
+    if files is None:
+        files = list(z.keys())
+
+    def stamp(key):
+        return int(z[key]) if key in files else None
+
+    return (
+        stamp("ckpt_cursor"), stamp("ckpt_head"),
+        stamp("ckpt_store_version"),
+    )
+
+
+def load_snapshot(path: str, want_extra: Dict[str, int] = None) -> Snapshot:
+    """Load a checkpoint; raises SnapshotFormatError on format mismatch or
+    when a ``want_extra`` stamp differs from what was saved."""
+    with np.load(path) as z:  # no pickle: all arrays are plain dtypes
+        return snapshot_from_arrays(z, want_extra)
+
+
+def load_snapshot_with_cursor(
+    path: str, want_extra: Dict[str, int] = None
+) -> Tuple[Snapshot, Optional[int], Optional[int], Optional[int]]:
+    """Like load_snapshot, plus the (cursor, head, store_version) stamps."""
+    with np.load(path) as z:
+        snap = snapshot_from_arrays(z, want_extra)
+        cursor, head, store_version = arrays_cursor(z)
+    return snap, cursor, head, store_version

@@ -94,6 +94,46 @@ class TupleColumns:
         self._rows_by_key = None
         return self
 
+    @classmethod
+    def from_tuples(cls, vocab: Vocab, tuples) -> "TupleColumns":
+        """Bulk adoption of a plain tuple list (a store rescan, a
+        replica's adopted scan): capacity is sized once up front instead
+        of paying log2(n) grow-copies of all 8 columns, and the row-key
+        index stays lazy like :meth:`from_arrays` — the first delete
+        pays for the dict, a bootstrap doesn't."""
+        self = cls(vocab)
+        n = len(tuples)
+        cap = self.cap
+        while cap < max(n, 1):
+            cap *= 2
+        if cap != self.cap:
+            self.cap = cap
+            for c in cls.COLS:
+                setattr(self, c, np.full(cap, -1, np.int32))
+            self.alive = np.zeros(cap, bool)
+        self._rows_by_key = None
+        v = vocab
+        ns_c, obj_c, rel_c, subj_c = self.ns, self.obj, self.rel, self.subj
+        is_set_c = self.is_set
+        sns_c, sobj_c, srel_c = self.s_ns, self.s_obj, self.s_rel
+        for i, t in enumerate(tuples):
+            v.intern_tuple(t)
+            ns_c[i] = v.namespaces.lookup(t.namespace)
+            obj_c[i] = v.objects.lookup(t.object)
+            rel_c[i] = v.relations.lookup(t.relation)
+            subj_c[i] = v.subjects.lookup(t.subject.unique_id())
+            if isinstance(t.subject, SubjectSet):
+                is_set_c[i] = 1
+                sns_c[i] = v.namespaces.lookup(t.subject.namespace)
+                sobj_c[i] = v.objects.lookup(t.subject.object)
+                srel_c[i] = v.relations.lookup(t.subject.relation)
+            else:
+                is_set_c[i] = 0
+        self.alive[:n] = True
+        self.n = n
+        self.alive_count = n
+        return self
+
     def masked(self, keep_rows: np.ndarray) -> "TupleColumns":
         """Shallow view with ``alive`` further restricted to ``keep_rows``
         (bool[n]) — shard partitioning without copying the columns."""

@@ -403,9 +403,7 @@ class DeviceCheckEngine:
             self._log_cursor = head
             return
         tuples, head = self.store.tuples_and_head()
-        self._cols = dl.TupleColumns(self._vocab)
-        for t in tuples:
-            self._cols.apply(1, t)
+        self._cols = dl.TupleColumns.from_tuples(self._vocab, tuples)
         self._log_cursor = head
 
     def _rebuild(self, fingerprint: int) -> None:
@@ -947,22 +945,41 @@ class DeviceCheckEngine:
 
     def save_checkpoint(self, path: str) -> None:
         """Persist the current projection; restart skips re-projection when
-        the store version and namespace config still match.  An active
-        delta overlay is folded in by a full rebuild first — the overlay is
-        not serialized, so saving the stale base would persist a projection
-        whose version never matches the store."""
+        the store version and namespace config still match.
+
+        Two capture modes, both one ``_sync_lock`` window:
+
+        * sync compaction (default): an active delta overlay is folded in
+          by a full rebuild first — the overlay is not serialized, so
+          saving the stale base would persist a projection whose version
+          never matches the store;
+        * background compaction: a refresh here would tear down the
+          in-flight compactor generation and re-arm the compile
+          observatory mid-serve, so the checkpoint instead captures the
+          base snapshot AND the changelog cursor it was built at (the
+          compaction race fix: cols + cursor from the same lock window).
+          A load replays the persisted-cursor tail through the normal
+          drain, restoring the exact served state."""
         from ketotpu.engine import checkpoint as ckpt
 
         with self._sync_lock:
             snap = self._snapshot_locked()
-            if self._overlay_active or self._pending:
+            if (
+                not self.compaction_background
+                and (self._overlay_active or self._pending)
+            ):
                 self.refresh()
                 snap = self._snap
+            cursor = self._snap_cursor
+            ver, store_head = self.store.version_and_head() if hasattr(
+                self.store, "version_and_head"
+            ) else (self.store.version, self.store.log_head)
             # stamp the fingerprint the snapshot was BUILT under, not a
             # fresh read: a file-backed config reloading between build and
             # save must not mis-stamp a stale projection as current
             ckpt.save_snapshot(
-                snap, path, extra={"fingerprint": self._snap_fingerprint}
+                snap, path, extra={"fingerprint": self._snap_fingerprint},
+                cursor=cursor, head=store_head, store_version=ver,
             )
 
     def load_checkpoint(self, path: str) -> bool:
@@ -975,8 +992,10 @@ class DeviceCheckEngine:
 
         fingerprint = config_fingerprint(self.namespace_manager)
         try:
-            snap = ckpt.load_snapshot(
-                path, want_extra={"fingerprint": fingerprint}
+            snap, cursor, saved_head, saved_ver = (
+                ckpt.load_snapshot_with_cursor(
+                    path, want_extra={"fingerprint": fingerprint}
+                )
             )
         except Exception:  # noqa: BLE001 - refusal is the contract
             return False
@@ -985,15 +1004,37 @@ class DeviceCheckEngine:
             # between the two reads then fails the version check (reading in
             # the other order would skip that write's log entry forever)
             log_head = self.store.log_head
-            if snap.version != self.store.version:
+            # the gate version is the STORE version at save time: under
+            # background compaction the base snapshot's own version lags
+            # the store (the un-folded tail is replayed below), so the
+            # snapshot version only gates legacy stamp-less files
+            ver_gate = saved_ver if saved_ver is not None else snap.version
+            if ver_gate != self.store.version:
                 return False  # store moved since the save: stale projection
+            if cursor is None or saved_head is None or cursor == saved_head:
+                # head-exact save (pre-cursor file, or no overlay at save
+                # time): the base covers everything at this version, adopt
+                # at the LOCAL head — a rebooted store restarts its log
+                # coordinates at 0 and the old cursor means nothing there
+                cursor = log_head
+            elif cursor > log_head or log_head < saved_head:
+                # a base-at-cursor save needs the tail [cursor, saved_head)
+                # replayed from the local log.  A local head short of the
+                # saved one means a different coordinate space (fresh-boot
+                # log reset: matching version + a shorter log is only
+                # reachable by reboot, since entries only land with version
+                # bumps) — the tail is gone, refuse rather than serve a
+                # base missing acknowledged writes.
+                return False
+            elif self.store.changes_since(cursor)[0] is None:
+                return False  # tail evicted from the bounded log
             self._snap = snap
             self._snap_fingerprint = fingerprint
             self._vocab = snap.vocab
             self._cols = None  # lazily re-mirrored on the next full rebuild
-            self._log_cursor = log_head
-            self._served_cursor = log_head
-            self._snap_cursor = log_head
+            self._log_cursor = cursor
+            self._served_cursor = cursor
+            self._snap_cursor = cursor
             self._since_base = []
             self._pending = []
             self._gen_token += 1
@@ -1006,6 +1047,65 @@ class DeviceCheckEngine:
             self._leo_device = None
             self._install_device_arrays()
             return True
+
+    # -- replication (warm-standby follower, server/workers.py wire ops) ----
+
+    def replication_snapshot(self):
+        """Bootstrap payload for a warm-standby follower, captured so no
+        concurrent write can fall between the pieces: the served base
+        snapshot + the cursor it was built at (one ``_sync_lock`` window —
+        a background compactor swap cannot tear them apart), then an
+        atomic replica scan of the store, then the changelog tail
+        ``[cursor, head)`` sliced to the scan's head.  Returns
+        ``(snap, cursor, fingerprint, rows, tail, head, version)``."""
+        with self._sync_lock:
+            snap = self._snapshot_locked()
+            cursor = self._snap_cursor
+            fingerprint = self._snap_fingerprint
+            rows, head, version = self.store.replica_scan()
+            tail, _ = self.store.changes_since(cursor)
+            if tail is None:
+                # the base predates the bounded log (long-lived overlay):
+                # rebuild once so (base, tail) is a consistent pair
+                self._rebuild(config_fingerprint(self.namespace_manager))
+                snap = self._snap
+                cursor = self._snap_cursor
+                fingerprint = self._snap_fingerprint
+                rows, head, version = self.store.replica_scan()
+                tail, _ = self.store.changes_since(cursor)
+                tail = tail if tail is not None else []
+            # changes_since may already see writes past the replica scan;
+            # the follower's replica is anchored at `head`, so ship exactly
+            # the tail the scan covers
+            tail = tail[: max(0, head - cursor)]
+        return snap, cursor, fingerprint, rows, tail, head, version
+
+    def adopt_snapshot(self, snap, *, cursor: int, fingerprint=None) -> None:
+        """Install a snapshot shipped from a live owner (standby bootstrap).
+        Unlike ``load_checkpoint`` there is no version gate: the caller has
+        already anchored the local replica store at the owner's changelog
+        coordinates, so the normal drain replays everything past
+        ``cursor``."""
+        with self._sync_lock:
+            self._snap = snap
+            self._snap_fingerprint = (
+                fingerprint if fingerprint is not None
+                else config_fingerprint(self.namespace_manager)
+            )
+            self._vocab = snap.vocab
+            self._cols = None
+            self._log_cursor = cursor
+            self._served_cursor = cursor
+            self._snap_cursor = cursor
+            self._since_base = []
+            self._pending = []
+            self._gen_token += 1
+            self.generation += 1
+            self._overlay = dl.OverlayState()
+            self._overlay_active = False
+            self._leopard = None
+            self._leo_device = None
+            self._install_device_arrays()
 
     # -- query encoding -----------------------------------------------------
 

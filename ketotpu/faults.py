@@ -13,7 +13,10 @@ Sites wired into the stack:
 * ``owner_handler``   — latency spike in the owner's unix-socket handler,
   exercising worker-side deadlines;
 * ``socket_drop``     — (via ``should``) worker-side drop of a pooled
-  owner connection mid-call, exercising discard + backoff reconnect.
+  owner connection mid-call, exercising discard + backoff reconnect;
+* ``tail_drop``       — (via ``should``) owner-side failure of a standby's
+  replication tail poll, exercising the follower's heartbeat-miss counter
+  and (past the miss budget) its takeover path.
 
 Knobs (env var / ``configure`` kwarg):
 
@@ -23,6 +26,8 @@ Knobs (env var / ``configure`` kwarg):
   to every device dispatch (wedged-engine simulation);
 * ``KETO_FAULT_SOCKET_DROP_RATE`` / ``socket_drop_rate`` — probability a
   worker→owner call drops its connection before sending;
+* ``KETO_FAULT_TAIL_DROP_RATE`` / ``tail_drop_rate`` — probability the
+  owner fails a standby replication tail poll;
 * ``KETO_FAULT_LATENCY_MS`` + ``KETO_FAULT_LATENCY_RATE`` /
   ``latency_ms``, ``latency_rate`` — latency spike (rate defaults to 1.0
   when a spike is configured);
@@ -53,6 +58,7 @@ class FaultPlan:
         device_error_rate: float = 0.0,
         device_stall_ms: float = 0.0,
         socket_drop_rate: float = 0.0,
+        tail_drop_rate: float = 0.0,
         latency_ms: float = 0.0,
         latency_rate: Optional[float] = None,
         shard_error_rate: float = 0.0,
@@ -62,6 +68,7 @@ class FaultPlan:
         self.device_error_rate = float(device_error_rate)
         self.device_stall_ms = float(device_stall_ms)
         self.socket_drop_rate = float(socket_drop_rate)
+        self.tail_drop_rate = float(tail_drop_rate)
         self.shard_error_rate = float(shard_error_rate)
         self.shard_id = int(shard_id)
         self.latency_ms = float(latency_ms)
@@ -81,6 +88,7 @@ class FaultPlan:
             self.device_error_rate
             or self.device_stall_ms
             or self.socket_drop_rate
+            or self.tail_drop_rate
             or self.shard_error_rate
             or (self.latency_ms and self.latency_rate)
         )
@@ -115,6 +123,7 @@ class FaultPlan:
             device_error_rate=f("KETO_FAULT_DEVICE_ERROR_RATE"),
             device_stall_ms=f("KETO_FAULT_DEVICE_STALL_MS"),
             socket_drop_rate=f("KETO_FAULT_SOCKET_DROP_RATE"),
+            tail_drop_rate=f("KETO_FAULT_TAIL_DROP_RATE"),
             latency_ms=f("KETO_FAULT_LATENCY_MS"),
             latency_rate=float(rate_raw) if rate_raw else None,
             shard_error_rate=f("KETO_FAULT_SHARD_ERROR_RATE"),
@@ -160,6 +169,7 @@ def configure_from_config(cfg) -> None:
         device_error_rate=block.get("device_error_rate", 0.0),
         device_stall_ms=block.get("device_stall_ms", 0.0),
         socket_drop_rate=block.get("socket_drop_rate", 0.0),
+        tail_drop_rate=block.get("tail_drop_rate", 0.0),
         latency_ms=block.get("latency_ms", 0.0),
         latency_rate=block.get("latency_rate") or None,
         shard_error_rate=block.get("shard_error_rate", 0.0),
@@ -192,12 +202,15 @@ def inject(site: str) -> None:
 
 
 def should(kind: str) -> bool:
-    """Roll for a boolean fault (currently only ``socket_drop``)."""
+    """Roll for a boolean fault (``socket_drop`` / ``tail_drop``)."""
     p = _plan
     if not p.active:
         return False
     if kind == "socket_drop" and p._roll(p.socket_drop_rate):
         p._count("socket_drop")
+        return True
+    if kind == "tail_drop" and p._roll(p.tail_drop_rate):
+        p._count("tail_drop")
         return True
     return False
 

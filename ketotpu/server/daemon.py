@@ -233,6 +233,7 @@ class Server:
         self._muxes: List[_Mux] = []
         self._threads: List[threading.Thread] = []
         self.addresses: Dict[str, Tuple[str, int]] = {}
+        self._engine_host = None
         self._stopped = threading.Event()
         # anonymized usage telemetry (daemon.go:64-98 seam): inert unless
         # sqa.server_url is configured AND the operator did not opt out.
@@ -378,6 +379,23 @@ class Server:
         self._threads.append(t)
         self.addresses["metrics"] = httpd.server_address[:2]
         self.logger.info("serving metrics on %s:%d", *self.addresses["metrics"])
+
+        # replication channel: a single-process daemon that owns the device
+        # engine publishes the engine-host socket when durability.socket is
+        # configured, so a warm standby can bootstrap + tail it (the same
+        # wire --workers mode uses; in that mode the owner process, not
+        # this daemon, hosts the socket)
+        repl_sock = str(r.config.get("durability.socket") or "")
+        if repl_sock and not self.reuse_port \
+                and r._device_engine() is not None:
+            from ketotpu.server.workers import EngineHostServer
+
+            self._engine_host = EngineHostServer(
+                r, repl_sock, health_fn=r.health,
+            ).start()
+            self.logger.info(
+                "serving engine host (replication wire) on %s", repl_sock
+            )
         return self
 
     # -- lifecycle ----------------------------------------------------------
@@ -388,6 +406,12 @@ class Server:
     def stop(self, grace: float = 5.0) -> None:
         if self.sqa is not None:
             self.sqa.close()
+        if self._engine_host is not None:
+            try:
+                self._engine_host.stop()
+            except Exception:  # noqa: BLE001 - shutdown must not raise
+                pass
+            self._engine_host = None
         for mux in self._muxes:
             mux.close()
         # retire the coalescer BEFORE the gRPC backends drain: its wave

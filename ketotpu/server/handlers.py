@@ -835,6 +835,27 @@ class ExpandHandler:
             _abort(context, e)
 
 
+def _wait_replicated(r) -> None:
+    """Semi-sync durability (durability.replication): after the store
+    commit, hold this write's ack until the warm standby's tail cursor
+    covers the committed changelog head.  Async mode costs one config
+    read; a timed-out wait degrades to async for this write (counted by
+    the gate) rather than failing a committed transaction."""
+    if str(r.config.get("durability.replication", "async")) != "semi-sync":
+        return
+    gate_fn = getattr(r, "durability_gate", None)
+    if gate_fn is None:
+        return  # derived/remote registries without the gate seam
+    head = getattr(r.store(), "log_head", None)
+    t0 = time.perf_counter()
+    replicated = gate_fn().wait_replicated(head)
+    r.metrics().observe(
+        "keto_replication_wait_seconds", time.perf_counter() - t0,
+        help="write-path wait for the standby replication ack (semi-sync)",
+        replicated=str(bool(replicated)).lower(),
+    )
+
+
 class RelationTupleHandler:
     """`internal/relationtuple/{read_server,transact_server}.go` — tuple
     CRUD over ReadService + WriteService and the REST admin routes."""
@@ -861,6 +882,7 @@ class RelationTupleHandler:
             if inserts or deletes:
                 r.mapper().from_tuple(*inserts, *deletes)  # validate + ns
             r.store().transact_relation_tuples(inserts, deletes)
+        _wait_replicated(r)
         r.tracer().event(RELATIONTUPLES_CHANGED)
         r.metrics().counter(
             "keto_relationtuples_writes_total", 1, help="tuple transactions"
@@ -872,6 +894,8 @@ class RelationTupleHandler:
             if query is not None and query.namespace is not None:
                 r.read_only_mapper().from_query(query)
             n = r.store().delete_all_relation_tuples(query)
+        if n:
+            _wait_replicated(r)
         r.tracer().event(RELATIONTUPLES_DELETED)
         return n
 

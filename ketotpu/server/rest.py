@@ -69,7 +69,7 @@ _ADMISSION_EXEMPT = {
     # surfaces matter most
     "/debug/flight-recorder", "/debug/waves", "/debug/compiles",
     "/debug/profile", "/debug/projection", "/debug/mesh",
-    "/debug", "/debug/trace", "/debug/divergence",
+    "/debug", "/debug/trace", "/debug/divergence", "/debug/handoff",
 }
 
 # REST paths that get the full stage decomposition (flightrec context);
@@ -822,6 +822,23 @@ def metrics_router(registry) -> Router:
 
     rt.add("POST", "/debug/profile", post_profile)
 
+    def post_handoff(req):
+        # deliberate takeover (rolling restart): tells the warm-standby
+        # follower attached to this registry to promote itself NOW instead
+        # of waiting out the heartbeat-miss budget.  409 when no standby
+        # machinery is wired (a plain owner/daemon process).
+        fn = getattr(registry, "handoff_fn", None)
+        if fn is None:
+            return 409, {"error": {
+                "code": 409,
+                "message": "no standby attached to this process; handoff"
+                           " is served by the follower's metrics port",
+            }}
+        reason = str(req.query.get("reason", "handoff") or "handoff")
+        return 200, dict(fn(reason) or {}, reason=reason)
+
+    rt.add("POST", "/debug/handoff", post_handoff)
+
     def get_debug_index(req):
         # one stop for "what can I look at?": every debug surface on this
         # port with a one-liner, so an operator paging through an incident
@@ -844,6 +861,9 @@ def metrics_router(registry) -> Router:
                 "sharded serving: per-shard state + replica map",
             "/debug/profile":
                 "POST: on-demand jax.profiler capture (config-gated)",
+            "/debug/handoff":
+                "POST: promote the attached warm standby now (rolling "
+                "restart; 409 when none)",
         }}
 
     rt.add("GET", "/debug", get_debug_index)

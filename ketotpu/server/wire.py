@@ -106,6 +106,61 @@ def unpack_strcol(arrays: Dict[str, np.ndarray], name: str) -> list:
     ]
 
 
+def pack_tuplecols(
+    arrays: Dict[str, np.ndarray], prefix: str, rows
+) -> None:
+    """Pack relation tuples as four string columns (``<prefix>_ns/obj/rel``
+    plus the subject in its canonical string form) — the replication
+    bootstrap/tail carrier.  A 10M-row bootstrap crosses the wire as eight
+    contiguous buffers (and takes the shared-memory hop past the
+    threshold) instead of 10M JSON strings."""
+    pack_strcol(arrays, f"{prefix}_ns", [t.namespace for t in rows])
+    pack_strcol(arrays, f"{prefix}_obj", [t.object for t in rows])
+    pack_strcol(arrays, f"{prefix}_rel", [t.relation for t in rows])
+    pack_strcol(arrays, f"{prefix}_subj", [str(t.subject) for t in rows])
+
+
+def unpack_tuplecols(arrays: Dict[str, np.ndarray], prefix: str) -> list:
+    """Inverse of :func:`pack_tuplecols`: a list of RelationTuple."""
+    from ketotpu.api.types import RelationTuple, subject_from_string
+
+    ns = unpack_strcol(arrays, f"{prefix}_ns")
+    obj = unpack_strcol(arrays, f"{prefix}_obj")
+    rel = unpack_strcol(arrays, f"{prefix}_rel")
+    subj = unpack_strcol(arrays, f"{prefix}_subj")
+    if not (len(ns) == len(obj) == len(rel) == len(subj)):
+        raise WireError(f"tuple columns {prefix!r} have mismatched lengths")
+    return [
+        RelationTuple(
+            namespace=n, object=o, relation=r,
+            subject=subject_from_string(s),
+        )
+        for n, o, r, s in zip(ns, obj, rel, subj)
+    ]
+
+
+def pack_changes(
+    arrays: Dict[str, np.ndarray], prefix: str, entries
+) -> None:
+    """Pack changelog entries ``[(op, tuple)]`` (op = +1 insert / -1
+    delete) as the tuple columns plus an int8 op column."""
+    arrays[f"{prefix}_op"] = np.array(
+        [op for op, _ in entries], dtype=np.int8
+    )
+    pack_tuplecols(arrays, prefix, [t for _, t in entries])
+
+
+def unpack_changes(arrays: Dict[str, np.ndarray], prefix: str) -> list:
+    """Inverse of :func:`pack_changes`."""
+    ops = arrays.get(f"{prefix}_op")
+    if ops is None or ops.ndim != 1:
+        raise WireError(f"change column {prefix!r}_op missing or misshapen")
+    tuples = unpack_tuplecols(arrays, prefix)
+    if len(ops) != len(tuples):
+        raise WireError(f"change columns {prefix!r} have mismatched lengths")
+    return [(int(op), t) for op, t in zip(ops, tuples)]
+
+
 class ShmRing:
     """Sender-owned shared-memory segment for large frame payloads,
     reused (and grown) across calls; unlinked on close."""

@@ -125,6 +125,11 @@ class EngineHostServer:
         self._vocab_obj = None
         self._vepoch = 0
         self._rev: Optional[dict] = None
+        # live accepted connections: stop() severs them so an attached
+        # standby observes the owner's death exactly as a kill -9 would
+        # (shutdown() alone only stops the accept loop)
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
         if os.path.exists(path):
             os.unlink(path)
 
@@ -132,6 +137,8 @@ class EngineHostServer:
 
         class Handler(socketserver.StreamRequestHandler):
             def handle(self):
+                with host._conns_lock:
+                    host._conns.add(self.connection)
                 ring = wire.ShmRing()
                 shm_cache = wire.ShmCache()
                 try:
@@ -166,6 +173,8 @@ class EngineHostServer:
                             break
                         host._wire_count("tx", sent)
                 finally:
+                    with host._conns_lock:
+                        host._conns.discard(self.connection)
                     ring.close()
                     shm_cache.close()
 
@@ -487,6 +496,58 @@ class EngineHostServer:
                 )
                 flightrec.note_stage("barrier", time.perf_counter() - t0)
                 return {"ok": True}, None
+        if op == "repl_bootstrap":
+            # warm-standby bootstrap: one frame carries the owner's device
+            # projection (the checkpoint codec's flat array dict — no
+            # re-projection on the standby), the full store scan, and the
+            # changelog tail [cursor, head) so the standby's engine drains
+            # forward from the snapshot's cursor exactly as the owner would
+            from ketotpu.engine import checkpoint as ckpt
+
+            with flightrec.rpc_recording(
+                r, "repl_bootstrap", traceparent=tp,
+                detail="standby->owner bootstrap",
+            ):
+                eng = r._device_engine()
+                (snap, cursor, fingerprint, rows, tail, head,
+                 version) = eng.replication_snapshot()
+                resp_arrays = ckpt.snapshot_to_arrays(
+                    snap, extra={"fingerprint": fingerprint},
+                    cursor=cursor, head=head, store_version=version,
+                )
+                wire.pack_tuplecols(resp_arrays, "st", rows)
+                wire.pack_changes(resp_arrays, "tl", tail)
+                return {
+                    "cursor": int(cursor), "head": int(head),
+                    "version": int(version),
+                    "fingerprint": int(fingerprint),
+                    "n_tuples": len(rows),
+                }, resp_arrays
+        if op == "repl_tail":
+            # standby tail poll, doubling as the replication ack: the cursor
+            # the standby sends IS its durable head, so acking it here is
+            # what releases semi-sync writers waiting in wait_replicated.
+            # resync=True mirrors the Watch API's overflow contract — the
+            # cursor predates the bounded log and the standby must
+            # re-bootstrap from a fresh snapshot.
+            if faults.should("tail_drop"):
+                raise OSError("fault-injected tail drop")
+            cursor = int(meta["cursor"])
+            st = r.store()
+            if hasattr(st, "changes_since_versioned"):
+                entries, head, version = st.changes_since_versioned(cursor)
+            else:
+                entries, head = st.changes_since(cursor)
+                version = st.version
+            gate = r.durability_gate()
+            if gate is not None:
+                gate.ack(cursor)
+            resp_arrays = {}
+            wire.pack_changes(resp_arrays, "tl", entries or [])
+            return {
+                "head": int(head), "version": int(version),
+                "resync": entries is None,
+            }, resp_arrays
         if op == "ping":
             return {"pong": True}, None
         if op == "health":
@@ -500,10 +561,113 @@ class EngineHostServer:
     def stop(self) -> None:
         self._srv.shutdown()
         self._srv.server_close()
+        with self._conns_lock:
+            conns = list(self._conns)
+            self._conns.clear()
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
         try:
             os.unlink(self.path)
         except OSError:
             pass
+
+
+class ReplicationGate:
+    """Write-path coupling to the warm-standby follower.
+
+    ``durability.replication`` picks the mode:
+
+    * ``async`` (default) — writes ack as soon as the store commits; the
+      standby tails on its own schedule and a takeover may lose the last
+      unreplicated entries (bounded by the poll interval);
+    * ``semi-sync`` — a write's ack waits until the standby's tail cursor
+      covers the committed head.  The standby's ``repl_tail`` poll carries
+      its durable head as the cursor, and the owner's handler calls
+      ``ack`` with it — that IS the replication acknowledgement.
+
+    The gate only engages once a follower has ATTACHED (first tail poll
+    seen): a semi-sync owner with no standby yet — boot order, standby
+    restart — must not stall every write forever.  A wait that exceeds
+    ``durability.ack_timeout_ms`` degrades that one write to async and
+    counts it (``keto_replication_ack_timeouts_total``): availability
+    over the durability upgrade, loudly.
+    """
+
+    def __init__(self, mode: str = "async", *,
+                 ack_timeout_ms: float = 2000.0, metrics=None):
+        self.mode = str(mode)
+        self.ack_timeout = float(ack_timeout_ms) / 1000.0
+        self._metrics = metrics
+        self._cond = threading.Condition()
+        self._acked = -1
+        self._attached = False
+        self.timeouts = 0
+        self.waits = 0
+
+    def ack(self, cursor: int) -> None:
+        """Record the follower's durable head (its tail-poll cursor)."""
+        with self._cond:
+            self._attached = True
+            if cursor > self._acked:
+                self._acked = cursor
+            self._cond.notify_all()
+
+    def detach(self) -> None:
+        """Forget the follower (owner noticed it gone); semi-sync writes
+        stop waiting until a follower polls again."""
+        with self._cond:
+            self._attached = False
+            self._cond.notify_all()
+
+    def wait_replicated(self, head: Optional[int]) -> bool:
+        """Block a committed write until the follower has acked ``head``.
+        True = replicated (or gate not engaged); False = timed out and
+        degraded to async for this write."""
+        if self.mode != "semi-sync" or head is None:
+            return True
+        t0 = time.monotonic()
+        deadline_at = t0 + self.ack_timeout
+        with self._cond:
+            if not self._attached:
+                return True
+            self.waits += 1
+            while self._attached and self._acked < head:
+                left = deadline_at - time.monotonic()
+                if left <= 0:
+                    self.timeouts += 1
+                    if self._metrics is not None:
+                        self._metrics.counter(
+                            "keto_replication_ack_timeouts_total", 1,
+                            help="semi-sync write acks degraded to async "
+                                 "after waiting ack_timeout_ms",
+                        )
+                    return False
+                self._cond.wait(timeout=left)
+        if self._metrics is not None:
+            self._metrics.observe(
+                "keto_replication_wait_seconds",
+                time.monotonic() - t0,
+                help="time a semi-sync write ack waited for the standby's "
+                     "tail cursor to cover it",
+            )
+        return True
+
+    def stats(self) -> dict:
+        with self._cond:
+            return {
+                "mode": self.mode,
+                "attached": self._attached,
+                "acked_cursor": self._acked,
+                "semi_sync_waits": self.waits,
+                "ack_timeouts": self.timeouts,
+            }
 
 
 class _Conn:

@@ -1,0 +1,318 @@
+"""Warm-standby follower: replicated changelog, snaptoken-exact takeover.
+
+``keto-tpu serve --standby`` runs this process next to a live owner.  It
+bootstraps over the owner's engine-host socket (``durability.socket``)
+with ONE framed wire op — the checkpoint codec's flat array dict (the
+projected CSR snapshot, so the standby never re-projects), the full
+store scan, and the changelog tail — then anchors its local replica
+store at the OWNER'S changelog coordinates (``adopt_replica``).  From
+there it stays warm:
+
+* the shipped snapshot is installed on the local device
+  (``adopt_snapshot``) and the jit programs are pre-compiled against the
+  owner's shapes by probe checks, so the first post-takeover verdict
+  costs a dispatch, not a cold projection build or an XLA compile;
+* a tail loop polls ``repl_tail`` every ``durability.poll_ms``, applying
+  the owner's changelog entries position-exactly (``apply_replicated``)
+  and draining them into the device overlay — the poll's cursor IS the
+  standby's durable head, which the owner's :class:`ReplicationGate`
+  treats as the semi-sync replication ack;
+* a tail cursor that fell off the owner's bounded log comes back as
+  ``resync`` (the Watch API's overflow contract) and the standby
+  re-bootstraps from a fresh snapshot instead of serving a gap.
+
+Takeover is snaptoken-exact: because the replica lives at the owner's
+(version, cursor) coordinates, every token the old owner ever minted is
+satisfiable here and at-least-as-fresh reads never regress.  Promotion
+fires on ``durability.heartbeat_misses`` consecutive failed polls (owner
+death) or a deliberate ``POST /debug/handoff`` on the standby's metrics
+port (rolling restart); the caller then binds the SO_REUSEPORT front
+door via ``daemon.serve_all(reg, reuse_port=True)``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from ketotpu import compilewatch
+from ketotpu.engine import checkpoint as ckpt
+from ketotpu.server import wire
+
+#: numeric encoding of the follower state for the keto_standby_state gauge
+STATES = {
+    "bootstrapping": 0,
+    "tailing": 1,
+    "resyncing": 2,
+    "promoting": 3,
+    "serving": 4,
+}
+
+#: probe rounds the warm-up loop may spend chasing compile quiescence
+_WARM_MAX_ROUNDS = 16
+#: consecutive compile-free probe dispatches before declaring warm
+_WARM_CLEAN_TARGET = 2
+
+
+class StandbyError(RuntimeError):
+    """The follower cannot proceed (misconfiguration, dead owner at
+    bootstrap); the CLI surfaces it and exits non-zero."""
+
+
+class StandbyFollower:
+    """The follower state machine: bootstrap → tail → (resync) → promote."""
+
+    def __init__(
+        self,
+        registry,
+        socket_path: str,
+        *,
+        poll_s: Optional[float] = None,
+        heartbeat_s: Optional[float] = None,
+        heartbeat_misses: Optional[int] = None,
+    ):
+        cfg = registry.config
+        self.registry = registry
+        self.path = socket_path
+        self.poll_s = poll_s if poll_s is not None else float(
+            cfg.get("durability.poll_ms", 50) or 50
+        ) / 1000.0
+        self.heartbeat_s = heartbeat_s if heartbeat_s is not None else float(
+            cfg.get("durability.heartbeat_ms", 500) or 500
+        ) / 1000.0
+        self.miss_budget = int(
+            heartbeat_misses if heartbeat_misses is not None
+            else cfg.get("durability.heartbeat_misses", 3) or 3
+        )
+        self._conn = None
+        self._lock = threading.Lock()
+        self.state = "bootstrapping"
+        self.misses = 0
+        self.resyncs = 0
+        self.bootstraps = 0
+        self.applied_entries = 0
+        self.owner_head = -1
+        self.owner_version = -1
+        self.warm_probe_rounds = 0
+        self._last_ok = time.monotonic()
+        self._promote_evt = threading.Event()
+        self._promote_reason: Optional[str] = None
+        # surface this follower on the registry's debug plane: standby
+        # rows in /debug/projection + status --debug, and POST
+        # /debug/handoff on the standby's own metrics port
+        registry.standby_state_fn = self.state_snapshot
+        registry.handoff_fn = self.request_promote
+
+    # -- wire ----------------------------------------------------------------
+
+    def _call(self, meta, timeout: Optional[float]):
+        from ketotpu.server.workers import _Conn
+
+        if self._conn is None or self._conn.broken:
+            self._conn = _Conn(
+                self.path,
+                metrics=self.registry.metrics(),
+                shm_threshold=int(
+                    self.registry.config.get(
+                        "engine.wire_shm_threshold", 262144
+                    ) or 262144
+                ),
+            )
+        return self._conn.call(meta, timeout=timeout)
+
+    def close(self) -> None:
+        if self._conn is not None:
+            self._conn.close()
+            self._conn = None
+
+    # -- bootstrap -----------------------------------------------------------
+
+    def bootstrap(self, *, timeout: float = 300.0) -> None:
+        """Stream the owner's snapshot + scan + tail and install all three
+        at the owner's coordinates; then drain and pre-compile."""
+        from ketotpu.engine.tpu import config_fingerprint
+
+        self._set_state("bootstrapping")
+        resp, arrays = self._call({"op": "repl_bootstrap"}, timeout)
+        eng = self.registry._device_engine()
+        if eng is None:
+            raise StandbyError(
+                "standby needs a device engine (engine.kind=tpu)"
+            )
+        # the shipped fingerprint must match OUR namespace config: adopting
+        # a projection built under different namespaces would serve wrong
+        # verdicts silently — refuse loudly instead (SnapshotFormatError)
+        want = config_fingerprint(self.registry.namespace_manager())
+        snap = ckpt.snapshot_from_arrays(arrays, {"fingerprint": want})
+        rows = wire.unpack_tuplecols(arrays, "st")
+        tail = wire.unpack_changes(arrays, "tl")
+        cursor = int(resp["cursor"])
+        head = int(resp["head"])
+        version = int(resp["version"])
+        store = self.registry.store()
+        if not hasattr(store, "adopt_replica"):
+            raise StandbyError(
+                f"store {type(store).__name__} cannot host a replica; "
+                "run the standby with dsn=memory"
+            )
+        store.adopt_replica(rows, head, version, log=tail, log_start=cursor)
+        eng.adopt_snapshot(snap, cursor=cursor, fingerprint=want)
+        eng.snapshot()  # drain the shipped tail into the overlay
+        self.owner_head = head
+        self.owner_version = version
+        self.bootstraps += 1
+        self._last_ok = time.monotonic()
+        self._warm(eng)
+        self._set_state("tailing")
+
+    def _warm(self, eng) -> None:
+        """Probe-dispatch until the compile observatory goes quiet, then
+        declare warm: from here every XLA compile is an after-warm alarm,
+        which is exactly the takeover guarantee — the first post-promotion
+        verdict must not pay a compile."""
+        rows, _ = self.registry.store().get_relation_tuples(page_size=4)
+        if not rows:
+            return  # empty graph: nothing to shape the programs against
+        watch = compilewatch.get()
+        clean = 0
+        for _ in range(_WARM_MAX_ROUNDS):
+            before = watch.compiles_total
+            eng.batch_check(list(rows), 0)
+            self.warm_probe_rounds += 1
+            if watch.compiles_total == before:
+                clean += 1
+                if clean >= _WARM_CLEAN_TARGET:
+                    break
+            else:
+                clean = 0
+        watch.declare_warm()
+
+    # -- tail loop -----------------------------------------------------------
+
+    def poll_once(self) -> bool:
+        """One tail poll; True on success.  A failure of ANY kind — socket
+        drop, owner error, timeout — is one heartbeat miss; the owner is
+        only as alive as its ability to answer the tail."""
+        store = self.registry.store()
+        cursor = store.log_head
+        try:
+            resp, arrays = self._call(
+                {"op": "repl_tail", "cursor": int(cursor)},
+                max(self.heartbeat_s, 0.1),
+            )
+        except Exception:  # noqa: BLE001 - every failure is one miss
+            self.misses += 1
+            self._set_gauges()
+            return False
+        self.misses = 0
+        self._last_ok = time.monotonic()
+        self.owner_head = int(resp["head"])
+        self.owner_version = int(resp["version"])
+        if resp.get("resync"):
+            # our cursor fell off the owner's bounded log: the gap is
+            # unrecoverable from the tail — re-bootstrap from a fresh
+            # snapshot (mirrors the Watch API's resync_required marker)
+            self.resyncs += 1
+            self.registry.metrics().counter(
+                "keto_standby_resyncs_total", 1,
+                help="standby re-bootstraps after changelog overflow",
+            )
+            self._set_state("resyncing")
+            self.bootstrap()
+            return True
+        entries = wire.unpack_changes(arrays, "tl")
+        if entries:
+            store.apply_replicated(
+                entries, self.owner_head, self.owner_version
+            )
+            self.applied_entries += len(entries)
+            eng = self.registry._device_engine()
+            if eng is not None:
+                eng.snapshot()  # drain into the device overlay, stay warm
+        self._set_gauges()
+        return True
+
+    def run(self) -> str:
+        """Bootstrap, then tail until promotion triggers; returns the
+        promotion reason (``owner_death`` | a /debug/handoff reason)."""
+        self.bootstrap()
+        while not self._promote_evt.is_set():
+            ok = self.poll_once()
+            if not ok and self.misses >= self.miss_budget:
+                self.request_promote("owner_death")
+                break
+            # failed polls back off to the heartbeat cadence; healthy
+            # ones run at the (faster) replication poll interval
+            self._promote_evt.wait(
+                self.poll_s if ok else self.heartbeat_s
+            )
+        return self.promote(self._promote_reason or "handoff")
+
+    # -- promotion -----------------------------------------------------------
+
+    def request_promote(self, reason: str = "handoff") -> dict:
+        """Ask the tail loop to promote (the /debug/handoff seam); safe
+        from any thread, idempotent."""
+        with self._lock:
+            if self._promote_reason is None:
+                self._promote_reason = str(reason or "handoff")
+        self._promote_evt.set()
+        return {"status": "promoting", "state": self.state}
+
+    def promote(self, reason: str) -> str:
+        """Finalize takeover: one last drain so the served snapshot covers
+        every replicated entry, then hand the front door to the caller.
+        The projection was shipped and the programs pre-compiled, so this
+        is O(tail), never a cold build."""
+        self._set_state("promoting")
+        self.close()
+        # this process is the owner now: /debug/handoff must 409 again
+        # (the state_snapshot seam stays — its serving row is useful)
+        self.registry.handoff_fn = None
+        eng = self.registry._device_engine()
+        if eng is not None:
+            eng.snapshot()
+        self.registry.metrics().counter(
+            "keto_handoff_total", 1,
+            help="standby promotions by trigger", reason=reason,
+        )
+        self._set_state("serving")
+        return reason
+
+    # -- observability -------------------------------------------------------
+
+    def _set_state(self, state: str) -> None:
+        self.state = state
+        self._set_gauges()
+
+    def _set_gauges(self) -> None:
+        m = self.registry.metrics()
+        lag = max(0, self.owner_head - self.registry.store().log_head) \
+            if self.owner_head >= 0 else 0
+        m.gauge("keto_standby_lag_entries", float(lag),
+                help="changelog entries the standby has not yet applied")
+        m.gauge("keto_standby_lag_seconds",
+                time.monotonic() - self._last_ok,
+                help="seconds since the standby last heard the owner")
+        m.gauge("keto_standby_state", STATES.get(self.state, -1),
+                help="follower state (0=bootstrapping 1=tailing "
+                     "2=resyncing 3=promoting 4=serving)")
+
+    def state_snapshot(self) -> dict:
+        """The standby row for /debug/projection and status --debug."""
+        store = self.registry.store()
+        return {
+            "state": self.state,
+            "cursor": store.log_head,
+            "owner_head": self.owner_head,
+            "owner_version": self.owner_version,
+            "lag_entries": max(0, self.owner_head - store.log_head)
+            if self.owner_head >= 0 else 0,
+            "misses": self.misses,
+            "miss_budget": self.miss_budget,
+            "resyncs": self.resyncs,
+            "bootstraps": self.bootstraps,
+            "applied_entries": self.applied_entries,
+            "warm_probe_rounds": self.warm_probe_rounds,
+        }

@@ -130,6 +130,22 @@ class InMemoryTupleStore:
         with self._lock:
             return list(self._rows.values()), self._log_start + len(self._log)
 
+    def version_and_head(self) -> Tuple[int, int]:
+        """(version, log head) in one lock window.  Snaptoken minting needs
+        the pair atomic: a write landing between two separate reads would
+        mint a token whose cursor includes entries of a version the token
+        does not claim — harmless for freshness, wrong for the exactness a
+        replicated follower must preserve across a takeover."""
+        with self._lock:
+            return self.version, self._log_start + len(self._log)
+
+    def replica_scan(self) -> Tuple[List[RelationTuple], int, int]:
+        """(tuples, head, version) in one lock window: the bootstrap scan a
+        warm-standby follower seeds its replica from."""
+        with self._lock:
+            head = self._log_start + len(self._log)
+            return list(self._rows.values()), head, self.version
+
     # -- writes --------------------------------------------------------------
 
     def write_relation_tuples(self, *tuples: RelationTuple) -> None:
@@ -157,6 +173,86 @@ class InMemoryTupleStore:
                 n_deleted += self._delete_exact_locked(t)
             if insert or n_deleted:
                 self._bump()
+
+    # -- replication (warm-standby follower) ---------------------------------
+
+    def adopt_replica(
+        self,
+        tuples: Iterable[RelationTuple],
+        head: int,
+        version: int,
+        log: Iterable[Tuple[int, RelationTuple]] = (),
+        log_start: Optional[int] = None,
+    ) -> None:
+        """Install a leader's full row scan as this store's state, anchored
+        at the LEADER'S changelog coordinates.  ``log`` is the leader's tail
+        ``[log_start, head)`` so an engine whose base snapshot sits at
+        ``log_start`` can drain forward through ``changes_since`` exactly as
+        it would on the leader.  From here on, ``apply_replicated`` batches
+        keep positions and versions identical to the leader's — which is
+        what makes every leader-minted snaptoken satisfiable on this
+        replica after a takeover."""
+        tuples = list(tuples)
+        log = list(log)
+        if log_start is None:
+            log_start = head - len(log)
+        if log_start + len(log) != head:
+            raise ValueError(
+                f"replica log [{log_start}, {log_start + len(log)}) does "
+                f"not end at the declared head {head}"
+            )
+        with self._lock:
+            self._rows.clear()
+            self._by_userset.clear()
+            self._by_subject.clear()
+            self._next_seq = 0
+            for t in tuples:
+                seq = self._next_seq
+                self._next_seq += 1
+                self._rows[seq] = t
+                self._by_userset.setdefault(
+                    (t.namespace, t.object, t.relation), []
+                ).append(seq)
+                self._by_subject.setdefault(
+                    t.subject.unique_id(), []
+                ).append(seq)
+            self._log = log
+            self._log_start = log_start
+            self._overflow_episode = False
+            self.version = version
+
+    def apply_replicated(
+        self,
+        entries: Iterable[Tuple[int, RelationTuple]],
+        head: int,
+        version: int,
+    ) -> None:
+        """Apply a tailed changelog batch from the leader.  Each entry is
+        one EFFECTIVE row mutation (exactly what ``_log_locked`` recorded on
+        the leader), so a ``-1`` removes exactly one matching row; applying
+        the batch grows this log by ``len(entries)``, landing the head at
+        the leader's — asserted, because silent coordinate drift would
+        desync every snaptoken cursor minted afterward."""
+        entries = list(entries)
+        with self._lock:
+            for op, t in entries:
+                if op > 0:
+                    self._insert_locked(t)
+                else:
+                    key = (t.namespace, t.object, t.relation)
+                    for seq in list(self._by_userset.get(key, ())):
+                        if self._rows[seq] == t:
+                            self._remove_row_locked(seq)
+                            break
+            my_head = self._log_start + len(self._log)
+            if my_head != head:
+                raise ValueError(
+                    f"replica head {my_head} diverged from leader head "
+                    f"{head} after applying {len(entries)} entries"
+                )
+            self.version = version
+            for fn in self._listeners:
+                fn(self.version)
 
     def delete_all_relation_tuples(self, query: Optional[RelationQuery] = None) -> int:
         with self._lock:
@@ -231,6 +327,14 @@ class InMemoryTupleStore:
                 self._overflow_episode = False
                 return None, head
             return list(self._log[cursor - self._log_start:]), head
+
+    def changes_since_versioned(self, cursor: int):
+        """``changes_since`` plus the store version, all in one lock window
+        (the replication tail op ships the triple so the follower's replica
+        lands on exactly the leader's (head, version) pair)."""
+        with self._lock:
+            entries, head = self.changes_since(cursor)
+            return entries, head, self.version
 
 
 def _matches(t: RelationTuple, q: Optional[RelationQuery]) -> bool:

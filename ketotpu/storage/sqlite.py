@@ -630,6 +630,35 @@ class SQLiteTupleStore:
                 ).fetchall()
         return [(r[0], self._decode(r[1:])) for r in rows], head
 
+    def version_and_head(self) -> Tuple[int, int]:
+        """(version, log head) in one read transaction — the atomic pair
+        snaptoken minting and checkpoint stamping key off (the in-memory
+        store exposes the same contract)."""
+        with self._lock:
+            self._assert_migrated()
+            with self._tx():
+                return self.version, self._log_head_locked()
+
+    def replica_scan(self) -> Tuple[List[RelationTuple], int, int]:
+        """(tuples, head, version) in one read transaction: the bootstrap
+        scan a warm-standby follower seeds its replica from."""
+        with self._lock:
+            self._assert_migrated()
+            with self._tx():
+                return (
+                    self._all_tuples_locked(),
+                    self._log_head_locked(),
+                    self.version,
+                )
+
+    def changes_since_versioned(self, cursor: int):
+        """``changes_since`` plus the store version under one lock (the
+        replication tail op ships the triple so the follower's replica
+        lands on exactly the leader's (head, version) pair)."""
+        with self._lock:
+            entries, head = self.changes_since(cursor)
+            return entries, head, self.version
+
     def uuid_reverse_store(self) -> SQLiteReverseStore:
         """Durable reverse UUID mappings sharing this store's connection —
         the registry hands this to UUIDMapper so reverse lookups survive

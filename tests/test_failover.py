@@ -1,0 +1,386 @@
+"""Failover acceptance (slow): kill -9 the owner under a write storm and
+the warm standby takes over snaptoken-exact.
+
+The ISSUE's chaos bar, verified against real subprocess topologies:
+
+* zero acknowledged writes lost — every PUT that returned 201 before the
+  kill is visible on the promoted standby;
+* every pre-death snaptoken stays satisfiable — at-least-as-fresh reads
+  carrying old-owner tokens answer 200, never 412;
+* no cold start — the standby serves its first verdict without a
+  projection rebuild, and the warm gate (keto_xla_compiles_after_warm)
+  stays silent across the takeover;
+* bounded recovery — first post-death verdict within the heartbeat
+  budget plus port-rebind slack, not a resync-the-world pause.
+
+Also hosts the ``serve --workers`` SIGTERM regression (PR-11): a worker
+topology must exit cleanly on SIGTERM and actually release its ports.
+"""
+
+import json
+import os
+import pathlib
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from ketotpu.api.types import RelationTuple
+
+pytestmark = pytest.mark.slow
+
+REPO = pathlib.Path(__file__).parent.parent
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _http(method, url, body=None, headers=None, timeout=10.0):
+    req = urllib.request.Request(
+        url, data=body, method=method, headers=headers or {}
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read().decode(), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode(), dict(e.headers)
+
+
+def _check_url(addr, tuple_str, snaptoken=None):
+    q = RelationTuple.from_string(tuple_str).to_url_query()
+    if snaptoken:
+        q["snaptoken"] = snaptoken
+    return f"{addr}/relation-tuples/check/openapi?{urllib.parse.urlencode(q)}"
+
+
+def _wait_ready(metrics_addr, proc, deadline_s=180.0, what="topology"):
+    ready_by = time.monotonic() + deadline_s
+    while True:
+        if proc is not None:
+            assert proc.poll() is None, f"{what} died during boot"
+        try:
+            status, _, _ = _http(
+                "GET", f"{metrics_addr}/health/ready", timeout=2.0
+            )
+            if status == 200:
+                return
+        except OSError:
+            pass
+        assert time.monotonic() < ready_by, f"{what} never became ready"
+        time.sleep(0.25)
+
+
+def _spawn(cfg_path, *extra, env_extra=None):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(env_extra or {})
+    return subprocess.Popen(
+        [sys.executable, "-m", "ketotpu.cli", "serve",
+         "-c", str(cfg_path), *extra],
+        env=env, cwd=str(REPO),
+    )
+
+
+def _kill(proc):
+    if proc.poll() is None:
+        proc.kill()
+    try:
+        proc.wait(timeout=15)
+    except subprocess.TimeoutExpired:
+        pass
+
+
+def _failover_config(tmp_path, replication):
+    ports = {n: _free_port() for n in ("read", "write", "metrics", "opl")}
+    standby_port = _free_port()
+    sock = str(tmp_path / "repl.sock")
+    config = {
+        "dsn": "memory",
+        "serve": {
+            n: {"host": "127.0.0.1", "port": p} for n, p in ports.items()
+        },
+        "namespaces": [
+            {"id": 0, "name": "doc", "relations": ["viewers"]},
+        ],
+        "engine": {"kind": "tpu", "frontier": 512, "arena": 2048,
+                   "max_batch": 128},
+        "durability": {
+            "socket": sock,
+            "replication": replication,
+            "heartbeat_ms": 200,
+            "heartbeat_misses": 3,
+            "poll_ms": 20,
+            "standby_port": standby_port,
+        },
+        "log": {"request_log": False},
+    }
+    cfg_path = tmp_path / "failover.json"
+    cfg_path.write_text(json.dumps(config))
+    return cfg_path, ports, standby_port
+
+
+def _wait_standby_tailing(standby_port, proc, deadline_s=180.0):
+    """Poll the standby's pre-promotion metrics port until the
+    keto_standby_state gauge reports tailing (1)."""
+    url = f"http://127.0.0.1:{standby_port}/metrics/prometheus"
+    ready_by = time.monotonic() + deadline_s
+    while True:
+        assert proc.poll() is None, "standby died during bootstrap"
+        try:
+            status, body, _ = _http("GET", url, timeout=2.0)
+            if status == 200:
+                for line in body.splitlines():
+                    if line.startswith("keto_standby_state"):
+                        if float(line.rsplit(" ", 1)[-1]) == 1.0:
+                            return
+        except OSError:
+            pass
+        assert time.monotonic() < ready_by, "standby never reached tailing"
+        time.sleep(0.1)
+
+
+def test_kill9_owner_under_write_storm_standby_takes_over(tmp_path):
+    cfg_path, ports, standby_port = _failover_config(tmp_path, "semi-sync")
+    write = f"http://127.0.0.1:{ports['write']}"
+    read = f"http://127.0.0.1:{ports['read']}"
+    metrics = f"http://127.0.0.1:{ports['metrics']}"
+
+    owner = _spawn(cfg_path)
+    standby = None
+    try:
+        _wait_ready(metrics, owner, what="owner")
+        standby = _spawn(cfg_path, "--standby")
+        _wait_standby_tailing(standby_port, standby)
+
+        # -- write storm against the live owner --------------------------
+        acked = []        # (tuple_str, snaptoken) pairs that got a 201
+        lock = threading.Lock()
+        stop = threading.Event()
+
+        def storm(wid):
+            i = 0
+            while not stop.is_set():
+                ts = f"doc:d{wid}_{i}#viewers@u{wid}"
+                body = json.dumps(
+                    RelationTuple.from_string(ts).to_json()
+                ).encode()
+                try:
+                    status, _, hdrs = _http(
+                        "PUT", f"{write}/admin/relation-tuples", body,
+                        headers={"Content-Type": "application/json"},
+                        timeout=5.0,
+                    )
+                except OSError:
+                    break  # owner is gone: un-acked, not counted
+                if status != 201:
+                    break
+                with lock:
+                    acked.append((ts, hdrs.get("X-Keto-Snaptoken", "")))
+                i += 1
+
+        writers = [
+            threading.Thread(target=storm, args=(w,), daemon=True)
+            for w in range(4)
+        ]
+        for t in writers:
+            t.start()
+        # let the storm run long enough that kills land mid-write AND the
+        # standby has real tail traffic to replicate
+        time.sleep(3.0)
+
+        # -- kill -9 mid-storm -------------------------------------------
+        owner.send_signal(signal.SIGKILL)
+        t_kill = time.monotonic()
+        stop.set()
+        for t in writers:
+            t.join(timeout=15.0)
+        owner.wait(timeout=15)
+        assert acked, "storm produced no acknowledged writes"
+
+        # -- bounded recovery to first verdict ---------------------------
+        probe = acked[-1][0]
+        first_verdict = None
+        recovery_by = time.monotonic() + 60.0
+        while time.monotonic() < recovery_by:
+            assert standby.poll() is None, "standby died during takeover"
+            try:
+                status, body, _ = _http(
+                    "GET", _check_url(read, probe), timeout=2.0
+                )
+                if status == 200:
+                    first_verdict = time.monotonic() - t_kill
+                    assert json.loads(body)["allowed"] is True
+                    break
+            except OSError:
+                pass
+            time.sleep(0.1)
+        assert first_verdict is not None, "standby never served a verdict"
+        assert first_verdict < 45.0, f"unbounded recovery: {first_verdict}s"
+
+        # -- zero acknowledged writes lost -------------------------------
+        # semi-sync: a 201 means the standby's tail cursor covered the
+        # write, so EVERY acked tuple must be visible post-takeover
+        lost = []
+        for ts, _tok in acked:
+            status, body, _ = _http("GET", _check_url(read, ts))
+            if status != 200 or json.loads(body)["allowed"] is not True:
+                lost.append((ts, status))
+        assert not lost, f"{len(lost)}/{len(acked)} acked writes lost: " \
+            f"{lost[:5]}"
+
+        # -- every pre-death snaptoken stays satisfiable -----------------
+        stale = []
+        for ts, tok in acked:
+            if not tok:
+                continue
+            status, _, _ = _http("GET", _check_url(read, ts, snaptoken=tok))
+            if status != 200:
+                stale.append((ts, tok, status))
+        assert not stale, f"pre-death snaptokens unsatisfiable: {stale[:5]}"
+
+        # -- warm takeover: no cold build, no after-warm compiles --------
+        status, body, _ = _http("GET", f"{metrics}/metrics/prometheus")
+        assert status == 200
+        assert "keto_xla_compiles_after_warm_total" not in body, (
+            "takeover paid an XLA compile after the standby declared warm"
+        )
+        handoff = [
+            ln for ln in body.splitlines()
+            if ln.startswith("keto_handoff_total")
+        ]
+        assert handoff and 'reason="owner_death"' in handoff[0], handoff
+    finally:
+        _kill(owner)
+        if standby is not None:
+            standby.terminate()
+            try:
+                standby.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                _kill(standby)
+
+
+def test_rolling_restart_handoff_endpoint(tmp_path):
+    """Deliberate handoff: POST /debug/handoff on the standby's metrics
+    port promotes it without waiting for heartbeat loss, and the old
+    owner's writes stay visible."""
+    cfg_path, ports, standby_port = _failover_config(tmp_path, "async")
+    write = f"http://127.0.0.1:{ports['write']}"
+    read = f"http://127.0.0.1:{ports['read']}"
+    metrics = f"http://127.0.0.1:{ports['metrics']}"
+
+    owner = _spawn(cfg_path)
+    standby = None
+    try:
+        _wait_ready(metrics, owner, what="owner")
+        standby = _spawn(cfg_path, "--standby")
+        _wait_standby_tailing(standby_port, standby)
+
+        ts = "doc:roll#viewers@alice"
+        body = json.dumps(RelationTuple.from_string(ts).to_json()).encode()
+        status, _, hdrs = _http(
+            "PUT", f"{write}/admin/relation-tuples", body,
+            headers={"Content-Type": "application/json"},
+        )
+        assert status == 201
+        tok = hdrs.get("X-Keto-Snaptoken", "")
+        time.sleep(0.5)  # one poll interval: let the tail catch up
+
+        status, resp, _ = _http(
+            "POST", f"http://127.0.0.1:{standby_port}/debug/handoff", b"{}",
+            headers={"Content-Type": "application/json"},
+        )
+        assert status == 200, resp
+        # the rolling-restart runbook: handoff first, THEN retire the owner
+        owner.terminate()
+        owner.wait(timeout=30)
+
+        ok_by = time.monotonic() + 60.0
+        while time.monotonic() < ok_by:
+            assert standby.poll() is None, "standby died during handoff"
+            try:
+                status, body, _ = _http(
+                    "GET", _check_url(read, ts, snaptoken=tok), timeout=2.0
+                )
+                if status == 200:
+                    assert json.loads(body)["allowed"] is True
+                    break
+            except OSError:
+                pass
+            time.sleep(0.1)
+        else:
+            pytest.fail("promoted standby never served the handoff read")
+    finally:
+        _kill(owner)
+        if standby is not None:
+            standby.terminate()
+            try:
+                standby.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                _kill(standby)
+
+
+def test_sigterm_tears_down_worker_topology(tmp_path):
+    """PR-11 regression: ``serve --workers 2`` must exit cleanly on
+    SIGTERM — the parent's handler raises KeyboardInterrupt so workers
+    are reaped and every listening port is actually released."""
+    db = tmp_path / "sigterm.db"
+    from ketotpu.driver import Provider, Registry
+    seed = Registry(Provider({"dsn": f"sqlite://{db}"}))
+    seed.store().migrate_up()
+    seed.store().close()
+
+    ports = {n: _free_port() for n in ("read", "write", "metrics", "opl")}
+    config = {
+        "dsn": f"sqlite://{db}",
+        "serve": {
+            n: {"host": "127.0.0.1", "port": p} for n, p in ports.items()
+        },
+        "namespaces": [
+            {"id": 0, "name": "doc", "relations": ["viewers"]},
+        ],
+        "engine": {"kind": "tpu", "frontier": 512, "arena": 1024,
+                   "max_batch": 128},
+        "log": {"request_log": False},
+    }
+    cfg_path = tmp_path / "sigterm.json"
+    cfg_path.write_text(json.dumps(config))
+
+    proc = _spawn(cfg_path, "--workers", "2")
+    try:
+        _wait_ready(
+            f"http://127.0.0.1:{ports['metrics']}", proc,
+            what="worker topology",
+        )
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=60)
+        assert rc == 0, f"SIGTERM exit code {rc}"
+        # the ports must come free again (no orphaned workers holding them)
+        free_by = time.monotonic() + 30.0
+        pending = dict(ports)
+        while pending and time.monotonic() < free_by:
+            for name, port in list(pending.items()):
+                s = socket.socket()
+                try:
+                    s.bind(("127.0.0.1", port))
+                    del pending[name]
+                except OSError:
+                    pass
+                finally:
+                    s.close()
+            if pending:
+                time.sleep(0.25)
+        assert not pending, f"ports still held after SIGTERM: {pending}"
+    finally:
+        _kill(proc)

@@ -1,0 +1,433 @@
+"""Warm-standby follower tests (ketotpu/standby.py + the replication
+wire ops in server/workers.py).
+
+The takeover contract under test: a follower that bootstrapped over the
+owner's engine-host socket holds the owner's exact changelog coordinates
+— every snaptoken the owner ever minted is satisfiable on the replica,
+verdicts match without a cold projection build, and the first poll after
+a changelog overflow re-bootstraps instead of serving a gap.  The
+semi-sync ReplicationGate is exercised both standalone and end-to-end
+(the tail poll's cursor IS the ack).
+"""
+
+import threading
+import time
+
+import pytest
+
+from ketotpu import faults
+from ketotpu.api.types import RelationTuple
+from ketotpu.consistency import satisfies_token
+from ketotpu.consistency.tokens import Snaptoken, mint
+from ketotpu.driver import Provider, Registry
+from ketotpu.server.workers import EngineHostServer, ReplicationGate
+from ketotpu.standby import StandbyFollower
+
+T = RelationTuple.from_string
+
+NAMESPACES = [
+    {"id": 0, "name": "doc", "relations": ["viewers"]},
+    {"id": 1, "name": "grp", "relations": ["members"]},
+]
+
+
+@pytest.fixture(autouse=True)
+def _no_fault_leak():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _registry(**over):
+    cfg = {
+        "dsn": "memory",
+        "namespaces": NAMESPACES,
+        "engine": {
+            "kind": "tpu", "frontier": 512, "arena": 1024,
+            "max_batch": 128,
+        },
+    }
+    cfg.update(over)
+    return Registry(Provider(cfg))
+
+
+def _owner(n=20, **over):
+    reg = _registry(**over)
+    reg.store().write_relation_tuples(
+        *[T(f"doc:d{i}#viewers@u{i}") for i in range(n)]
+    )
+    reg.init()
+    return reg
+
+
+def _follower(stby, sock, **kw):
+    kw.setdefault("poll_s", 0.01)
+    kw.setdefault("heartbeat_s", 0.2)
+    return StandbyFollower(stby, sock, **kw)
+
+
+class TestBootstrap:
+    def test_bootstrap_installs_owner_coordinates(self, tmp_path):
+        owner = _owner()
+        sock = str(tmp_path / "repl.sock")
+        host = EngineHostServer(owner, sock).start()
+        try:
+            stby = _registry()
+            f = _follower(stby, sock)
+            f.bootstrap()
+            assert stby.store().log_head == owner.store().log_head
+            assert stby.store().version == owner.store().version
+            # verdicts straight off the shipped projection: no rebuild
+            eng = stby._device_engine()
+            assert eng.batch_check(
+                [T("doc:d1#viewers@u1"), T("doc:d1#viewers@u2")]
+            ) == [True, False]
+            assert eng.rebuilds == 0
+            assert f.state == "tailing"
+            f.close()
+        finally:
+            host.stop()
+
+    def test_every_owner_token_satisfiable_on_replica(self, tmp_path):
+        owner = _owner()
+        sock = str(tmp_path / "tok.sock")
+        host = EngineHostServer(owner, sock).start()
+        try:
+            # tokens minted across the owner's write history, including
+            # the newest possible one at bootstrap time
+            tokens = [mint(owner.store())]
+            owner.store().write_relation_tuples(T("doc:late#viewers@zed"))
+            tokens.append(mint(owner.store()))
+            stby = _registry()
+            f = _follower(stby, sock)
+            f.bootstrap()
+            for tok in tokens:
+                assert satisfies_token(
+                    tok,
+                    cursor=stby.store().log_head,
+                    version=stby.store().version,
+                ), tok
+            f.close()
+        finally:
+            host.stop()
+
+    def test_namespace_mismatch_refused_loudly(self, tmp_path):
+        from ketotpu.engine.checkpoint import SnapshotFormatError
+
+        owner = _owner()
+        sock = str(tmp_path / "mism.sock")
+        host = EngineHostServer(owner, sock).start()
+        try:
+            stby = _registry(
+                namespaces=[{"id": 0, "name": "other", "relations": ["x"]}]
+            )
+            f = _follower(stby, sock)
+            with pytest.raises(SnapshotFormatError):
+                f.bootstrap()
+            f.close()
+        finally:
+            host.stop()
+
+
+class TestTail:
+    def test_tail_applies_owner_writes(self, tmp_path):
+        owner = _owner()
+        sock = str(tmp_path / "tail.sock")
+        host = EngineHostServer(owner, sock).start()
+        try:
+            stby = _registry()
+            f = _follower(stby, sock)
+            f.bootstrap()
+            owner.store().write_relation_tuples(T("doc:dX#viewers@zed"))
+            owner.store().delete_relation_tuples(T("doc:d1#viewers@u1"))
+            assert f.poll_once() is True
+            assert stby.store().log_head == owner.store().log_head
+            eng = stby._device_engine()
+            assert eng.batch_check(
+                [T("doc:dX#viewers@zed"), T("doc:d1#viewers@u1")]
+            ) == [True, False]
+            snap = f.state_snapshot()
+            assert snap["lag_entries"] == 0
+            assert snap["applied_entries"] == 2
+            # the standby row rides the registry debug plane
+            assert stby.projection_stats()["standby"]["state"] == "tailing"
+            f.close()
+        finally:
+            host.stop()
+
+    def test_changelog_overflow_forces_resync(self, tmp_path):
+        owner = _owner()
+        owner.store()._log_cap = 8
+        sock = str(tmp_path / "ovf.sock")
+        host = EngineHostServer(owner, sock).start()
+        try:
+            stby = _registry()
+            f = _follower(stby, sock)
+            f.bootstrap()
+            # push the follower's cursor off the owner's bounded log
+            for i in range(20):
+                owner.store().write_relation_tuples(
+                    T(f"doc:r{i}#viewers@w{i}")
+                )
+            assert f.poll_once() is True
+            assert f.resyncs == 1
+            assert f.bootstraps == 2
+            assert stby.store().log_head == owner.store().log_head
+            eng = stby._device_engine()
+            assert eng.batch_check([T("doc:r19#viewers@w19")]) == [True]
+            f.close()
+        finally:
+            host.stop()
+
+    def test_injected_tail_drop_counts_a_miss(self, tmp_path):
+        owner = _owner()
+        sock = str(tmp_path / "drop.sock")
+        host = EngineHostServer(owner, sock).start()
+        try:
+            stby = _registry()
+            f = _follower(stby, sock)
+            f.bootstrap()
+            faults.configure(tail_drop_rate=1.0, seed=7)
+            assert f.poll_once() is False
+            assert f.misses == 1
+            assert faults.plan().injected.get("tail_drop", 0) == 1
+            faults.reset()
+            assert f.poll_once() is True
+            assert f.misses == 0
+            f.close()
+        finally:
+            host.stop()
+
+
+class TestPromotion:
+    def test_owner_death_promotes(self, tmp_path):
+        owner = _owner()
+        sock = str(tmp_path / "death.sock")
+        host = EngineHostServer(owner, sock).start()
+        stby = _registry()
+        f = _follower(
+            stby, sock, poll_s=0.01, heartbeat_s=0.01, heartbeat_misses=2
+        )
+        out = {}
+        t = threading.Thread(
+            target=lambda: out.update(reason=f.run()), daemon=True
+        )
+        t.start()
+        deadline = time.monotonic() + 30
+        while f.state != "tailing" and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert f.state == "tailing"
+        host.stop()
+        t.join(30)
+        assert out.get("reason") == "owner_death"
+        assert f.state == "serving"
+        # takeover serves off the replicated state, never a cold build
+        eng = stby._device_engine()
+        assert eng.batch_check([T("doc:d2#viewers@u2")]) == [True]
+        assert eng.rebuilds == 0
+
+    def test_deliberate_handoff(self, tmp_path):
+        owner = _owner()
+        sock = str(tmp_path / "hand.sock")
+        host = EngineHostServer(owner, sock).start()
+        try:
+            stby = _registry()
+            f = _follower(stby, sock)
+            out = {}
+            t = threading.Thread(
+                target=lambda: out.update(reason=f.run()), daemon=True
+            )
+            t.start()
+            deadline = time.monotonic() + 30
+            while f.state != "tailing" and time.monotonic() < deadline:
+                time.sleep(0.01)
+            # the /debug/handoff seam: wired to the registry, idempotent
+            assert stby.handoff_fn == f.request_promote
+            resp = f.request_promote("rolling-restart")
+            assert resp["status"] == "promoting"
+            t.join(30)
+            assert out.get("reason") == "rolling-restart"
+            # this process is the owner now: the handoff seam is cleared
+            assert stby.handoff_fn is None
+        finally:
+            host.stop()
+
+
+class TestReplicationGate:
+    def test_async_never_waits(self):
+        g = ReplicationGate("async")
+        assert g.wait_replicated(10) is True
+        assert g.stats()["semi_sync_waits"] == 0
+
+    def test_semi_sync_without_follower_passes(self):
+        g = ReplicationGate("semi-sync", ack_timeout_ms=50)
+        assert g.wait_replicated(10) is True  # nothing attached yet
+
+    def test_semi_sync_waits_for_the_ack(self):
+        g = ReplicationGate("semi-sync", ack_timeout_ms=5000)
+        g.ack(5)  # follower attached, durable through 5
+        assert g.wait_replicated(5) is True
+        t = threading.Thread(target=lambda: (time.sleep(0.05), g.ack(12)))
+        t.start()
+        assert g.wait_replicated(12) is True
+        t.join()
+        assert g.stats()["acked_cursor"] == 12
+
+    def test_semi_sync_timeout_degrades_per_write(self):
+        g = ReplicationGate("semi-sync", ack_timeout_ms=30)
+        g.ack(1)
+        t0 = time.monotonic()
+        assert g.wait_replicated(99) is False
+        assert time.monotonic() - t0 < 5.0  # bounded, not a hang
+        assert g.stats()["ack_timeouts"] == 1
+
+    def test_detach_releases_the_gate(self):
+        g = ReplicationGate("semi-sync", ack_timeout_ms=30)
+        g.ack(1)
+        g.detach()
+        assert g.wait_replicated(99) is True
+
+    def test_tail_poll_acks_end_to_end(self, tmp_path):
+        owner = _owner(durability={
+            "replication": "semi-sync", "ack_timeout_ms": 2000,
+        })
+        sock = str(tmp_path / "ack.sock")
+        host = EngineHostServer(owner, sock).start()
+        try:
+            stby = _registry()
+            f = _follower(stby, sock)
+            f.bootstrap()
+            f.poll_once()
+            gate = owner.durability_gate()
+            st = gate.stats()
+            assert st["mode"] == "semi-sync"
+            assert st["attached"] is True
+            assert st["acked_cursor"] == owner.store().log_head
+            # a write is acked once the follower has durably appended it
+            # and re-polled (the next poll's cursor covers it)
+            owner.store().write_relation_tuples(T("doc:dY#viewers@ack"))
+            head = owner.store().log_head
+            done = {}
+            t = threading.Thread(
+                target=lambda: done.update(ok=gate.wait_replicated(head))
+            )
+            t.start()
+            time.sleep(0.02)
+            f.poll_once()  # applies the entry (replica head -> head)
+            f.poll_once()  # acks the new head
+            t.join(10)
+            assert done.get("ok") is True
+            assert stby.projection_stats().get("standby")  # seam is live
+            assert owner.projection_stats()["replication"]["acked_cursor"] \
+                == head
+            f.close()
+        finally:
+            host.stop()
+
+
+class TestSatisfiesToken:
+    def test_cursorful_token_compares_by_cursor(self):
+        tok = Snaptoken(5, cursor=7)
+        assert satisfies_token(tok, cursor=7, version=0)
+        assert not satisfies_token(tok, cursor=6, version=99)
+
+    def test_legacy_token_compares_by_version(self):
+        tok = Snaptoken(5)
+        assert satisfies_token(tok, cursor=-1, version=5)
+        assert not satisfies_token(tok, cursor=100, version=4)
+
+    def test_minted_token_carries_atomic_coordinates(self):
+        reg = _registry()
+        reg.store().write_relation_tuples(T("doc:a#viewers@alice"))
+        tok = mint(reg.store())
+        assert tok.cursor == reg.store().log_head
+        assert tok.version == reg.store().version
+
+
+def test_checkpoint_during_inflight_compaction(tmp_path, monkeypatch):
+    """The checkpoint/compaction race fix (durability plane, satellite 1):
+    saving while a background compaction generation is in flight must
+    capture ONE consistent (snapshot, cursor) pair from a single
+    ``_sync_lock`` window — never tear down the compactor, never block on
+    it, and the persisted file must restore bit-identically with the
+    un-folded tail replayed through the normal drain.  The same capture
+    path feeds ``replication_snapshot``, so a torn pair here would ship a
+    torn bootstrap to a standby."""
+    import dataclasses
+
+    import numpy as np
+
+    from ketotpu.engine import checkpoint as ckpt
+    from ketotpu.engine import delta as dl
+    from ketotpu.engine.snapshot import Snapshot
+    from ketotpu.engine.tpu import DeviceCheckEngine
+    from ketotpu.utils.synth import build_synth
+
+    g = build_synth(n_users=32, n_groups=4, n_folders=8, n_docs=32)
+    eng = DeviceCheckEngine(
+        g.store, g.manager, frontier=2048, arena=4096, max_batch=512,
+        compaction={"background": True},
+    )
+    eng.snapshot()  # initial build
+    base = eng._snap
+    rebuilds0 = eng.rebuilds
+
+    # park the compactor off-lock inside its build step: the fold/rebuild
+    # entry points block on an event, holding the generation in flight
+    # deterministically while we checkpoint around it
+    ev_in, ev_go = threading.Event(), threading.Event()
+    real_fold = dl.fold_snapshot_cols
+    real_build = dl.build_snapshot_cols
+
+    def gated_fold(*a, **kw):
+        ev_in.set()
+        assert ev_go.wait(30)
+        return real_fold(*a, **kw)
+
+    def gated_build(*a, **kw):
+        ev_in.set()
+        assert ev_go.wait(30)
+        return real_build(*a, **kw)
+
+    monkeypatch.setattr(dl, "fold_snapshot_cols", gated_fold)
+    monkeypatch.setattr(dl, "build_snapshot_cols", gated_build)
+
+    # overflow the overlay so the drain kicks the background compactor
+    eng.max_overlay_pairs = 1
+    writes = [T(f"Group:g0#members@ckpt_w{i}") for i in range(8)]
+    g.store.write_relation_tuples(*writes)
+    eng.snapshot()
+    assert ev_in.wait(30)  # compactor is now in flight, off-lock
+
+    path = str(tmp_path / "racing.npz")
+    eng.save_checkpoint(path)  # must neither deadlock nor refresh
+    assert eng.rebuilds == rebuilds0  # no teardown of the live generation
+    assert eng._compactor_alive()  # and the compactor kept flying
+
+    # the file holds the base generation + the cursor it was built at:
+    # cols and cursor from the same lock window (the race being fixed is
+    # a fresh-cols/stale-cursor or stale-cols/fresh-cursor tear)
+    saved, cursor, head, ver = ckpt.load_snapshot_with_cursor(path)
+    assert cursor == eng._snap_cursor
+    assert cursor < head  # the compacting tail is NOT folded into the file
+    for f in dataclasses.fields(Snapshot):
+        a, b = getattr(base, f.name), getattr(saved, f.name)
+        if isinstance(a, np.ndarray):
+            assert a.dtype == b.dtype and (a == b).all(), f.name
+        elif isinstance(a, int):
+            assert a == b, f.name
+
+    # release the compactor and let its generation land
+    ev_go.set()
+    t = eng._compact_thread
+    if t is not None:
+        t.join(30)
+
+    # a fresh engine restores from the racing checkpoint: no re-projection,
+    # and the persisted-cursor tail replays through the normal drain
+    fresh = DeviceCheckEngine(
+        g.store, g.manager, frontier=2048, arena=4096, max_batch=512
+    )
+    assert fresh.load_checkpoint(path) is True
+    assert fresh.rebuilds == 0
+    assert fresh.batch_check(writes) == [True] * len(writes)
