@@ -210,6 +210,202 @@ def run_serving_bench(
         srv.stop(grace=2.0)
 
 
+def _hammer_shared(
+    target: str, requests, *, concurrency: int, duration: float,
+    channels: int = 64,
+) -> Dict[str, float]:
+    """``_hammer`` with a bounded shared channel pool: the north-star legs
+    run thousands of closed-loop clients, and one gRPC channel per client
+    would exhaust file descriptors long before the engine saturates."""
+    import grpc
+
+    from ketotpu.proto.services import CheckServiceStub
+
+    pool = [
+        grpc.insecure_channel(target)
+        for _ in range(max(1, min(channels, concurrency)))
+    ]
+    stubs = [CheckServiceStub(ch) for ch in pool]
+    lat: List[List[float]] = [[] for _ in range(concurrency)]
+    stop = threading.Event()
+    errors = [0]
+
+    def client(idx: int) -> None:
+        rng = np.random.default_rng(idx)
+        stub = stubs[idx % len(stubs)]
+        my = lat[idx]
+        n_req = len(requests)
+        while not stop.is_set():
+            r = requests[int(rng.integers(n_req))]
+            t0 = time.perf_counter()
+            try:
+                stub.Check(r)
+            except grpc.RpcError:
+                errors[0] += 1
+                continue
+            my.append(time.perf_counter() - t0)
+
+    threads = [
+        threading.Thread(target=client, args=(i,), daemon=True)
+        for i in range(concurrency)
+    ]
+    t_start = time.perf_counter()
+    for t in threads:
+        t.start()
+    time.sleep(duration)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10.0)
+    elapsed = time.perf_counter() - t_start
+    for ch in pool:
+        ch.close()
+    all_lat = np.array([x for sub in lat for x in sub])
+    done = len(all_lat)
+    return {
+        "rps": round(done / elapsed, 1),
+        "p50_ms": round(float(np.percentile(all_lat, 50)) * 1000, 2)
+        if done else -1.0,
+        "p99_ms": round(float(np.percentile(all_lat, 99)) * 1000, 2)
+        if done else -1.0,
+        "seconds": round(elapsed, 1),
+        "errors": errors[0],
+    }
+
+
+def run_northstar_bench(
+    graph=None,
+    *,
+    concurrencies=(1024, 4096),
+    duration: float = 8.0,
+    frontier: int = 16384,
+    arena: int = 65536,
+    fused_retry_lanes: int = 1,
+) -> Dict[str, float]:
+    """North-star serving leg for the fused tiered dispatch
+    (engine/fused.py): boot the daemon with ``engine.fused_dispatch`` ON,
+    hammer single Checks on the BASELINE mixed-general workload (30%
+    AND/NOT ``edit`` permits, subject-set slice) at each concurrency, and
+    report RPS + p50/p99 per point.  Three gates ride along:
+
+    * **zero divergence** — 512 served verdicts vs the host oracle at
+      the same state must agree exactly (``northstar_divergence == 0``);
+    * **steady-state compiles** — the timed hammers run after a warm
+      pass at the same shapes under ``bench._steady``; any XLA compile
+      inside them lands in ``steady_state_compiles`` (process exit 3);
+    * **single D2H per wave** — the wave ledger's fused deltas must show
+      ``fused_waves == fused_d2h_fetches`` (``northstar_single_d2h``).
+    """
+    import grpc
+
+    from ketotpu.api.proto_codec import subject_to_proto
+    from ketotpu.driver import Provider, Registry
+    from ketotpu.proto import check_service_pb2 as cs
+    from ketotpu.proto import relation_tuples_pb2 as rts
+    from ketotpu.proto.services import CheckServiceStub
+    from ketotpu.server import serve_all
+    from ketotpu.utils.synth import build_synth, synth_queries_mixed
+
+    if graph is None:
+        graph = build_synth(
+            n_users=2000, n_groups=100, n_folders=2000, n_docs=20000, seed=0
+        )
+    cfg = Provider(
+        {
+            "serve": {
+                n: {"host": "127.0.0.1", "port": 0}
+                for n in ("read", "write", "metrics", "opl")
+            },
+            "engine": {
+                "kind": "tpu",
+                "fused_dispatch": True,
+                "fused_retry_lanes": int(fused_retry_lanes),
+                "frontier": frontier,
+                "arena": arena,
+                "max_batch": frontier,
+                "coalesce_ms": 2,
+            },
+            # the 4096-client leg must shed nothing: admission caps would
+            # measure the limiter, not the fused engine — and the first
+            # fused compile takes minutes on XLA:CPU, so the per-request
+            # deadline must not fail the warm-up checks
+            "limit": {"max_inflight": 0, "request_timeout_ms": 0},
+            "log": {"request_log": False},
+        }
+    )
+    reg = Registry(
+        cfg, store=graph.store, namespace_manager=graph.manager
+    ).init()
+    srv = serve_all(reg)
+    try:
+        host, port = srv.addresses["read"]
+        target = f"{host}:{port}"
+        requests = [
+            cs.CheckRequest(
+                tuple=rts.RelationTuple(
+                    namespace=q.namespace,
+                    object=q.object,
+                    relation=q.relation,
+                    subject=subject_to_proto(q.subject),
+                )
+            )
+            for q in synth_queries_mixed(graph, 4096, seed=5)
+        ]
+        # zero-divergence gate: served verdicts vs the host oracle.
+        # Runs FIRST so the expensive fused compiles happen in-process,
+        # not under a gRPC warm-up call.
+        eng = reg.check_engine()
+        inner = getattr(eng, "inner", eng)
+        sample = synth_queries_mixed(graph, 512, seed=9)
+        served = eng.batch_check(sample)
+        want = [inner.oracle.check_is_member(q) for q in sample]
+        divergence = sum(1 for g, w in zip(served, want) if g != w)
+
+        with grpc.insecure_channel(target) as ch:
+            stub = CheckServiceStub(ch)
+            for r in requests[:8]:
+                stub.Check(r)
+
+        from bench import _steady
+
+        out: Dict[str, float] = {"northstar_divergence": divergence}
+        gate: Dict = {}
+        ledger = reg.wave_ledger()
+        w0 = ledger.stats() if ledger is not None else {}
+        for conc in concurrencies:
+            # warm pass at THIS concurrency's exact coalescer wave
+            # buckets, unmeasured; then the timed pass under the gate
+            _hammer_shared(
+                target, requests, concurrency=conc,
+                duration=max(2.0, duration * 0.4),
+            )
+            with _steady(gate, f"serve_northstar_{conc}"):
+                h = _hammer_shared(
+                    target, requests, concurrency=conc, duration=duration
+                )
+            out[f"northstar_{conc}_rps"] = h["rps"]
+            out[f"northstar_{conc}_p50_ms"] = h["p50_ms"]
+            out[f"northstar_{conc}_p99_ms"] = h["p99_ms"]
+            out[f"northstar_{conc}_errors"] = h["errors"]
+        steady = gate.get("steady_state_compiles", {})
+        out["northstar_steady_state_compiles"] = int(sum(steady.values()))
+        if steady:
+            out["steady_state_compiles"] = steady
+        if ledger is not None:
+            ws = ledger.stats()
+            out["northstar_wave_device_ms_p50"] = ws.get("device_ms_p50", 0)
+            out["northstar_wave_size_p95"] = ws.get("wave_size_p95", 0)
+            fw = ws.get("fused_waves", 0) - w0.get("fused_waves", 0)
+            fd = (ws.get("fused_d2h_fetches", 0)
+                  - w0.get("fused_d2h_fetches", 0))
+            out["northstar_fused_waves"] = fw
+            out["northstar_fused_d2h_fetches"] = fd
+            out["northstar_single_d2h"] = bool(fw > 0 and fw == fd)
+            out["northstar_fused_tier_rows"] = ws.get("fused_tier_rows", {})
+        return out
+    finally:
+        srv.stop(grace=2.0)
+
+
 def run_trace_overhead_bench(
     graph=None,
     *,
@@ -916,6 +1112,24 @@ if __name__ == "__main__":
         print(json.dumps(run_workers_bench(concurrency=conc, duration=secs)))
     elif len(sys.argv) > 3 and sys.argv[3] == "batch":
         print(json.dumps(run_batch_bench(concurrency=conc, duration=secs)))
+    elif len(sys.argv) > 3 and sys.argv[3] == "northstar":
+        import os
+
+        kw = {}
+        if os.environ.get("JAX_PLATFORMS") == "cpu":
+            # XLA:CPU compiles chip-shaped fused programs minutes-slow;
+            # the CI smoke leg shrinks the program (no retry lanes => no
+            # boosted bodies) and still drives the whole fused path
+            kw = dict(frontier=4096, arena=16384, fused_retry_lanes=0)
+        res = run_northstar_bench(
+            concurrencies=(conc,) if len(sys.argv) > 4 else (1024, 4096),
+            duration=secs, **kw,
+        )
+        print(json.dumps(res))
+        sys.exit(
+            3 if res.get("northstar_steady_state_compiles")
+            or res.get("northstar_divergence") else 0
+        )
     elif len(sys.argv) > 3 and sys.argv[3] == "trace":
         print(json.dumps(
             run_trace_overhead_bench(concurrency=conc, duration=secs)

@@ -116,6 +116,14 @@ def _defaults() -> Dict[str, Any]:
             "arena": 16384,
             "max_batch": 8192,
             "retry_scale": 4,
+            # fused tiered dispatch (engine/fused.py): compile the whole
+            # wave cascade (leopard probe -> fast BFS -> general algebra,
+            # done-masked) into ONE device program with a single D2H
+            # fetch; false restores the per-tier dispatch path
+            # (parity/debug oracle).  fused_retry_lanes bounds the
+            # in-program width-escalation re-runs of the fast tier.
+            "fused_dispatch": True,
+            "fused_retry_lanes": 1,
             # window (ms) for coalescing concurrent single checks into one
             # device dispatch; 0 disables (engine/coalesce.py)
             "coalesce_ms": 2,
@@ -365,6 +373,7 @@ class Provider:
             for known in ("max_read_depth", "max_read_width", "mesh_devices",
                           "mesh_axis", "max_batch", "retry_scale",
                           "coalesce_ms", "coalesce_batch_max",
+                          "fused_dispatch", "fused_retry_lanes",
                           "columnar_batch", "coalesce_pipeline",
                           "wire_shm_threshold", "experimental_strict_mode",
                           "max_inflight", "request_timeout_ms",
@@ -616,7 +625,14 @@ class Provider:
             val = self.get(key)
             if not isinstance(val, int) or val < 1:
                 raise ConfigError(key, f"must be a positive integer, got {val!r}")
+        val = self.get("engine.fused_retry_lanes")
+        if not isinstance(val, int) or val < 0:
+            raise ConfigError(
+                "engine.fused_retry_lanes",
+                f"must be a non-negative integer, got {val!r}",
+            )
         for key in ("engine.compaction.fold", "engine.compaction.background",
+                    "engine.fused_dispatch",
                     "engine.columnar_batch", "engine.coalesce_pipeline"):
             val = self.get(key)
             if not isinstance(val, bool):

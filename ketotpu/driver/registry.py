@@ -578,6 +578,15 @@ class Registry:
                         arena=int(self.config.get("engine.arena")),
                         max_batch=int(self.config.get("engine.max_batch")),
                         retry_scale=int(self.config.get("engine.retry_scale")),
+                        # serving default ON (schema default true): the
+                        # constructor default is off for directly-built
+                        # engines, the config decides for the daemon
+                        fused_dispatch=bool(
+                            self.config.get("engine.fused_dispatch", True)
+                        ),
+                        fused_retry_lanes=int(
+                            self.config.get("engine.fused_retry_lanes", 1)
+                        ),
                         metrics=self.metrics(),
                         result_cache=self.result_cache(),
                         leopard={
@@ -960,6 +969,16 @@ class Registry:
                 help="projection checkpoint save failures")
         m.gauge("keto_engine_dispatches", eng.dispatches,
                 help="device batch dispatches")
+        # fused tiered dispatch (engine/fused.py): whole-cascade waves
+        # and per-tier row attribution from the returned device masks
+        m.gauge("keto_fused_waves_total", eng.fused_waves,
+                help="waves dispatched as one fused device program")
+        m.gauge("keto_fused_d2h_fetches_total", eng.fused_d2h_fetches,
+                help="device-to-host fetches for fused waves (1 per wave)")
+        for tier, rows in eng.fused_tier_rows.items():
+            m.gauge("keto_fused_tier_rows_total", rows,
+                    help="fused-wave rows attributed per answering tier",
+                    tier=tier)
         m.gauge("keto_engine_projection_build_seconds",
                 eng.projection_build_s,
                 help="host-side snapshot projection build wall time")

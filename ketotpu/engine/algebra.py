@@ -665,13 +665,7 @@ def run_general_packed_timed(g, qpack, *, timer=None, **kw):
     return out
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=(
-        "sizes", "fast_b", "fast_sched", "max_width", "vcap", "shard",
-    ),
-)
-def run_general_packed(
+def _general_body(
     g: Dict[str, jax.Array],
     qpack,
     *,
@@ -683,6 +677,10 @@ def run_general_packed(
     shard: Tuple[str, int] = None,
 ):
     """One fused dispatch answering a whole general (AND/NOT) batch.
+
+    Non-jitted body so engine/fused.py can inline it as the general tier
+    of the single-program wave cascade; ``run_general_packed`` below is
+    the jitted standalone entry the unfused path dispatches.
 
     ``qpack``: int32[6, Q] (ns, obj, rel, subj, depth, active).
     ``sizes``: per-level task capacities for levels 1..D (level 0 = Q).
@@ -903,3 +901,11 @@ def run_general_packed(
         + fast_occ
     )
     return codes, occ
+
+
+run_general_packed = functools.partial(
+    jax.jit,
+    static_argnames=(
+        "sizes", "fast_b", "fast_sched", "max_width", "vcap", "shard",
+    ),
+)(_general_body)

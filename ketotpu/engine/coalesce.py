@@ -515,6 +515,14 @@ class CoalescingEngine:
         leo_before = int(getattr(inner, "leopard_answered", 0) or 0)
         fb_before = int(getattr(inner, "fallbacks", 0) or 0)
         phase_before = dict(getattr(inner, "phase_seconds", None) or {})
+        # fused tiered dispatch (engine/fused.py): per-wave deltas of the
+        # fused-wave count, its D2H fetches (the single-fetch invariant is
+        # checked as waves == fetches) and the per-tier row attribution
+        fused_before = (
+            int(getattr(inner, "fused_waves", 0) or 0),
+            int(getattr(inner, "fused_d2h_fetches", 0) or 0),
+            dict(getattr(inner, "fused_tier_rows", None) or {}),
+        )
         # per-shard wave accounting (mesh serving): routed-root deltas
         # across this wave's dispatches land in the ledger entry
         routes_fn = getattr(inner, "shard_route_counts", None)
@@ -602,7 +610,7 @@ class CoalescingEngine:
                 self._file_wave(
                     wave_id, wave, len(prepared), device_s,
                     leo_before, fb_before, phase_before,
-                    shards=shard_delta,
+                    shards=shard_delta, fused_before=fused_before,
                 )
             except Exception:  # noqa: BLE001 - diagnostics must never
                 pass  # take down the wave worker
@@ -683,11 +691,14 @@ class CoalescingEngine:
 
     def _file_wave(self, wave_id: int, wave: List[_Slot], n_groups: int,
                    device_s: float, leo_before: int, fb_before: int,
-                   phase_before: dict, shards: Optional[dict] = None) -> None:
+                   phase_before: dict, shards: Optional[dict] = None,
+                   fused_before: Optional[tuple] = None) -> None:
         """One ledger record per wave: occupancy, waits, device time,
         short-circuit counts, engine phase deltas, slowest traceparents —
         and, when the inner engine is sharded, the per-shard routed-root
-        deltas this wave produced."""
+        deltas this wave produced.  Fused-dispatch waves additionally
+        carry the per-tier attribution deltas the single D2H fetch
+        returned."""
         inner = self.inner
         waits = sorted(
             (s.t_dispatch - s.t_enq) for s in wave
@@ -710,6 +721,21 @@ class CoalescingEngine:
              if s.t_dispatch is not None and s.traceparent is not None),
             key=lambda s: s.t_dispatch - s.t_enq, reverse=True,
         )[:3]
+        fused = {"waves": 0, "d2h_fetches": 0, "tiers": {}}
+        if fused_before is not None:
+            fw, fd, ftiers = fused_before
+            fused["waves"] = max(
+                0, int(getattr(inner, "fused_waves", 0) or 0) - fw
+            )
+            fused["d2h_fetches"] = max(
+                0, int(getattr(inner, "fused_d2h_fetches", 0) or 0) - fd
+            )
+            now = dict(getattr(inner, "fused_tier_rows", None) or {})
+            fused["tiers"] = {
+                t: d for t, d in (
+                    (t, int(now[t]) - int(ftiers.get(t, 0))) for t in now
+                ) if d > 0
+            }
         self.ledger.record({
             "wave": wave_id,
             "size": len(wave),
@@ -737,6 +763,7 @@ class CoalescingEngine:
             ),
             "errors": sum(1 for s in wave if s.error is not None),
             "shards": shards or {},
+            "fused": fused,
             "phase_ms": phase_ms,
             "slowest": [
                 {

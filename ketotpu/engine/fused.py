@@ -1,0 +1,271 @@
+"""Fused tiered dispatch: one compiled device program per wave.
+
+The unfused cascade resolves a wave as host leopard probe ->
+``fp.run_fast_packed`` + D2H fetch -> ``_run_general`` + second D2H
+fetch -> optional width-escalation re-runs, each separated by a host
+sync (engine/tpu.py).  On a tunneled host link every one of those syncs
+costs real round-trip latency, and the three tiers cannot overlap; the
+inter-tier sync tax is the largest remaining on-device latency lever
+(BENCH_r05: engine wave p50 ~3.3 ms, general 37.9k checks/s vs 87k
+fast-path).
+
+This module compiles the whole cascade into ONE program:
+
+* **tier 0 — leopard closure probe**: an in-program binary search over
+  the already-shipped packed pair arrays (leopard/device.py
+  ``probe_in_program``).  The host keeps the half of ``answer_checks``
+  that needs dict state (taint/dirty sets, the delta pair dict, the
+  rewrite test) and ships it as one int32 probe mode per row
+  (closure.LM_*, ``prep_fused_checks``); the device finishes the clean
+  rows with the exact base formula.  The split is bit-identical to the
+  host path by construction.
+* **tier 1 — fast BFS** (``fp._fused_body``): runs with the leopard
+  answered-mask folded into its active mask, so closure-answered rows
+  are dead weight inside the program instead of host-filtered between
+  dispatches.  Width escalation happens as ``retry_lanes`` bounded
+  in-program re-runs at the boosted schedule: the overflow tail
+  re-walks at retry capacity without a host round-trip, found bits
+  accumulate monotonically (a tier-1 IS can never be revoked).
+* **tier 2 — general algebra** (``alg._general_body``): the AND/NOT
+  rows run done-masked in the same program, plus one boosted retry
+  lane mirroring the unfused general overflow re-run.
+
+Exactly ONE D2H fetch returns everything the collector needs: per-row
+verdict codes AND per-tier attribution masks packed into one int32 bit
+field, concatenated with the two occupancy vectors the adaptive
+scheduler feeds on.  Layout of the returned int32[Q + F + G] array
+(Q = padded wave rows, F = len(fast_sched), G = general occ length):
+
+=====  ==========================================================
+bits   per-row meaning (first Q entries)
+=====  ==========================================================
+0-1    general R_* verdict code (post-retry)
+2      general over (post-retry, folds retry dirty/ERR)
+3      general dirty (tier-1: overlay-stale state touched)
+4      fast found (monotone across retry lanes)
+5      fast fallback (dirty-unfound or still-over after retries)
+6      leopard answered
+7      leopard allowed
+8      fast row entered a retry lane
+9      general row entered the retry lane
+=====  ==========================================================
+
+Semantics are preserved bit-for-bit against the unfused cascade: the
+three-valued MembershipUnknown routing under depth/width truncation is
+the same formula on the same masks, over/dirty rows flow to the same
+host oracle, and the per-tier masks keep ``note_tier`` tracing,
+wave-ledger tier deltas and the leopard counters exact (counters
+increment at collect time from the returned masks, so totals match the
+unfused dispatch-time increments).
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ketotpu import compilewatch
+from ketotpu.engine import algebra as alg
+from ketotpu.engine import fastpath as fp
+from ketotpu.engine.optable import R_ERR
+from ketotpu.leopard import device as leodev
+from ketotpu.leopard.closure import (
+    LM_ALLOW,
+    LM_DENY,
+    LM_HIT_ONLY,
+    LM_PROBE,
+)
+
+
+def _wave_body(
+    g: Dict[str, jax.Array],
+    qpack,
+    *,
+    fast_sched: Tuple[Tuple[int, int], ...],
+    retry_sched: Optional[Tuple[Tuple[int, int], ...]],
+    retry_lanes: int,
+    gen: Tuple,
+    gen_retry: Optional[Tuple],
+    max_width: int,
+    depth_slack: int,
+):
+    """The whole wave cascade, traced once.
+
+    ``qpack``: int32[10, Q] — ns, obj, rel, subj, depth, fast-eligible,
+    general, leopard probe mode (closure.LM_*), leopard probe set id,
+    leopard probe element id.  The probe ids are -1 on rows the probe
+    must miss (ineligible, unknown node/subject — consistent with the
+    host path, where a -1 key can never match a non-negative pair).
+
+    Absent tiers compile OUT of the program: ``fast_sched=None`` drops
+    tier 1 (and its retry lanes), ``gen=None`` drops tier 2 (and its
+    retry lane), and a ``g`` without the leopard columns drops tier 0.
+    The dispatcher gates on which row classes the wave actually holds —
+    XLA compile cost is superlinear in module size, so an all-fast wave
+    must not pay for a traced-but-masked general skeleton.
+    """
+    q_ns, q_obj, q_rel, q_subj, q_depth = (
+        qpack[0], qpack[1], qpack[2], qpack[3], qpack[4]
+    )
+    fast_elig = qpack[5].astype(bool)
+    gact = qpack[6].astype(bool)
+    lmode = qpack[7]
+    Q = qpack.shape[1]
+    ones = jnp.ones((Q,), bool)
+    zeros = jnp.zeros((Q,), bool)
+
+    # -- tier 0: leopard closure probe -------------------------------------
+    # every real row of a chunk shares one rest_depth and row 0 is always
+    # real (padding is appended), so q_depth[0] is the scalar the host
+    # formula uses
+    if "leo_sets" in g:
+        hit, hop = leodev.probe_in_program(
+            g["leo_sets"], g["leo_elts"], g["leo_hops"],
+            qpack[8], qpack[9],
+        )
+        ok_depth = hop.astype(jnp.int32) + depth_slack <= q_depth[0]
+    else:
+        hit = zeros
+        ok_depth = zeros
+    leo_ans = jnp.select(
+        [lmode == LM_PROBE, lmode == LM_ALLOW, lmode == LM_DENY,
+         lmode == LM_HIT_ONLY],
+        [ok_depth | ~hit, ones, ones, hit & ok_depth],
+        zeros,
+    )
+    leo_allow = jnp.select(
+        [lmode == LM_PROBE, lmode == LM_ALLOW, lmode == LM_HIT_ONLY],
+        [(ok_depth | ~hit) & hit, ones, hit & ok_depth],
+        zeros,
+    )
+
+    # -- tier 1: fast BFS, leopard answers done-masked ---------------------
+    found = zeros
+    fast_fb = zeros
+    retried = zeros
+    occ_tail = []
+    if fast_sched is not None:
+        fast_act = fast_elig & ~leo_ans
+        fres, focc = fp._fused_body(
+            g, q_ns, q_obj, q_rel, q_subj, q_depth, fast_act,
+            schedule=fast_sched, max_width=max_width,
+        )
+        found1, dirty1 = fres.found, fres.dirty
+        found = found1
+        # in-program width escalation: the overflow tail re-walks at
+        # retry capacity inside the same program (the unfused path pays
+        # a host round-trip to gather/re-pad it); found is monotone, so
+        # lanes only ever add verdicts
+        unres = fast_act & fres.over & ~found1 & ~dirty1
+        for _ in range(retry_lanes):
+            retried = retried | unres
+            rres, _rocc = fp._fused_body(
+                g, q_ns, q_obj, q_rel, q_subj, q_depth, unres,
+                schedule=retry_sched, max_width=max_width,
+            )
+            found = found | (unres & rres.found)
+            unres = unres & (rres.over | rres.dirty) & ~rres.found
+        fast_fb = (fast_act & dirty1 & ~found1) | unres
+        occ_tail.append(focc)
+
+    # -- tier 2: general algebra, done-masked ------------------------------
+    izeros = jnp.zeros((Q,), jnp.int32)
+    gcode = izeros
+    gover = zeros
+    gdirty = zeros
+    gen_retried = zeros
+    if gen is not None:
+        gpack = jnp.stack(
+            [q_ns, q_obj, q_rel, q_subj, q_depth, gact.astype(jnp.int32)]
+        )
+        gcodes, gocc = alg._general_body(
+            g, gpack, sizes=gen[0], fast_b=gen[1], fast_sched=gen[2],
+            max_width=max_width, vcap=gen[3],
+        )
+        gcode = (gcodes & 3).astype(jnp.int32)
+        gover = ((gcodes >> 2) & 1).astype(bool)
+        gdirty = ((gcodes >> 3) & 1).astype(bool)
+        if gen_retry is not None:
+            gunres = gact & gover & ~gdirty & (gcode != R_ERR)
+            gen_retried = gunres
+            rpack = jnp.stack(
+                [q_ns, q_obj, q_rel, q_subj, q_depth,
+                 gunres.astype(jnp.int32)]
+            )
+            rcodes, _rgocc = alg._general_body(
+                g, rpack, sizes=gen_retry[0], fast_b=gen_retry[1],
+                fast_sched=gen_retry[2], max_width=max_width,
+                vcap=gen_retry[3],
+            )
+            rcode = (rcodes & 3).astype(jnp.int32)
+            rover = ((rcodes >> 2) & 1).astype(bool)
+            rdirty = ((rcodes >> 3) & 1).astype(bool)
+            gcode = jnp.where(gunres, rcode, gcode)
+            gover = jnp.where(
+                gunres, rover | rdirty | (rcode == R_ERR), gover
+            )
+        occ_tail.append(gocc)
+
+    rows = (
+        gcode
+        | (gover.astype(jnp.int32) << 2)
+        | (gdirty.astype(jnp.int32) << 3)
+        | (found.astype(jnp.int32) << 4)
+        | (fast_fb.astype(jnp.int32) << 5)
+        | (leo_ans.astype(jnp.int32) << 6)
+        | (leo_allow.astype(jnp.int32) << 7)
+        | (retried.astype(jnp.int32) << 8)
+        | (gen_retried.astype(jnp.int32) << 9)
+    )
+    return jnp.concatenate([rows, *occ_tail])
+
+
+_run_wave = functools.partial(
+    jax.jit,
+    static_argnames=(
+        "fast_sched", "retry_sched", "retry_lanes", "gen", "gen_retry",
+        "max_width", "depth_slack",
+    ),
+)(_wave_body)
+
+
+def run_fused_wave(
+    g: Dict[str, jax.Array],
+    qpack: np.ndarray,
+    *,
+    fast_sched: Tuple[Tuple[int, int], ...],
+    retry_sched: Optional[Tuple[Tuple[int, int], ...]],
+    retry_lanes: int,
+    gen: Tuple,
+    gen_retry: Optional[Tuple],
+    max_width: int = 100,
+    depth_slack: int = 2,
+    timer=None,
+):
+    """Dispatch one fused wave; returns the UNCOLLECTED int32 device array
+    (the caller's single ``np.asarray`` is the wave's one D2H fetch).
+    ``timer`` receives the dispatch's host wall seconds (trace/compile on
+    a fresh shape, async enqueue after)."""
+    Q = qpack.shape[1]
+    t0 = time.perf_counter()
+    with compilewatch.scope(
+        "fused_wave",
+        lambda: (
+            f"Q={Q} fast={fast_sched} retry={retry_sched}x{retry_lanes} "
+            f"gen={gen} genr={gen_retry} width={max_width}"
+        ),
+    ):
+        out = _run_wave(
+            g, qpack,
+            fast_sched=fast_sched, retry_sched=retry_sched,
+            retry_lanes=retry_lanes, gen=gen, gen_retry=gen_retry,
+            max_width=max_width, depth_slack=depth_slack,
+        )
+    if timer is not None:
+        timer(time.perf_counter() - t0)
+    return out

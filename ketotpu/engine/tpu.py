@@ -71,6 +71,7 @@ from ketotpu.cache import check_key as cache_check_key
 from ketotpu.engine import algebra as alg
 from ketotpu.engine import delta as dl
 from ketotpu.engine import fastpath as fp
+from ketotpu.engine import fused as fdx
 from ketotpu.engine.optable import R_ERR, R_IS
 from ketotpu.engine.oracle import (
     DEFAULT_MAX_DEPTH,
@@ -165,6 +166,8 @@ class DeviceCheckEngine:
         retry_scale: int = 4,
         gen_levels: int = 12,
         gen_levels_max: int = 24,
+        fused_dispatch: bool = False,
+        fused_retry_lanes: int = 1,
         metrics=None,
         leopard: Optional[dict] = None,
         result_cache=None,
@@ -230,6 +233,28 @@ class DeviceCheckEngine:
         # is a few %; underestimates cost one retry dispatch for the
         # overflow tail, so a tight margin wins
         self.occ_headroom = 1.15
+        # fused tiered dispatch (engine/fused.py): the whole wave cascade
+        # (leopard probe -> fast BFS -> general algebra, with in-program
+        # retry lanes) compiles into ONE device program with ONE D2H
+        # fetch.  The unfused cascade stays as the fallback/oracle path
+        # (flag off, mesh engine, diagnostic surfaces).  The SERVING
+        # default is ON — the driver wires engine.fused_dispatch
+        # (spec/config.schema.json, default true) through the registry;
+        # the constructor default stays off so directly-built engines
+        # (tests, diagnostic tooling, one-shot scripts) keep the
+        # per-tier programs, whose XLA modules compile several times
+        # faster — the fused module's compile cost is superlinear in
+        # its size, prohibitive on XLA:CPU for throwaway engines.
+        self.fused_dispatch = bool(fused_dispatch)
+        self.fused_retry_lanes = max(int(fused_retry_lanes), 0)
+        self.fused_waves = 0  # observability: fused waves collected
+        self.fused_d2h_fetches = 0  # observability: D2H fetches (1/wave)
+        # per-tier row attribution for fused waves, from the returned
+        # masks (keto_fused_tier_rows_total; wave-ledger tier deltas)
+        self.fused_tier_rows = {
+            "cache": 0, "leopard": 0, "fastpath": 0, "general": 0,
+            "oracle": 0,
+        }
         self.fallbacks = 0  # observability: host-fallback counter
         self.retries = 0  # observability: device-retry (tier-2) counter
         self.rebuilds = 0  # observability: full snapshot rebuilds
@@ -336,6 +361,9 @@ class DeviceCheckEngine:
 
     def _gen_timer(self, dt: float) -> None:
         self._phase("check_gen_dispatch", dt)
+
+    def _fused_timer(self, dt: float) -> None:
+        self._phase("check_fused_dispatch", dt)
 
     def _device_failure(self) -> None:
         self.device_failures += 1
@@ -1315,8 +1343,12 @@ class DeviceCheckEngine:
         self.leopard_hits += int(allowed.sum())
         return allowed, answered
 
-    def _dispatch(self, queries: Sequence[RelationTuple], rest_depth: int):
-        """Enqueue one chunk's device work; returns an uncollected handle."""
+    def _dispatch(self, queries: Sequence[RelationTuple], rest_depth: int,
+                  fused: Optional[bool] = None):
+        """Enqueue one chunk's device work; returns an uncollected handle.
+        ``fused`` overrides the engine flag per call (diagnostic surfaces
+        pin the unfused cascade: its host-side tiers are individually
+        observable)."""
         n = len(queries)
         if n == 0:
             return None
@@ -1326,6 +1358,12 @@ class DeviceCheckEngine:
         snap, dev_arrays, overlay_active, cursor = self._sync_view()
         enc = self._encode(snap, queries, rest_depth)
         err, general = self._classify(snap, enc[0], enc[2])
+        use_fused = self.fused_dispatch if fused is None else fused
+        if use_fused:
+            return self._dispatch_fused(
+                queries, rest_depth, dev_arrays, cursor, enc, err,
+                general, t_enc,
+            )
         # Leopard first: closure-eligible fast queries resolve as one
         # sorted-pair binary search and leave the device walk entirely
         # (their fast_active bit drops, so the BFS does no work for them)
@@ -1377,6 +1415,124 @@ class DeviceCheckEngine:
             gres = self._run_general(dev_arrays, enc, gi)
         return (enc, err, general, res, gi, gres, dev_arrays, occ, leo_res,
                 cache_res, cursor)
+
+    def _dispatch_fused(self, queries, rest_depth, dev_arrays, cursor,
+                        enc, err, general, t_enc):
+        """Fused branch of ``_dispatch``: the whole tier cascade (leopard
+        probe -> fast BFS -> general algebra, with bounded in-program
+        retry lanes) compiles into ONE device program (engine/fused.py)
+        with ONE D2H fetch at collect.  The host keeps only the leopard
+        work that needs dict state (closure.prep_fused_checks) and ships
+        it as per-row probe modes; answered-masks gate the later tiers
+        in-program, so resolved rows are dead weight instead of
+        host-filtered between dispatches.  Returns a MUTABLE list handle
+        (same slot layout as the unfused tuple): the collector writes
+        the decoded leopard/cache slots back so ``_note_tiers`` and
+        ``_cache_fill`` read them unchanged."""
+        n = len(queries)
+        q_ns, q_obj, q_rel, q_subj, q_depth = enc
+        lmode = np.zeros(n, np.int32)
+        leo_set = np.full(n, -1, np.int32)
+        leo_elt = np.full(n, -1, np.int32)
+        leo_dev = None
+        has_leo = False
+        if self._leopard is not None and not self.strict_mode:
+            with self._sync_lock:
+                idx = self._leopard
+                if idx is not None:
+                    has_leo = True
+                    nodes, node_hi = idx.node_ids_np(q_ns, q_obj, q_rel)
+                    leo_dev = self._leo_device
+                    if leo_dev is not None:
+                        lmode = idx.prep_fused_checks(
+                            nodes, q_subj, node_hi, rest_depth
+                        )
+                        probe_ok = (nodes >= 0) & (q_subj >= 0)
+                        leo_set = np.where(probe_ok, nodes, -1).astype(
+                            np.int32
+                        )
+                        leo_elt = np.where(probe_ok, q_subj, -1).astype(
+                            np.int32
+                        )
+                    else:
+                        # pairs never shipped (device put failed or the
+                        # index is empty): the host path answers, encoded
+                        # as pre-resolved modes — LM_ALLOW/LM_DENY need
+                        # no pairs on the device
+                        allowed, answered = idx.answer_checks(
+                            nodes, q_subj, node_hi, int(q_depth[0])
+                        )
+                        lmode[answered & allowed] = leo.LM_ALLOW
+                        lmode[answered & ~allowed] = leo.LM_DENY
+        lmode[err | general] = leo.LM_NONE
+        # the cache sees every row the host KNOWS is unanswered; rows the
+        # device probe may yet answer keep leopard precedence at collect
+        pre_ans = (lmode == leo.LM_ALLOW) | (lmode == leo.LM_DENY)
+        cache_res = self._cache_consult(
+            queries, rest_depth, err, general,
+            (None, pre_ans) if has_leo else None, cursor,
+        )
+        fast_elig = ~(err | general)
+        if cache_res is not None:
+            fast_elig &= ~cache_res[0]
+            general = general & ~cache_res[0]
+        qpad = min(_bucket(n), self.frontier)
+        padded = self._pad(enc, n, qpad)
+        pad = qpad - n
+        qpack = np.stack([
+            *padded,
+            np.pad(fast_elig, (0, pad)).astype(np.int32),
+            np.pad(general, (0, pad)).astype(np.int32),
+            np.pad(lmode, (0, pad)),
+            np.pad(leo_set, (0, pad), constant_values=-1),
+            np.pad(leo_elt, (0, pad), constant_values=-1),
+        ]).astype(np.int32)
+        # tiers the wave doesn't hold compile OUT of the program — XLA
+        # compile cost is superlinear in module size, and an all-fast
+        # wave must not pay for a traced-but-masked general skeleton.
+        # Retry lanes stay in whenever their base tier is in: overflow
+        # is only knowable on device, and the lane firing on zero rows
+        # is free at run time.
+        fast_sched = retry_sched = None
+        lanes = 0
+        if fast_elig.any():
+            fast_sched = fp.level_schedule(
+                qpad, self.frontier, self.arena, self.max_depth, 1,
+                self._adaptive_mults(),
+            )
+            lanes = self.fused_retry_lanes if self.retry_scale > 1 else 0
+            if lanes:
+                retry_sched = fp.level_schedule(
+                    qpad, self.retry_scale * self.frontier,
+                    self.retry_scale * self.arena, self.max_depth,
+                    self.retry_scale,
+                )
+        gen = gen_retry = None
+        if general.any():
+            gen = self._gen_schedule(qpad, 1)
+            if self.retry_scale > 1 and self.fused_retry_lanes > 0:
+                gen_retry = self._gen_schedule(qpad, self.retry_scale)
+        g = dev_arrays
+        if leo_dev is not None:
+            g = dict(dev_arrays, leo_sets=leo_dev["sets"],
+                     leo_elts=leo_dev["elts"], leo_hops=leo_dev["hops"])
+        self._phase("check_encode", time.perf_counter() - t_enc)
+        fres = fdx.run_fused_wave(
+            g, qpack,
+            fast_sched=fast_sched, retry_sched=retry_sched,
+            retry_lanes=lanes, gen=gen, gen_retry=gen_retry,
+            max_width=self.max_width, depth_slack=leo.DEPTH_SLACK,
+            timer=self._fused_timer,
+        )
+        meta = {
+            "n": n, "qpad": qpad, "has_leo": has_leo,
+            "flen": len(fast_sched) if fast_sched is not None else 0,
+            "glen": (len(gen[0]) + 2 + len(gen[2])) if gen is not None
+                    else 0,
+            "gen_fast_b": gen[1] if gen is not None else 0,
+        }
+        return [enc, err, general, fres, None, meta, dev_arrays, None,
+                None, cache_res, cursor]
 
     def _cache_consult(self, queries, rest_depth, err, general, leo_res,
                        cursor):
@@ -1591,6 +1747,8 @@ class DeviceCheckEngine:
         The retry runs against the handle's own device arrays — a write
         landing between dispatch and retry must not pair these encodings
         with a newer projection."""
+        if isinstance(handle, list):  # fused wave (mutable list handle)
+            return self._collect_fused(handle)
         (enc, err, general, res, gi, gres, dev_arrays, occ, leo_res,
          cache_res, _cursor) = handle
         n = err.shape[0]
@@ -1697,6 +1855,86 @@ class DeviceCheckEngine:
         fallback |= unres
         return allowed, fallback
 
+    def _collect_fused(self, handle):
+        """Sync one fused wave: ONE D2H fetch returns the verdict codes
+        AND the per-tier attribution masks (engine/fused.py bit layout).
+        Decode, feed the occupancy EMAs, update the leopard/retry
+        counters from the returned masks (totals match the unfused
+        dispatch-time increments exactly), and write the decoded
+        leopard/cache slots back into the mutable handle so
+        ``_note_tiers`` and ``_cache_fill`` work unchanged."""
+        (enc, err, general, fres, _gi, meta, _dev, _occ, _leo,
+         cache_res, _cursor) = handle
+        n = meta["n"]
+        qpad = meta["qpad"]
+        t_sync = time.perf_counter()
+        packed = np.asarray(fres)  # the wave's single D2H fetch
+        self._phase("check_collect_sync", time.perf_counter() - t_sync)
+        self.fused_waves += 1
+        self.fused_d2h_fetches += 1
+        rows = packed[:n]
+        focc = packed[qpad:qpad + meta["flen"]]
+        gocc = packed[qpad + meta["flen"]:
+                      qpad + meta["flen"] + meta["glen"]]
+        gcode = (rows & 3).astype(np.int8)
+        gover = ((rows >> 2) & 1).astype(bool)
+        gdirty = ((rows >> 3) & 1).astype(bool)
+        found = ((rows >> 4) & 1).astype(bool)
+        fast_fb = ((rows >> 5) & 1).astype(bool)
+        leo_ans = ((rows >> 6) & 1).astype(bool)
+        leo_allow = ((rows >> 7) & 1).astype(bool)
+        retried = ((rows >> 8) & 1).astype(bool)
+        gen_retried = ((rows >> 9) & 1).astype(bool)
+        # occupancy EMA feeds (absent tiers ship no occupancy at all)
+        if meta["flen"]:
+            self._update_occ(focc)
+        if meta["glen"]:
+            self._update_gen_occ(gocc, meta["gen_fast_b"])
+        self.retries += int(retried.sum()) + int(gen_retried.sum())
+        leo_res = None
+        if meta["has_leo"]:
+            leo_res = (leo_allow, leo_ans)
+            self.leopard_answered += int(leo_ans.sum())
+            self.leopard_hits += int(leo_allow.sum())
+            handle[8] = leo_res
+            if cache_res is not None:
+                # leopard precedence: the unfused cascade never consults
+                # the cache for closure-answered rows, so a fused cache
+                # hit on one must not claim its verdict or attribution
+                cache_res = (cache_res[0] & ~leo_ans, cache_res[1])
+                handle[9] = cache_res
+        allowed = np.zeros(n, bool)
+        fallback = err.copy()
+        allowed[general] = (gcode == R_IS)[general]
+        fallback[general] |= (gover | gdirty | (gcode == R_ERR))[general]
+        fmask = ~(err | general)
+        allowed[fmask] = found[fmask]
+        if leo_res is not None:
+            allowed[leo_ans] = leo_allow[leo_ans]
+        if cache_res is not None:
+            allowed[cache_res[0]] = cache_res[1][cache_res[0]]
+            fallback &= ~cache_res[0]
+        # fast_fb is masked to the fast-active rows in-program, which
+        # already exclude leopard/cache-answered rows
+        fallback |= fast_fb
+        # per-tier row attribution from the returned masks — same
+        # precedence as _note_tiers (cache -> leopard -> oracle -> device)
+        tr = self.fused_tier_rows
+        seen = np.zeros(n, bool)
+        if cache_res is not None:
+            tr["cache"] += int(cache_res[0].sum())
+            seen |= cache_res[0]
+        if leo_res is not None:
+            tr["leopard"] += int(leo_ans.sum())
+            seen |= leo_ans
+        orc = (fallback | err) & ~seen
+        tr["oracle"] += int(orc.sum())
+        seen |= orc
+        rest = ~seen
+        tr["general"] += int((rest & general).sum())
+        tr["fastpath"] += int((rest & ~general).sum())
+        return allowed, fallback
+
     def _note_tiers(self, handle, fallback) -> np.ndarray:
         """Attribute this chunk's verdicts to the tier that answered them
         (request-anatomy tracing + shadow-plane provenance): cache hits,
@@ -1708,6 +1946,10 @@ class DeviceCheckEngine:
         seen = np.zeros(err.shape[0], bool)
         if flightrec.current() is None:
             return seen
+        if isinstance(handle, list):
+            # fused-wave handle: stamp the request's shadow provenance so
+            # a divergence localizes to the fused program vs the cascade
+            flightrec.note_fused()
         if cache_res is not None and cache_res[0].any():
             flightrec.note_tier("cache", int(cache_res[0].sum()))
             seen |= cache_res[0]
@@ -1869,8 +2111,10 @@ class DeviceCheckEngine:
         self, queries: Sequence[RelationTuple], rest_depth: int = 0, retry: bool = True
     ):
         """Device verdicts without oracle fallback: (allowed[], fallback_needed[]).
-        Test/diagnostic surface."""
-        handle = self._dispatch(list(queries), rest_depth)
+        Test/diagnostic surface — pinned to the unfused cascade, whose
+        host-side tiers honor ``retry=False`` individually (the fused
+        program's retry lanes are compiled in)."""
+        handle = self._dispatch(list(queries), rest_depth, fused=False)
         if handle is None:
             return [], []
         allowed, fallback = self._collect(handle, retry=retry)

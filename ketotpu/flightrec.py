@@ -197,6 +197,15 @@ def note_tier(tier: str, n: int = 1) -> None:
     ctx.info["tier"] = max(tiers.items(), key=lambda kv: kv[1])[0]
 
 
+def note_fused() -> None:
+    """Mark the current RPC as served by a fused-dispatch wave
+    (engine/fused.py): shadow divergence records carry the flag so a
+    lying verdict localizes to the fused program vs the tier cascade."""
+    ctx = getattr(_local, "ctx", None)
+    if ctx is not None:
+        ctx.info["fused"] = True
+
+
 def force_promote(reason: str) -> None:
     """Mark the current request's trace for promotion regardless of its
     latency (e.g. a synchronous shadow divergence)."""

@@ -58,6 +58,18 @@ from ketotpu.api.types import RelationTuple, SubjectSet
 # happens one level below the last expansion).
 DEPTH_SLACK = 2
 
+# Fused-dispatch probe row modes (engine/fused.py): prep_fused_checks
+# resolves everything that needs host dict state (taint/dirty sets, the
+# delta pair dict, the rewrite-eligibility test) into one int32 mode per
+# row; the device finishes the clean rows with its in-program binary
+# search.  The split is bit-identical to answer_checks by construction —
+# see prep_fused_checks for the per-mode argument.
+LM_NONE = 0  # ineligible: the index must not answer this row
+LM_PROBE = 1  # clean, no delta pair: device formula answers
+LM_ALLOW = 2  # pre-answered allow (delta pair within the depth budget)
+LM_DENY = 3  # pre-answered deny (unknown node, rewrite-free relation)
+LM_HIT_ONLY = 4  # delta pair beyond budget: answer only on base hit+depth
+
 _EMPTY32 = np.empty(0, np.int32)
 
 
@@ -512,6 +524,79 @@ class ClosureIndex:
             answered[kn] = ans_k
             allowed[kn] = ans_k & hit
         return allowed, answered
+
+    def prep_fused_checks(
+        self,
+        nodes: np.ndarray,
+        subjects: np.ndarray,
+        node_hi: np.ndarray,
+        rest_depth: int,
+    ) -> np.ndarray:
+        """Host half of ``answer_checks`` for the fused wave cascade:
+        int32 probe modes (LM_*), one per row.  Everything that needs
+        dict state resolves here; the device finishes LM_PROBE /
+        LM_HIT_ONLY rows with the in-program binary search over the
+        shipped pairs.  Mode-by-mode equivalence with answer_checks:
+
+        * LM_DENY — unknown node, rewrite-free relation: answer_checks
+          denies unconditionally, so the device can too.
+        * LM_PROBE — clean node, no delta pair: the device computes the
+          exact base formula ``ans = ok_depth | ~hit, allow = ans & hit``.
+        * LM_ALLOW — delta pair within the depth budget: answer_checks
+          allows whether or not the base probe hits (a base hit within
+          budget allows directly; otherwise the delta supplies the hit
+          with an in-budget hop), so the verdict is device-independent.
+        * LM_HIT_ONLY — delta pair beyond the budget: answer_checks
+          answers only when the base probe hits within budget (otherwise
+          the delta forces ``hit`` with a too-deep hop and the row
+          declines), which is exactly ``ans = allow = hit & ok_depth``.
+        * LM_NONE — tainted/dirty node, or unknown node with a reachable
+          rewrite: answer_checks declines, the device must not answer.
+
+        The dirty-set decline counter increments here with the same
+        coverage as answer_checks (all known rows at probe time).
+        """
+        n = len(nodes)
+        lmode = np.zeros(n, np.int32)
+        if n == 0:
+            return lmode
+        known = nodes >= 0
+        if self._rewrite_his:
+            rw = np.isin(
+                node_hi,
+                np.fromiter(
+                    self._rewrite_his, np.int64, len(self._rewrite_his)
+                ),
+            )
+        else:
+            rw = np.zeros(n, bool)
+        lmode[~known & ~rw] = LM_DENY
+        if known.any() and self.n_nodes:
+            kn = np.flatnonzero(known)
+            node_k = nodes[kn]
+            clean = ~self.tainted[node_k]
+            if self._d_taint or self.dirty:
+                bad = self._d_taint | self.dirty
+                clean &= ~np.isin(
+                    node_k, np.fromiter(bad, np.int64, len(bad))
+                )
+            if self.dirty:
+                darr = np.fromiter(self.dirty, np.int64, len(self.dirty))
+                self.fallbacks += int(np.isin(node_k, darr).sum())
+            mode_k = np.where(clean, LM_PROBE, LM_NONE).astype(np.int32)
+            if self._d_elt:
+                for j in np.flatnonzero(clean).tolist():
+                    dh = self._d_elt.get(
+                        (int(node_k[j]), int(subjects[kn[j]]))
+                    )
+                    if dh is not None:
+                        mode_k[j] = (
+                            LM_ALLOW
+                            if dh + DEPTH_SLACK <= rest_depth
+                            else LM_HIT_ONLY
+                        )
+            lmode[kn] = mode_k
+        return lmode
 
     # ----------------------------------------------------- incremental
 

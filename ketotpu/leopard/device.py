@@ -43,10 +43,15 @@ except Exception:  # pragma: no cover
 DEVICE_PROBE_MIN = 2048
 
 
-#: pairs-array pad sentinel: sorts after every real packed key (set and
-#: element ids are non-negative int32, so real keys are < 2**62) and can
-#: never equal one, keeping the searchsorted hit test exact on padding
-_PAIR_PAD = np.iinfo(np.int64).max
+#: pairs-column pad sentinel: sorts after every real id (set and element
+#: ids are non-negative int32 well below the ceiling) and can never equal
+#: one, keeping the binary-search hit test exact on padding.  The pairs
+#: ship as TWO sorted int32 columns (set, element) rather than the host's
+#: packed int64 keys: with jax's default x64-disabled config a device_put
+#: int64 array silently truncates to int32, which both destroys the pad
+#: sentinel (int64 max -> -1, sorted FIRST) and overflows the
+#: ``set << 32 | element`` packing itself.
+_PAIR_PAD = np.iinfo(np.int32).max
 
 
 def _pair_bucket(n: int, floor: int = 1024) -> int:
@@ -60,19 +65,26 @@ def _pair_bucket(n: int, floor: int = 1024) -> int:
 
 
 def ship_pairs(index) -> Optional[dict]:
-    """Device-put the closure pair arrays (padded to a power-of-two
-    bucket); None when jax is unavailable or the index is empty."""
+    """Device-put the closure pair columns (padded to a power-of-two
+    bucket); None when jax is unavailable or the index is empty.  The
+    host's sorted packed int64 keys split into two int32 columns with the
+    same lexicographic order (the packing IS the lexicographic order of
+    its halves), so a two-column binary search visits the same positions
+    the host searchsorted does."""
     if not _HAS_JAX or index is None or len(index.elt_packed) == 0:
         return None
     try:
         n = len(index.elt_packed)
         cap = _pair_bucket(n)
-        pairs = np.full(cap, _PAIR_PAD, np.int64)
-        pairs[:n] = index.elt_packed
-        hops = np.zeros(cap, index.elt_hop.dtype)
+        sets = np.full(cap, _PAIR_PAD, np.int32)
+        elts = np.full(cap, _PAIR_PAD, np.int32)
+        sets[:n] = (index.elt_packed >> 32).astype(np.int32)
+        elts[:n] = (index.elt_packed & 0x7FFFFFFF).astype(np.int32)
+        hops = np.zeros(cap, np.int32)
         hops[:n] = index.elt_hop
         return {
-            "pairs": jax.device_put(pairs),
+            "sets": jax.device_put(sets),
+            "elts": jax.device_put(elts),
             "hops": jax.device_put(hops),
         }
     except Exception:
@@ -81,28 +93,60 @@ def ship_pairs(index) -> Optional[dict]:
 
 if _HAS_JAX:
 
-    @jax.jit
-    def _probe(pairs, hops, keys):
-        idx = jnp.searchsorted(pairs, keys)
-        idx = jnp.clip(idx, 0, pairs.shape[0] - 1)
-        hit = pairs[idx] == keys
+    def probe_in_program(sets, elts, hops, q_set, q_elt):
+        """Traced (non-jitted) probe body: one lexicographic binary
+        search per query over the two sorted int32 pair columns
+        (equivalent to the host's searchsorted over the packed int64
+        keys, which jax's default x64-disabled config cannot represent
+        on device).  The fused wave cascade (engine/fused.py) inlines
+        this as its tier-0 phase — the probe then compiles INTO the wave
+        program instead of costing its own dispatch — and the standalone
+        ``_probe`` below jits the same body for the unfused path, so
+        both paths share one definition and stay bit-identical.  A query
+        set id of -1 (ineligible row) can never match: real ids are
+        non-negative and padding is ``_PAIR_PAD``.  The unrolled step
+        count is derived from the (static) padded capacity, so the
+        compiled search is exact for any occupancy."""
+        cap = sets.shape[0]
+        steps = max(int(cap).bit_length(), 1)
+        lo = jnp.zeros(q_set.shape, jnp.int32)
+        hi = jnp.full(q_set.shape, cap, jnp.int32)
+        for _ in range(steps):
+            mid = (lo + hi) >> 1
+            ms, me = sets[mid], elts[mid]
+            less = (ms < q_set) | ((ms == q_set) & (me < q_elt))
+            lo = jnp.where(less, mid + 1, lo)
+            hi = jnp.where(less, hi, mid)
+        idx = jnp.clip(lo, 0, cap - 1)
+        hit = (sets[idx] == q_set) & (elts[idx] == q_elt)
         return hit, jnp.where(hit, hops[idx], 0)
+
+    _probe = jax.jit(probe_in_program)
 
 
 def probe_pairs(
     dev: Optional[dict], keys: np.ndarray, pad_to: int
 ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
-    """Batched (hit, hop) via the device pairs; None => use host path."""
+    """Batched (hit, hop) via the device pairs; None => use host path.
+    ``keys`` is the host's packed int64 array (-1 = must-miss row); the
+    halves split into int32 columns for the device search."""
     if dev is None or not _HAS_JAX or len(keys) < DEVICE_PROBE_MIN:
         return None
     try:
-        padded = np.full(pad_to, -1, np.int64)
-        padded[: len(keys)] = keys
+        q_set = np.full(pad_to, -1, np.int32)
+        q_elt = np.full(pad_to, -1, np.int32)
+        q_set[: len(keys)] = (keys >> 32).astype(np.int32)
+        q_elt[: len(keys)] = (keys & 0x7FFFFFFF).astype(np.int32)
+        # a -1 key's high half is -1 (arithmetic shift), keeping the
+        # must-miss contract: no real set id is negative
+        q_elt[: len(keys)][keys < 0] = -1
         with compilewatch.scope(
             "leopard_probe",
-            lambda: f"pairs={dev['pairs'].shape[0]} pad={pad_to}",
+            lambda: f"pairs={dev['sets'].shape[0]} pad={pad_to}",
         ):
-            hit, hop = _probe(dev["pairs"], dev["hops"], padded)
+            hit, hop = _probe(
+                dev["sets"], dev["elts"], dev["hops"], q_set, q_elt
+            )
         hit = np.asarray(hit)[: len(keys)]
         hop = np.asarray(hop)[: len(keys)]
         return hit, hop

@@ -86,6 +86,10 @@ class MeshCheckEngine(DeviceCheckEngine):
         **kwargs,
     ):
         super().__init__(store, namespace_manager, **kwargs)
+        # the mesh overrides _dispatch/_collect wholesale (per-shard
+        # routing, all_to_all collectives); the single-program fused wave
+        # does not apply here, whatever the shared config says
+        self.fused_dispatch = False
         self.mesh = make_mesh(mesh_devices, axis=mesh_axis)
         if self.mesh.devices.size != mesh_devices:
             # make_mesh silently truncates to what exists; serving with
@@ -451,7 +455,9 @@ class MeshCheckEngine(DeviceCheckEngine):
             self._shard_fallbacks[s] = 0
             self.shard_recoveries += 1
 
-    def _dispatch(self, queries, rest_depth: int):
+    def _dispatch(self, queries, rest_depth: int, fused=None):
+        # ``fused`` accepted for base-class call compatibility and
+        # ignored: the sharded cascade has no fused-wave variant
         n = len(queries)
         if n == 0:
             return None

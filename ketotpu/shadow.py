@@ -131,6 +131,9 @@ class ShadowVerifier:
             "cursor": int(cursor),
             "tier": info.get("tier", "fastpath"),
             "tiers": dict(info.get("tiers") or {}),
+            # fused-dispatch provenance (engine/fused.py): a divergence on
+            # a fused wave indicts the one compiled program, not a tier
+            "fused": bool(info.get("fused", False)),
             "wave": info.get("wave", -1),
             "trace_id": getattr(ctx, "trace_id", None) if ctx else None,
             "traceparent": info.get("traceparent"),
@@ -193,6 +196,7 @@ class ShadowVerifier:
             "oracle": want,
             "tier": job["tier"],
             "tiers": job["tiers"],
+            "fused": job["fused"],
             "wave": job["wave"],
             "trace_id": job["trace_id"],
             "generation": job["generation"],

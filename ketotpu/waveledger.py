@@ -80,6 +80,17 @@ class WaveLedger:
         )
         devs = sorted(float(e.get("device_ms", 0.0)) for e in entries)
         n = len(entries)
+        # fused tiered dispatch (engine/fused.py): ring-wide sums of the
+        # per-wave deltas; `fused_waves == fused_d2h_fetches` IS the
+        # single-fetch-per-wave invariant the serving bench asserts
+        fused_waves = fused_d2h = 0
+        fused_tiers: Dict[str, int] = {}
+        for e in entries:
+            f = e.get("fused") or {}
+            fused_waves += int(f.get("waves", 0))
+            fused_d2h += int(f.get("d2h_fetches", 0))
+            for t, d in (f.get("tiers") or {}).items():
+                fused_tiers[t] = fused_tiers.get(t, 0) + int(d)
         return {
             "waves_recorded": recorded,
             "waves_in_ring": n,
@@ -90,4 +101,7 @@ class WaveLedger:
             "window_wait_ms_p95": round(_percentile(waits, 0.95), 3),
             "device_ms_p50": round(_percentile(devs, 0.50), 3),
             "device_ms_p95": round(_percentile(devs, 0.95), 3),
+            "fused_waves": fused_waves,
+            "fused_d2h_fetches": fused_d2h,
+            "fused_tier_rows": fused_tiers,
         }

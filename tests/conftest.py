@@ -23,6 +23,14 @@ if "xla_cpu_parallel_codegen_split_count" not in flags:
     flags = (flags + " --xla_cpu_parallel_codegen_split_count=1").strip()
 os.environ["XLA_FLAGS"] = flags
 
+# Fused tiered dispatch defaults ON for serving (engine/fused.py), but a
+# fused wave's one-program compile is several times a per-tier program's
+# on XLA:CPU — across every daemon-booting test here that would blow the
+# suite's compile budget (and raise the segfault-threshold program count).
+# Tests exercise the unfused cascade unless they opt in explicitly; fused
+# parity coverage lives in test_fused.py and the CI serve-northstar job.
+os.environ.setdefault("KETO_ENGINE_FUSED_DISPATCH", "false")
+
 # The env var alone does NOT win against the preinstalled TPU plugin in this
 # jax build (verified: a subprocess with JAX_PLATFORMS=cpu still gets the
 # axon TPU client); the config.update below does.
