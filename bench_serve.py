@@ -884,6 +884,401 @@ def run_sharded_bench(
     }
 
 
+def _wait_marker(path, timeout: float, what: str) -> None:
+    import os
+
+    deadline = time.monotonic() + timeout
+    while not os.path.exists(path):
+        if time.monotonic() > deadline:
+            raise RuntimeError(f"timed out waiting for {what}")
+        time.sleep(0.25)
+
+
+def run_multihost_child(spec_path: str) -> Dict:
+    """One owner host of the 2-host loopback mesh (ISSUE 14).  Driven by
+    ``run_multihost_bench`` as a subprocess; the JSON spec carries the
+    topology (shared sqlite DSN, both PeerLink addresses, this host's
+    id/role) and the phase directory both hosts coordinate through with
+    marker files.
+
+    Roles:
+
+    * ``victim`` — boots, warms its engine locally, marks itself ready,
+      then idles serving DCN frames until the parent kill -9s it (the
+      whole-host-failure half of the chaos bar).
+    * ``rejoin`` — the restarted victim: boots warm, marks ready, then
+      holds a steady-compile gate open from the ``gate_start`` marker to
+      ``stop`` — the driver hammers THROUGH it in that window, so the
+      gate proves a returning peer serves forwarded waves with ZERO
+      after-warm XLA compiles.
+    * ``driver`` — serves the gRPC hammer: divergence probes before the
+      kill, through it, and after the rejoin; the kill-window hammer and
+      the recovered-window hammer both run under steady-compile gates.
+    """
+    import os
+
+    from ketotpu.driver import Provider, Registry
+    from ketotpu.server import serve_all
+    from ketotpu.utils.synth import build_synth, synth_queries
+
+    with open(spec_path) as f:
+        spec = json.load(f)
+    role = spec["role"]
+    phase = spec["phase_dir"]
+    # the same deterministic synth graph the parent seeded the shared
+    # sqlite store from: used ONLY to generate requests
+    graph = build_synth(
+        n_users=1024, n_groups=64, n_folders=1024, n_docs=8192, seed=0
+    )
+    cfg = Provider(
+        {
+            "dsn": spec["dsn"],
+            "namespaces": {"location": spec["namespaces"]},
+            "serve": {
+                n: {"host": "127.0.0.1", "port": p}
+                for n, p in spec["serve_ports"].items()
+            },
+            "engine": {
+                "kind": "tpu",
+                "mesh_devices": int(spec["shards"]),
+                "frontier": 4096,
+                "arena": 16384,
+                "max_batch": 4096,
+                "coalesce_ms": 2,
+                "mesh": {
+                    "hosts": {
+                        "host_id": int(spec["host_id"]),
+                        "peers": list(spec["peers"]),
+                        "secret": spec["secret"],
+                        "heartbeat_ms": 200,
+                        "heartbeat_misses": 3,
+                        # generous: a first-shape frontier exchange may
+                        # sit behind an XLA:CPU compile on either side
+                        "rpc_timeout_ms": 240000,
+                    },
+                },
+            },
+            # leopard answers fast roots from the local closure index
+            # BEFORE cross-host routing is consulted — correct, but it
+            # would serve this synth graph entirely locally and leave
+            # the DCN lane untested; the lane-live gate below needs real
+            # cross-host traffic
+            "leopard": {"enabled": False},
+            "limit": {"max_inflight": 0},
+            "log": {"request_log": False},
+        }
+    )
+    reg = Registry(cfg).init()
+    srv = serve_all(reg)
+    try:
+        eng = reg.check_engine()
+        inner = getattr(eng, "inner", eng)
+        link = inner.hostlink
+
+        # warm the LOCAL cascade (XLA compiles) before anything crosses
+        # the lane: the local-serve scope pins the batch to this host
+        warm = synth_queries(graph, 512, seed=5)
+        inner._peer_serve_check(warm, 0)
+        # ...and at the <=256-row fast bucket forwarded sub-waves land in
+        inner._peer_serve_check(warm[:160], 0)
+
+        def probe_divergence(n: int, seed: int) -> int:
+            sample = synth_queries(graph, n, seed=seed)
+            served = eng.batch_check(sample)
+            want = [inner.oracle.check_is_member(q) for q in sample]
+            return sum(1 for g, w in zip(served, want) if g != w)
+
+        if role in ("victim", "rejoin"):
+            from bench import _steady
+
+            res: Dict = {"role": role, "host_id": spec["host_id"]}
+            open(os.path.join(phase, f"{role}_ready"), "w").close()
+            if role == "rejoin":
+                # hold the after-warm compile gate open across the
+                # driver's recovered-window hammer (forwarded waves land
+                # here the whole time)
+                _wait_marker(
+                    os.path.join(phase, "gate_start"), 600.0, "gate_start"
+                )
+                gate: Dict = {}
+                with _steady(gate, "serve_multihost_rejoin"):
+                    _wait_marker(
+                        os.path.join(phase, "stop"), 600.0, "stop marker"
+                    )
+                res["after_warm_compiles"] = int(
+                    gate.get("steady_state_compiles", {}).get(
+                        "serve_multihost_rejoin", 0
+                    )
+                )
+                res["peer"] = inner.mesh_stats()
+                with open(os.path.join(phase, "rejoin_result.json"), "w") as f:
+                    json.dump(res, f)
+            else:
+                _wait_marker(
+                    os.path.join(phase, "stop"), 600.0, "stop marker"
+                )
+            return res
+
+        # -- driver host --------------------------------------------------
+        from bench import _steady
+
+        conc = int(spec["concurrency"])
+        secs = float(spec["duration"])
+        host, port = srv.addresses["read"]
+        target = f"{host}:{port}"
+        requests = _build_requests(graph, 2048)
+
+        _wait_marker(
+            os.path.join(phase, "victim_ready"), 600.0, "victim boot"
+        )
+        # absorb first-shape compiles on BOTH sides of the lane, then
+        # prove the lane is live before the storm
+        div_a = probe_divergence(256, seed=9)
+        div_a += probe_divergence(256, seed=9)
+        routed_warm = int(inner.peer_route_counts().sum())
+        _hammer(target, requests, concurrency=conc,
+                duration=max(2.0, secs * 0.4))
+
+        # timed kill-window hammer: the parent kill -9s the victim
+        # mid-window; verdicts must stay exact (replica or oracle) and
+        # the wave must never block past its budget
+        open(os.path.join(phase, "hammer_start"), "w").close()
+        gate: Dict = {}
+        with _steady(gate, "serve_multihost"):
+            h_kill = _hammer(
+                target, requests, concurrency=conc, duration=secs
+            )
+        div_b = probe_divergence(256, seed=10)
+        kill_stats = inner.mesh_stats()
+
+        # recovery: the restarted victim marks ready, the heartbeat loop
+        # marks it up, rows route cross-host again
+        _wait_marker(
+            os.path.join(phase, "rejoin_ready"), 600.0, "victim rejoin"
+        )
+        recovered = False
+        deadline_t = time.monotonic() + 240.0
+        while time.monotonic() < deadline_t:
+            if inner.mesh_stats().get("hosts_down", 1) == 0:
+                recovered = True
+                break
+            time.sleep(0.5)
+        # settle pass re-warms the rejoined peer's forwarded shapes
+        # (unmeasured — long enough to play the coalescer's bucket
+        # spectrum onto the rejoiner), then the gated recovered-window
+        # hammer runs with the rejoin child's own after-warm compile
+        # gate open too
+        _hammer(target, requests, concurrency=conc,
+                duration=max(4.0, secs * 0.8))
+        open(os.path.join(phase, "gate_start"), "w").close()
+        gate2: Dict = {}
+        with _steady(gate2, "serve_multihost_recovered"):
+            h_rec = _hammer(
+                target, requests, concurrency=conc,
+                duration=max(3.0, secs * 0.5),
+            )
+        div_c = probe_divergence(256, seed=11)
+        open(os.path.join(phase, "stop"), "w").close()
+
+        ms = inner.mesh_stats()
+        return {
+            "role": "driver",
+            "rps": h_kill["rps"],
+            "p50_ms": h_kill["p50_ms"],
+            "p99_ms": h_kill["p99_ms"],
+            "errors": h_kill["errors"],
+            "recovered_rps": h_rec["rps"],
+            "recovered_p99_ms": h_rec["p99_ms"],
+            "divergence": div_a + div_b + div_c,
+            "steady_state_compiles": int(
+                gate.get("steady_state_compiles", {}).get(
+                    "serve_multihost", 0
+                )
+            ) + int(
+                gate2.get("steady_state_compiles", {}).get(
+                    "serve_multihost_recovered", 0
+                )
+            ),
+            "peer_routed_warm": routed_warm,
+            "peer_routed": int(ms.get("peer_routed", 0)),
+            "peer_fallbacks_kill_window": int(
+                kill_stats.get("peer_fallbacks", 0)
+            ),
+            "hosts_down_kill_window": int(kill_stats.get("hosts_down", 0)),
+            "recovery_observed": bool(recovered),
+            "peer_recoveries": int(ms.get("peer_recoveries", 0)),
+            "frontier_rtt_p50_ms": float(
+                ms.get("peer_frontier_rtt_p50_ms", 0.0)
+            ),
+        }
+    finally:
+        srv.stop(grace=2.0)
+
+
+def run_multihost_bench(
+    *,
+    concurrency: int = 64,
+    duration: float = 8.0,
+    shards: int = 4,
+) -> Dict:
+    """Cross-host mesh chaos sweep (ISSUE 14): two REAL owner processes
+    over a loopback DCN lane against one shared sqlite store.  The
+    driver host serves a concurrency-N gRPC hammer; mid-window the
+    parent kill -9s the victim host, then restarts it.  Gates: zero
+    verdict divergence across all three probes (before / during-kill /
+    after-rejoin), zero steady-state compiles on the driver, zero
+    after-warm compiles on the rejoined victim, and observable
+    recovery.  Reports the kill-window and recovered-window RPS/p99 and
+    the frontier round-trip p50."""
+    import os
+    import shutil
+    import signal
+    import subprocess
+    import tempfile
+
+    from ketotpu.storage.sqlite import SQLiteTupleStore
+    from ketotpu.utils.synth import SYNTH_OPL, build_synth
+
+    tmp = tempfile.mkdtemp(prefix="keto-multihost-bench-")
+    procs: Dict[str, subprocess.Popen] = {}
+    pgids: Dict[str, int] = {}
+
+    def spawn(role: str, host_id: int, spec: Dict) -> None:
+        spec_path = os.path.join(tmp, f"{role}.json")
+        with open(spec_path, "w") as f:
+            json.dump(dict(spec, role=role, host_id=host_id), f)
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = " ".join(
+            x for x in env.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in x
+        )
+        env["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={shards}"
+            " --xla_cpu_parallel_codegen_split_count=1"
+        ).strip()
+        p = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             str(concurrency), str(duration), "multihost_child",
+             spec_path],
+            env=env, start_new_session=True,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        procs[role] = p
+        pgids[role] = os.getpgid(p.pid)
+        _CHILD_PGIDS.append(pgids[role])
+
+    try:
+        ns_path = os.path.join(tmp, "namespaces.keto.ts")
+        with open(ns_path, "w") as f:
+            f.write(SYNTH_OPL)
+        db_path = os.path.join(tmp, "store.db")
+        graph = build_synth(
+            n_users=1024, n_groups=64, n_folders=1024, n_docs=8192, seed=0
+        )
+        store = SQLiteTupleStore(db_path)
+        store.migrate_up()
+        tuples = graph.store.all_tuples()
+        for i in range(0, len(tuples), 10_000):
+            store.write_relation_tuples(*tuples[i : i + 10_000])
+        store.close()
+
+        peer_ports = [_free_port(), _free_port()]
+        peers = [f"127.0.0.1:{p}" for p in peer_ports]
+        base = {
+            "dsn": f"sqlite://{db_path}",
+            "namespaces": f"file://{ns_path}",
+            "peers": peers,
+            "secret": "multihost-bench-secret",
+            "phase_dir": tmp,
+            "shards": shards,
+            "concurrency": concurrency,
+            "duration": duration,
+        }
+
+        def ports() -> Dict[str, int]:
+            return {
+                n: _free_port()
+                for n in ("read", "write", "metrics", "opl")
+            }
+
+        spawn("victim", 1, dict(base, serve_ports=ports()))
+        _wait_marker(
+            os.path.join(tmp, "victim_ready"), 600.0, "victim boot"
+        )
+        spawn("driver", 0, dict(base, serve_ports=ports()))
+        _wait_marker(
+            os.path.join(tmp, "hammer_start"), 600.0, "driver hammer"
+        )
+
+        # kill -9 the victim mid-hammer: a whole host, gone at once
+        time.sleep(max(1.0, duration * 0.5))
+        os.killpg(pgids["victim"], signal.SIGKILL)
+        procs["victim"].wait(timeout=30)
+
+        # restart it on the SAME topology slot (same PeerLink port)
+        time.sleep(1.0)
+        spawn("rejoin", 1, dict(base, serve_ports=ports()))
+
+        out, err = procs["driver"].communicate(timeout=1800)
+        line = out.strip().splitlines()[-1] if out.strip() else "{}"
+        try:
+            driver = json.loads(line)
+        except json.JSONDecodeError:
+            driver = {"error": (err or out)[-400:]}
+        driver["exit_code"] = procs["driver"].returncode
+
+        rejoin_json = os.path.join(tmp, "rejoin_result.json")
+        _wait_marker(rejoin_json, 120.0, "rejoin result")
+        with open(rejoin_json) as f:
+            rejoin = json.load(f)
+        try:
+            procs["rejoin"].wait(timeout=120)
+        except subprocess.TimeoutExpired:
+            pass
+
+        after_warm = int(rejoin.get("after_warm_compiles", -1))
+        return {
+            "serve_multihost": driver,
+            "serve_multihost_rejoin": rejoin,
+            "serve_multihost_divergence": int(
+                driver.get("divergence", -1)
+            ),
+            "serve_multihost_steady_compiles": int(
+                driver.get("steady_state_compiles", -1)
+            ),
+            "serve_multihost_rejoin_after_warm_compiles": after_warm,
+            "serve_multihost_recovery_observed": bool(
+                driver.get("recovery_observed", False)
+            ),
+            "serve_multihost_peer_routed": int(
+                driver.get("peer_routed", 0)
+            ),
+            "serve_multihost_rps": driver.get("rps", -1.0),
+            "serve_multihost_p99_ms": driver.get("p99_ms", -1.0),
+            "serve_multihost_recovered_rps": driver.get(
+                "recovered_rps", -1.0
+            ),
+            "serve_multihost_frontier_rtt_p50_ms": driver.get(
+                "frontier_rtt_p50_ms", -1.0
+            ),
+        }
+    finally:
+        import signal as _sig
+
+        for role, p in procs.items():
+            if p.poll() is None:
+                try:
+                    os.killpg(pgids[role], _sig.SIGTERM)
+                    p.wait(timeout=10)
+                except (OSError, subprocess.TimeoutExpired):
+                    try:
+                        os.killpg(pgids[role], _sig.SIGKILL)
+                    except OSError:
+                        pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _scrape_means(metrics, name: str, label_keys) -> Dict[str, float]:
     """Mean milliseconds per histogram series, keyed by the joined label
     values ("check.coalesce_wait") — the per-stage RPC breakdown the bench
@@ -1106,6 +1501,34 @@ if __name__ == "__main__":
         )
         print(json.dumps(res))
         sys.exit(3 if res.get("steady_state_compiles") else 0)
+    elif len(sys.argv) > 3 and sys.argv[3] == "multihost_child":
+        res = run_multihost_child(sys.argv[4])
+        print(json.dumps(res))
+        if res.get("role") == "driver":
+            bad = (
+                res.get("divergence")
+                or res.get("steady_state_compiles")
+                or not res.get("recovery_observed")
+                # a dead DCN lane serves everything locally and passes
+                # the other gates vacuously — require real routing
+                or not res.get("peer_routed")
+            )
+            sys.exit(3 if bad else 0)
+        sys.exit(0)
+    elif len(sys.argv) > 3 and sys.argv[3] == "serve_multihost":
+        shards = int(sys.argv[4]) if len(sys.argv) > 4 else 4
+        res = run_multihost_bench(
+            concurrency=conc, duration=secs, shards=shards
+        )
+        print(json.dumps(res))
+        bad = (
+            res.get("serve_multihost_divergence")
+            or res.get("serve_multihost_steady_compiles")
+            or res.get("serve_multihost_rejoin_after_warm_compiles")
+            or not res.get("serve_multihost_recovery_observed")
+            or not res.get("serve_multihost_peer_routed")
+        )
+        sys.exit(3 if bad else 0)
     elif len(sys.argv) > 3 and sys.argv[3] == "sharded":
         print(json.dumps(run_sharded_bench(concurrency=conc, duration=secs)))
     elif len(sys.argv) > 3 and sys.argv[3] == "workers":

@@ -165,6 +165,27 @@ def _defaults() -> Dict[str, Any]:
                 "rebalance_skew": 4.0,
                 "interval_ms": 0,
                 "failover": True,
+                # multi-host topology (parallel/peerlink.py): peers lists
+                # every owner process's DCN address host:port, indexed by
+                # host id ([] = single-host, the lane stays off).  host_id
+                # names THIS process's slot; listen overrides the bind
+                # address (default: the peers[host_id] entry — bind
+                # 0.0.0.0 behind NAT/containers).  secret gates the
+                # shared-secret handshake and is REQUIRED when peers is
+                # non-empty.  Heartbeats every heartbeat_ms; a peer
+                # missing heartbeat_misses in a row is marked down (every
+                # shard it owns at once).  max_frame_mb caps a single DCN
+                # frame; rpc_timeout_ms bounds each cross-host call.
+                "hosts": {
+                    "host_id": 0,
+                    "peers": [],
+                    "listen": "",
+                    "secret": "",
+                    "heartbeat_ms": 500,
+                    "heartbeat_misses": 3,
+                    "max_frame_mb": 64,
+                    "rpc_timeout_ms": 2000,
+                },
             },
             # optional projection checkpoint path: resumed at boot when it
             # matches the store version + namespace config; every full
@@ -310,6 +331,9 @@ def _defaults() -> Dict[str, Any]:
             "tail_drop_rate": 0.0,
             "latency_ms": 0.0,
             "latency_rate": 0.0,
+            "peer_down": -1,
+            "peer_drop_rate": 0.0,
+            "peer_latency_ms": 0.0,
             "seed": 0,
         },
     }
@@ -395,7 +419,10 @@ class Provider:
                           "slow_ms", "store_size", "recent_size",
                           "sample_rate", "ledger_size", "poll_ms",
                           "heartbeat_misses", "ack_timeout_ms",
-                          "standby_port", "tail_drop_rate"):
+                          "standby_port", "tail_drop_rate",
+                          "peer_down", "peer_drop_rate",
+                          "peer_latency_ms", "host_id",
+                          "max_frame_mb", "rpc_timeout_ms"):
                 suffix = known.split("_")
                 if len(joined) > len(suffix) and joined[-len(suffix):] == suffix:
                     joined = joined[: -len(suffix)] + [known]
@@ -592,10 +619,22 @@ class Provider:
             )
         for key in ("faults.device_error_rate", "faults.socket_drop_rate",
                     "faults.tail_drop_rate", "faults.latency_rate",
-                    "faults.shard_error_rate"):
+                    "faults.shard_error_rate", "faults.peer_drop_rate"):
             val = self.get(key, 0)
             if not isinstance(val, (int, float)) or not (0 <= val <= 1):
                 raise ConfigError(key, f"must be a rate in [0, 1], got {val!r}")
+        val = self.get("faults.peer_latency_ms", 0)
+        if not isinstance(val, (int, float)) or val < 0:
+            raise ConfigError(
+                "faults.peer_latency_ms",
+                f"must be a non-negative number, got {val!r}",
+            )
+        val = self.get("faults.peer_down", -1)
+        if not isinstance(val, int):
+            raise ConfigError(
+                "faults.peer_down",
+                f"must be an integer host id (-1 = none), got {val!r}",
+            )
         ns = v.get("namespaces")
         if isinstance(ns, dict):
             if "location" not in ns and "experimental_strict_mode" not in ns:
@@ -666,6 +705,43 @@ class Provider:
                 "engine.mesh.interval_ms",
                 f"must be a non-negative number, got {val!r}",
             )
+        peers = self.get("engine.mesh.hosts.peers")
+        if not isinstance(peers, list) or any(
+            not isinstance(p, str) or ":" not in p for p in peers
+        ):
+            raise ConfigError(
+                "engine.mesh.hosts.peers",
+                f"must be a list of host:port strings, got {peers!r}",
+            )
+        if peers:
+            hid = self.get("engine.mesh.hosts.host_id")
+            if not isinstance(hid, int) or not (0 <= hid < len(peers)):
+                raise ConfigError(
+                    "engine.mesh.hosts.host_id",
+                    f"must index the {len(peers)}-entry peers list, "
+                    f"got {hid!r}",
+                )
+            if len(peers) < 2:
+                raise ConfigError(
+                    "engine.mesh.hosts.peers",
+                    "a multi-host topology needs at least 2 peers "
+                    "(leave empty for single-host)",
+                )
+            if not self.get("engine.mesh.hosts.secret"):
+                raise ConfigError(
+                    "engine.mesh.hosts.secret",
+                    "the DCN lane requires a shared secret when peers "
+                    "are configured",
+                )
+        for key in ("engine.mesh.hosts.heartbeat_ms",
+                    "engine.mesh.hosts.heartbeat_misses",
+                    "engine.mesh.hosts.max_frame_mb",
+                    "engine.mesh.hosts.rpc_timeout_ms"):
+            val = self.get(key)
+            if not isinstance(val, (int, float)) or val <= 0:
+                raise ConfigError(
+                    key, f"must be a positive number, got {val!r}"
+                )
         if not isinstance(self.get("leopard.enabled", True), bool):
             raise ConfigError(
                 "leopard.enabled",

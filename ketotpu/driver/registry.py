@@ -545,6 +545,42 @@ class Registry:
 
     # -- engines (the EngineProvider seam) ----------------------------------
 
+    def _build_hostlink(self):
+        """The multi-host DCN lane (parallel/peerlink.py) from the
+        ``engine.mesh.hosts`` block, bound and heartbeating — or None
+        when ``peers`` is empty (single-host mesh, lane off).  The
+        engine attaches itself in the MeshCheckEngine constructor and
+        stops the link in its close()."""
+        peers = self.config.get("engine.mesh.hosts.peers") or []
+        if len(peers) < 2:
+            return None
+        from ketotpu.parallel import HostLink
+
+        hid = int(self.config.get("engine.mesh.hosts.host_id") or 0)
+        link = HostLink(
+            hid, list(peers),
+            str(self.config.get("engine.mesh.hosts.secret") or ""),
+            heartbeat_ms=float(
+                self.config.get("engine.mesh.hosts.heartbeat_ms", 500)
+            ),
+            miss_budget=int(
+                self.config.get("engine.mesh.hosts.heartbeat_misses", 3)
+            ),
+            rpc_timeout_ms=float(
+                self.config.get("engine.mesh.hosts.rpc_timeout_ms", 2000)
+            ),
+            max_frame_mb=int(
+                self.config.get("engine.mesh.hosts.max_frame_mb", 64)
+            ),
+            metrics=self.metrics(),
+        )
+        listen = str(self.config.get("engine.mesh.hosts.listen") or "")
+        if listen:
+            link.set_peer_addr(hid, listen)
+        link.bind()
+        link.start()
+        return link
+
     def check_engine(self):
         with self._lock:
             if self._check_engine is None:
@@ -641,6 +677,7 @@ class Registry:
 
                         dev = MeshCheckEngine(
                             self.store(), self.namespace_manager(),
+                            hostlink=self._build_hostlink(),
                             mesh_devices=n_mesh,
                             mesh_axis=str(
                                 self.config.get("engine.mesh_axis") or "shard"
@@ -1109,6 +1146,43 @@ class Registry:
                 help="faulted shards recovered and re-shipped")
         m.gauge("keto_mesh_load_skew", ms.get("skew", 1.0),
                 help="max/mean per-shard routed-root load ratio")
+        # multi-host topology gauges (parallel/peerlink.py): emitted only
+        # when a hostlink is attached — a single-host mesh scrapes none
+        # of the keto_mesh_peer_* / keto_mesh_host_down family
+        peers_fn = getattr(eng, "peer_stats", None)
+        peer_rows = peers_fn() if peers_fn is not None else []
+        for row in peer_rows:
+            h = str(row["peer"])
+            m.gauge("keto_mesh_host_down", int(row["down"]),
+                    help="1 while this peer host is marked down by "
+                         "heartbeat loss", host=h)
+            m.gauge("keto_mesh_peer_heartbeat_age_seconds",
+                    max(row["heartbeat_age_s"], 0.0),
+                    help="seconds since this peer last answered or sent "
+                         "a heartbeat", host=h)
+            m.gauge("keto_mesh_peer_frontier_roundtrips",
+                    row["frontier_roundtrips"],
+                    help="completed cross-host frontier exchanges with "
+                         "this peer", host=h)
+            m.gauge("keto_mesh_peer_routed", row["routed"],
+                    help="root queries shipped to this peer host",
+                    host=h)
+            m.gauge("keto_mesh_peer_fallbacks", row["fallbacks"],
+                    help="oracle fallbacks attributed to this peer "
+                         "(host down, call failed, or budget expired)",
+                    host=h)
+        if peer_rows:
+            m.gauge("keto_mesh_peer_frontier_rtt_ms_p50",
+                    ms.get("peer_frontier_rtt_p50_ms", 0.0),
+                    help="median cross-host frontier round-trip time")
+            m.gauge("keto_mesh_peer_deadline_total",
+                    ms.get("peer_deadline_degrades", 0),
+                    help="cross-host rows degraded to the oracle because "
+                         "the wave's deadline budget expired")
+            m.gauge("keto_mesh_peer_recoveries",
+                    ms.get("peer_recoveries", 0),
+                    help="peer hosts that answered again after being "
+                         "marked down")
 
     def health(self) -> Dict[str, str]:
         """Readiness probe results per check: "ok", a returned string

@@ -527,6 +527,10 @@ class CoalescingEngine:
         # across this wave's dispatches land in the ledger entry
         routes_fn = getattr(inner, "shard_route_counts", None)
         shards_before = routes_fn() if routes_fn is not None else None
+        # per-peer wave accounting (multi-host mesh): rows shipped to
+        # each peer host across this wave's dispatches
+        peers_fn = getattr(inner, "peer_route_counts", None)
+        peers_before = peers_fn() if peers_fn is not None else None
         device_s = 0.0
         if prepared is None:
             prepared = self._prepare(wave)
@@ -607,10 +611,19 @@ class CoalescingEngine:
                         for i, d in enumerate(after - shards_before)
                         if d > 0
                     }
+                peer_delta = None
+                if peers_before is not None:
+                    pafter = peers_fn()
+                    peer_delta = {
+                        str(i): int(d)
+                        for i, d in enumerate(pafter - peers_before)
+                        if d > 0
+                    }
                 self._file_wave(
                     wave_id, wave, len(prepared), device_s,
                     leo_before, fb_before, phase_before,
-                    shards=shard_delta, fused_before=fused_before,
+                    shards=shard_delta, peers=peer_delta,
+                    fused_before=fused_before,
                 )
             except Exception:  # noqa: BLE001 - diagnostics must never
                 pass  # take down the wave worker
@@ -692,13 +705,14 @@ class CoalescingEngine:
     def _file_wave(self, wave_id: int, wave: List[_Slot], n_groups: int,
                    device_s: float, leo_before: int, fb_before: int,
                    phase_before: dict, shards: Optional[dict] = None,
+                   peers: Optional[dict] = None,
                    fused_before: Optional[tuple] = None) -> None:
         """One ledger record per wave: occupancy, waits, device time,
         short-circuit counts, engine phase deltas, slowest traceparents —
         and, when the inner engine is sharded, the per-shard routed-root
-        deltas this wave produced.  Fused-dispatch waves additionally
-        carry the per-tier attribution deltas the single D2H fetch
-        returned."""
+        deltas this wave produced (plus per-peer shipped-row deltas on a
+        multi-host topology).  Fused-dispatch waves additionally carry
+        the per-tier attribution deltas the single D2H fetch returned."""
         inner = self.inner
         waits = sorted(
             (s.t_dispatch - s.t_enq) for s in wave
@@ -763,6 +777,7 @@ class CoalescingEngine:
             ),
             "errors": sum(1 for s in wave if s.error is not None),
             "shards": shards or {},
+            "peers": peers or {},
             "fused": fused,
             "phase_ms": phase_ms,
             "slowest": [

@@ -35,6 +35,13 @@ Knobs (env var / ``configure`` kwarg):
   ``shard_error_rate``, ``shard_id`` — probability a single mesh shard's
   device faults (``MeshCheckEngine`` degrades that shard to replica /
   oracle serving instead of failing the wave; ``shard_id`` names which);
+* ``KETO_FAULT_PEER_DOWN`` / ``peer_down`` — host id of the mesh peer
+  that stops answering DCN frames (its PeerLink server closes every
+  connection unanswered — the whole-host-failure simulation; -1 = none);
+* ``KETO_FAULT_PEER_DROP_RATE`` / ``peer_drop_rate`` — probability a
+  cross-host PeerLink call drops its connection before sending;
+* ``KETO_FAULT_PEER_LATENCY_MS`` / ``peer_latency_ms`` — latency spike
+  added to every cross-host PeerLink call (DCN congestion simulation);
 * ``KETO_FAULT_SEED`` / ``seed`` — deterministic RNG seed.
 """
 
@@ -63,6 +70,9 @@ class FaultPlan:
         latency_rate: Optional[float] = None,
         shard_error_rate: float = 0.0,
         shard_id: int = -1,
+        peer_down: int = -1,
+        peer_drop_rate: float = 0.0,
+        peer_latency_ms: float = 0.0,
         seed: Optional[int] = None,
     ):
         self.device_error_rate = float(device_error_rate)
@@ -71,6 +81,9 @@ class FaultPlan:
         self.tail_drop_rate = float(tail_drop_rate)
         self.shard_error_rate = float(shard_error_rate)
         self.shard_id = int(shard_id)
+        self.peer_down = int(peer_down)
+        self.peer_drop_rate = float(peer_drop_rate)
+        self.peer_latency_ms = float(peer_latency_ms)
         self.latency_ms = float(latency_ms)
         if latency_rate is None:
             latency_rate = 1.0 if latency_ms > 0 else 0.0
@@ -90,6 +103,9 @@ class FaultPlan:
             or self.socket_drop_rate
             or self.tail_drop_rate
             or self.shard_error_rate
+            or self.peer_down >= 0
+            or self.peer_drop_rate
+            or self.peer_latency_ms
             or (self.latency_ms and self.latency_rate)
         )
 
@@ -119,6 +135,7 @@ class FaultPlan:
         seed_raw = env.get("KETO_FAULT_SEED", "")
         rate_raw = env.get("KETO_FAULT_LATENCY_RATE", "")
         shard_raw = env.get("KETO_FAULT_SHARD_ID", "")
+        peer_raw = env.get("KETO_FAULT_PEER_DOWN", "")
         return cls(
             device_error_rate=f("KETO_FAULT_DEVICE_ERROR_RATE"),
             device_stall_ms=f("KETO_FAULT_DEVICE_STALL_MS"),
@@ -128,6 +145,9 @@ class FaultPlan:
             latency_rate=float(rate_raw) if rate_raw else None,
             shard_error_rate=f("KETO_FAULT_SHARD_ERROR_RATE"),
             shard_id=int(shard_raw) if shard_raw else -1,
+            peer_down=int(peer_raw) if peer_raw else -1,
+            peer_drop_rate=f("KETO_FAULT_PEER_DROP_RATE"),
+            peer_latency_ms=f("KETO_FAULT_PEER_LATENCY_MS"),
             seed=int(seed_raw) if seed_raw else None,
         )
 
@@ -174,6 +194,9 @@ def configure_from_config(cfg) -> None:
         latency_rate=block.get("latency_rate") or None,
         shard_error_rate=block.get("shard_error_rate", 0.0),
         shard_id=block.get("shard_id", -1),
+        peer_down=block.get("peer_down", -1),
+        peer_drop_rate=block.get("peer_drop_rate", 0.0),
+        peer_latency_ms=block.get("peer_latency_ms", 0.0),
         seed=block.get("seed") or None,
     )
 
@@ -223,6 +246,36 @@ def shard_faulted(shard: int) -> bool:
     return bool(
         p.active and p.shard_error_rate > 0 and p.shard_id == int(shard)
     )
+
+
+def peer_silenced(host_id: int) -> bool:
+    """True while the plan names this mesh host as down (no roll): its
+    PeerLink server stops answering DCN frames — connections close
+    unanswered, so every peer's heartbeat-miss counter runs — until the
+    plan stops naming it.  Recovery is the plan changing, like
+    :func:`shard_faulted`."""
+    p = _plan
+    return bool(p.active and p.peer_down == int(host_id))
+
+
+def peer_dropped() -> bool:
+    """Roll for a cross-host PeerLink call dropping its connection before
+    the frame is sent.  Counted so chaos tests can assert the storm
+    actually fired."""
+    p = _plan
+    if not p.active or not p._roll(p.peer_drop_rate):
+        return False
+    p._count("peer_drop")
+    return True
+
+
+def peer_latency() -> None:
+    """Stall a cross-host PeerLink call by the configured DCN latency
+    spike.  No-op when the plan is inactive or the knob is zero."""
+    p = _plan
+    if p.active and p.peer_latency_ms > 0:
+        p._count("peer_latency")
+        time.sleep(p.peer_latency_ms / 1000.0)
 
 
 def shard_down(shard: int) -> bool:

@@ -38,10 +38,13 @@ from ketotpu.parallel.graphshard import (
 )
 from ketotpu.parallel.mesh import make_mesh, shard_fast_check, shard_general_check
 from ketotpu.parallel.meshengine import MeshCheckEngine
+from ketotpu.parallel.peerlink import HostLink, host_of
 
 __all__ = [
+    "HostLink",
     "MeshCheckEngine",
     "build_sharded_snapshot",
+    "host_of",
     "make_mesh",
     "shard_general_check",
     "shard_fast_check",

@@ -44,13 +44,29 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ketotpu import compilewatch, faults, flightrec
+from ketotpu import compilewatch, deadline, faults, flightrec
 from ketotpu.cache.hotspot import HotSpotSketch
 from ketotpu.engine import delta as dl
 from ketotpu.engine.optable import R_ERR, R_IS
 from ketotpu.engine.tpu import DeviceCheckEngine, _bucket, _bucket15
-from ketotpu.parallel import graphshard
+from ketotpu.parallel import graphshard, peerlink
 from ketotpu.parallel.mesh import make_mesh
+
+#: collectives over the host's ONE device backend cannot overlap even
+#: across ENGINE INSTANCES (two in-process mesh engines — the multi-host
+#: parity tests' topology — share the same CPU/TPU backend, and two
+#: in-flight sharded programs interleave their all_to_all rendezvous and
+#: starve each other), so the run lock is process-global, not per-engine
+_MESH_RUN_LOCK = threading.Lock()
+
+#: set while THIS thread is serving a peer's forwarded rows: the mesh
+#: engine must answer those locally — re-forwarding a replica-routed row
+#: to its hash owner would bounce between hosts forever
+_LOCAL_SERVE = threading.local()
+
+#: separator for the string-keyed cross-host root key (vocab ids are
+#: per-process; only the strings mean the same thing on every host)
+_KEY_SEP = "\x1f"
 
 
 def _pack_keys(ns_ids: np.ndarray, obj_ids: np.ndarray) -> np.ndarray:
@@ -83,6 +99,7 @@ class MeshCheckEngine(DeviceCheckEngine):
         rebalance_skew: float = 4.0,
         rebalance_interval_ms: float = 0.0,
         failover: bool = True,
+        hostlink=None,
         **kwargs,
     ):
         super().__init__(store, namespace_manager, **kwargs)
@@ -149,8 +166,35 @@ class MeshCheckEngine(DeviceCheckEngine):
         # collectives over ONE mesh cannot overlap: two in-flight
         # executions of the sharded program interleave their all_to_all
         # rendezvous on the host backend and starve each other, so every
-        # device launch (and the shared routing counters) serializes here
-        self._mesh_run_lock = threading.Lock()
+        # device launch (and the shared routing counters) serializes on
+        # the process-global run lock (see _MESH_RUN_LOCK)
+        self._mesh_run_lock = _MESH_RUN_LOCK
+        # -- multi-host topology (parallel/peerlink.py) ------------------
+        # the host coordinate partitions SERVING RESPONSIBILITY for root
+        # keys, not device memory: every host builds the full sharded
+        # graph from the shared store, so any host's verdict for any key
+        # is bit-identical — cross-host routing is a throughput/failover
+        # decision, never a correctness one
+        self.hostlink = hostlink
+        self.host_id = hostlink.host_id if hostlink is not None else 0
+        self.n_hosts = hostlink.n_hosts if hostlink is not None else 1
+        # string-keyed hot sketch for MY owned roots: the cross-host
+        # replication controller's feed (the shard-level sketch above
+        # keys by per-process vocab ids, useless across hosts)
+        self._peer_hot = HotSpotSketch(top_k=max(self.replica_max_keys, 16))
+        # key -> remote hosts holding a SERVE-COPY: merged from every
+        # owner's heartbeat-published plan plus my own; replaced
+        # wholesale (atomic rebind), read lock-free on the dispatch path
+        self._peer_replicas: Dict[str, Tuple[int, ...]] = {}
+        self._peer_plans: Dict[int, Dict[str, Tuple[int, ...]]] = {}
+        self._my_peer_plan: Dict[str, Tuple[int, ...]] = {}
+        self._peer_batches = np.zeros(max(self.n_hosts, 1), np.int64)
+        self._peer_fallbacks = np.zeros(max(self.n_hosts, 1), np.int64)
+        self.peer_deadline_degrades = 0
+        self.peer_host_down_events = 0
+        self.peer_recover_events = 0
+        if hostlink is not None:
+            hostlink.attach_engine(self)
         self._rebal_stop = threading.Event()
         self._rebal_thread: Optional[threading.Thread] = None
         if self.rebalance_interval_ms > 0 and mesh_devices > 1:
@@ -455,6 +499,210 @@ class MeshCheckEngine(DeviceCheckEngine):
             self._shard_fallbacks[s] = 0
             self.shard_recoveries += 1
 
+    # -- cross-host routing / serving (parallel/peerlink.py) ----------------
+
+    @staticmethod
+    def _query_key_cols(queries):
+        """(namespace, object) STRING columns for a wave — the cross-host
+        coordinate hashes strings, never per-process vocab ids."""
+        if hasattr(queries, "encode_for"):
+            return queries.ns, queries.obj
+        return (
+            [q.namespace for q in queries],
+            [q.object for q in queries],
+        )
+
+    def _route_hosts(self, queries, cand_mask, rest_depth: int):
+        """Split a wave by serving host.  Each row's serve-set is its
+        owner host plus any heartbeat-published replica hosts; the
+        least-loaded LIVE member serves it.  Rows landing on a peer batch
+        into one framed round trip per peer (fired here, joined in
+        _collect); rows with every copy down — and every cross-host row
+        of a wave whose deadline budget is already spent — degrade to the
+        oracle instead of blocking the wave."""
+        cand = np.flatnonzero(cand_mask)
+        if not len(cand):
+            return None
+        link = self.hostlink
+        n = cand_mask.shape[0]
+        ns_s, obj_s = self._query_key_cols(queries)
+        owner_host = np.fromiter(
+            (
+                peerlink.host_of(ns_s[i], obj_s[i], self.n_hosts)
+                for i in cand
+            ),
+            np.int32, count=len(cand),
+        )
+        rep = self._peer_replicas
+        if self.replicate_hot:
+            mine = cand[owner_host == self.host_id]
+            if len(mine):
+                self._peer_hot.observe_many(
+                    [ns_s[i] + _KEY_SEP + obj_s[i] for i in mine]
+                )
+        loads = {
+            h: (
+                float(self._shard_batches.sum()) if h == self.host_id
+                else link.peer_load(h)
+            )
+            for h in range(self.n_hosts)
+        }
+        downs = {
+            h: (False if h == self.host_id else link.peer_down(h))
+            for h in range(self.n_hosts)
+        }
+        sent = np.zeros(n, bool)
+        lost = np.zeros(n, bool)
+        send: Dict[int, list] = {}
+        for pos in range(len(cand)):
+            i = int(cand[pos])
+            own = int(owner_host[pos])
+            extras = rep.get(ns_s[i] + _KEY_SEP + obj_s[i]) if rep else None
+            if own == self.host_id and not extras:
+                continue  # the common case: I own it, nobody else serves it
+            live = [
+                h for h in dict.fromkeys((own, *(extras or ())))
+                if not downs.get(h, True)
+            ]
+            if not live:
+                # whole serve-set down: this row rides the existing
+                # err-mask to the host oracle, attributed to the owner
+                lost[i] = True
+                self._peer_fallbacks[own] += 1
+                continue
+            serve = min(live, key=lambda h: loads[h])
+            if serve == self.host_id:
+                continue
+            send.setdefault(serve, []).append(i)
+            sent[i] = True
+        if not sent.any() and not lost.any():
+            return None
+        rem = deadline.remaining()
+        if rem is not None and rem <= 0 and sent.any():
+            # budget already spent: shipping would only return expired —
+            # degrade this wave's cross-host rows to the oracle now
+            self.peer_deadline_degrades += int(sent.sum())
+            for hid, idx in send.items():
+                self._peer_fallbacks[hid] += len(idx)
+            lost |= sent
+            sent = np.zeros(n, bool)
+            send = {}
+        timeout_s = link.rpc_timeout_s if rem is None else min(
+            rem, link.rpc_timeout_s
+        )
+        pend = {}
+        for hid, idx in send.items():
+            rows = [queries[i] for i in idx]
+            pend[hid] = (
+                np.asarray(idx, np.int64),
+                link.check_rows_async(hid, rows, rest_depth, timeout_s),
+                timeout_s,
+            )
+            self._peer_batches[hid] += len(idx)
+        return {"sent": sent, "lost": lost, "pend": pend}
+
+    def _peer_serve_check(self, rows, rest_depth: int) -> np.ndarray:
+        """Answer a peer's forwarded rows from the LOCAL cascade.  The
+        local-serve scope pins the whole sub-wave to this host: a
+        replica-routed row re-hashed here would forward straight back to
+        its owner and bounce forever."""
+        prev = getattr(_LOCAL_SERVE, "serving", False)
+        _LOCAL_SERVE.serving = True
+        try:
+            return np.asarray(
+                self.batch_check(rows, rest_depth=rest_depth), bool
+            )
+        finally:
+            _LOCAL_SERVE.serving = prev
+
+    def _hb_payload(self) -> dict:
+        """What this host publishes on every heartbeat: its load (the
+        peers' least-loaded-copy routing signal), shard count, drained
+        cursor, and its hot-key replica plan — the consensus-free
+        controller's whole protocol rides the heartbeat."""
+        plan = self.plan_peer_replicas() if self.replicate_hot else {}
+        return {
+            "load": float(self._shard_batches.sum()),
+            "shards": int(self.n_shards),
+            "cursor": int(self._log_cursor),
+            "replicas": {k: list(v) for k, v in plan.items()},
+        }
+
+    def plan_peer_replicas(self) -> Dict[str, Tuple[int, ...]]:
+        """The cross-host replica plan for MY owned hot keys: existing
+        placements stick (stability), new hot keys get one copy on the
+        least-loaded live remote host.  Copy-never-move like the shard
+        controller: every host serves from its own full graph, so a
+        serve-copy is a routing fact, not a data move — verdicts stay
+        bit-identical wherever a row lands."""
+        link = self.hostlink
+        if link is None or self.n_hosts < 2:
+            return {}
+        remote = [h for h in link.live_hosts() if h != self.host_id]
+        out: Dict[str, Tuple[int, ...]] = {}
+        if remote:
+            for key, est in self._peer_hot.top():
+                if est < self.hot_min or not isinstance(key, str):
+                    continue
+                if len(out) >= self.replica_max_keys:
+                    break
+                kept = tuple(
+                    h for h in self._my_peer_plan.get(key, ())
+                    if h in remote
+                )
+                out[key] = kept or (
+                    min(remote, key=lambda h: link.peer_load(h)),
+                )
+        self._my_peer_plan = out
+        self._rebuild_peer_replicas()
+        return out
+
+    def _merge_peer_replicas(self, hid: int, mapping) -> None:
+        """Absorb a peer's heartbeat-published replica plan."""
+        self._peer_plans[int(hid)] = {
+            str(k): tuple(int(h) for h in v)
+            for k, v in (mapping or {}).items()
+        }
+        self._rebuild_peer_replicas()
+
+    def _rebuild_peer_replicas(self) -> None:
+        merged: Dict[str, Tuple[int, ...]] = {}
+        for plan in (*self._peer_plans.values(), self._my_peer_plan):
+            for k, hosts in plan.items():
+                merged[k] = tuple(
+                    dict.fromkeys(merged.get(k, ()) + tuple(hosts))
+                )
+        self._peer_replicas = merged  # atomic rebind: lock-free readers
+
+    def _on_peer_down(self, hid: int) -> None:
+        """Heartbeat loss marked a whole peer down: every shard it owns
+        is down at once.  Routing reads liveness from the hostlink on
+        every wave, so there is nothing to re-ship — the next wave's fast
+        roots already reroute to live replicas and the rest degrades to
+        the oracle via the err-mask."""
+        self.peer_host_down_events += 1
+
+    def _on_peer_up(self, hid: int) -> None:
+        """A peer answered again after being down: its owned keys route
+        back to it on the next wave (warm rejoin — the peer re-ships its
+        own stacks from the shared store before answering)."""
+        self.peer_recover_events += 1
+
+    def peer_route_counts(self) -> np.ndarray:
+        """Cumulative rows shipped per peer host (the coalescer diffs
+        consecutive reads for the wave ledger's per-peer accounting)."""
+        return self._peer_batches.copy()
+
+    def mesh_bootstrap(self, hid: int) -> None:
+        """Warm-join via segment ship: adopt the peer's projected base
+        snapshot (checkpoint codec arrays over the DCN lane) instead of
+        re-projecting the store.  Shape-signature gating in the adopt
+        path keeps a rejoin at matching shapes free of XLA recompiles."""
+        if self.hostlink is None:
+            raise RuntimeError("no hostlink attached")
+        snap, cursor = self.hostlink.bootstrap_from(int(hid))
+        self.adopt_snapshot(snap, cursor=cursor)
+
     def _dispatch(self, queries, rest_depth: int, fused=None):
         # ``fused`` accepted for base-class call compatibility and
         # ignored: the sharded cascade has no fused-wave variant
@@ -485,6 +733,20 @@ class MeshCheckEngine(DeviceCheckEngine):
         if cache_res is not None:
             act &= ~cache_res[0]
             general = general & ~cache_res[0]
+        # cross-host routing BEFORE the shard-level machinery: rows whose
+        # serving host is a peer leave the local wave entirely (one framed
+        # round trip per peer, launched now so the DCN exchange overlaps
+        # the local device run; joined last in _collect).  Rows with no
+        # live serving host degrade to the oracle via the err-mask.
+        peerh = None
+        if (self.hostlink is not None and self.n_hosts > 1
+                and not getattr(_LOCAL_SERVE, "serving", False)):
+            peerh = self._route_hosts(queries, act | general, rest_depth)
+            if peerh is not None:
+                gone = peerh["sent"] | peerh["lost"]
+                act = act & ~gone
+                general = general & ~gone
+                err = err | gone
         self._poll_shard_faults()
         assign, owner = self._route_assign(enc[0], enc[1])
         if self._shard_down.any():
@@ -522,7 +784,7 @@ class MeshCheckEngine(DeviceCheckEngine):
             gres = self._run_general_mesh(stacked, enc, gi)
         self._phase("check_mesh_dispatch", time.perf_counter() - t0)
         return (enc, err, general, res, gi, gres, stacked, assign, leo_res,
-                cache_res, cursor)
+                cache_res, cursor, peerh)
 
     def _note_fast_tiers(self, mask, handle) -> None:
         # split the fast-path attribution by serving shard so a divergence
@@ -535,7 +797,7 @@ class MeshCheckEngine(DeviceCheckEngine):
 
     def _collect(self, handle, retry: bool = True):
         (enc, fallback_mask, general, res, gi, gres, stacked, assign,
-         leo_res, cache_res, _cursor) = handle
+         leo_res, cache_res, _cursor, peerh) = handle
         n = fallback_mask.shape[0]
         allowed = np.zeros(n, bool)
         fallback = fallback_mask.copy()
@@ -623,7 +885,32 @@ class MeshCheckEngine(DeviceCheckEngine):
             # cached verdicts likewise rode inactive all-zero BFS slots
             allowed[cache_res[0]] = cache_res[1][cache_res[0]]
             fallback &= ~cache_res[0]
-        fb = np.flatnonzero(fallback)
+        # join the cross-host exchanges LAST and with no lock held: the
+        # local device work (including retries) above overlapped the DCN
+        # round trips, and a peer serving OUR rows may itself be waiting
+        # for this host's run lock
+        peer_attr = None
+        if peerh is not None:
+            peer_attr = peerh["sent"] | peerh["lost"]
+            for hid, (idx, pending, tmo) in peerh["pend"].items():
+                ok = pending.wait(tmo)
+                if ok is not None:
+                    allowed[idx] = ok
+                    fallback[idx] = False
+                    continue
+                # the peer never answered inside the budget: those rows
+                # ride the oracle.  A clean timeout is deadline
+                # semantics; an error is the peer dying mid-wave.
+                if pending.error is None:
+                    self.peer_deadline_degrades += len(idx)
+                self._peer_fallbacks[hid] += len(idx)
+                fallback[idx] = True
+        # peer-degraded rows are attributed per-PEER, not to the local
+        # owner shards: a dead host must not smear fallback counts over
+        # this host's (healthy) shard gauges
+        fb = np.flatnonzero(
+            fallback & ~peer_attr if peer_attr is not None else fallback
+        )
         if len(fb):
             # attribute each oracle fallback to the query's owner shard
             # (the same (ns, obj) hash that partitioned the graph); err
@@ -796,6 +1083,8 @@ class MeshCheckEngine(DeviceCheckEngine):
                 self.compaction_errors += 1
 
     def close(self) -> None:
+        if self.hostlink is not None:
+            self.hostlink.stop()
         self._rebal_stop.set()
         t = self._rebal_thread
         if t is not None and t.is_alive():
@@ -810,7 +1099,7 @@ class MeshCheckEngine(DeviceCheckEngine):
     def mesh_stats(self) -> dict:
         """Engine-level replication / rebalance / failover counters for
         the registry's mesh gauges."""
-        return {
+        out = {
             "replica_keys": len(self._replica_map),
             "replica_routed": int(self.replica_routed),
             "replications": int(self.replications),
@@ -819,6 +1108,37 @@ class MeshCheckEngine(DeviceCheckEngine):
             "shards_down": int(self._shard_down.sum()),
             "skew": round(self.shard_skew(), 3),
         }
+        link = self.hostlink
+        if link is not None:
+            out.update({
+                "host_id": int(self.host_id),
+                "n_hosts": int(self.n_hosts),
+                "hosts_down": sum(
+                    1 for h in range(self.n_hosts)
+                    if h != self.host_id and link.peer_down(h)
+                ),
+                "peer_routed": int(self._peer_batches.sum()),
+                "peer_fallbacks": int(self._peer_fallbacks.sum()),
+                "peer_deadline_degrades": int(self.peer_deadline_degrades),
+                "peer_replica_keys": len(self._peer_replicas),
+                "peer_recoveries": int(link.peer_recoveries),
+                "peer_frontier_rtt_p50_ms": link.frontier_rtt_p50_ms(),
+            })
+        return out
+
+    def peer_stats(self) -> List[dict]:
+        """Per-peer rows (id, liveness, heartbeat age, load, frontier
+        round trips, shipped rows, peer-degraded fallbacks) for
+        ``/debug/mesh`` and the registry's peer gauges."""
+        link = self.hostlink
+        if link is None:
+            return []
+        rows = link.peer_rows()
+        for r in rows:
+            hid = r["peer"]
+            r["routed"] = int(self._peer_batches[hid])
+            r["fallbacks"] = int(self._peer_fallbacks[hid])
+        return rows
 
     def shard_stats(self) -> List[dict]:
         """Per-shard serving counters for the registry's mesh gauges and

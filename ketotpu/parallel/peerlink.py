@@ -1,0 +1,650 @@
+"""PeerLink: the cross-host DCN lane of the multi-host mesh.
+
+One host of the mesh is a MeshCheckEngine process (parallel/meshengine.py)
+owning a slice of the ROOT-KEY space: ``host_of(namespace, object)`` — a
+process-independent hash over the key *strings* (vocab ids are per-process
+and useless as a cross-host coordinate) — extends the PR-10 host-computed
+``assign`` column with a host coordinate.  Everything that crosses hosts
+rides this lane:
+
+* **frontier exchange** — a wave's cross-host rows batch into ONE framed
+  round-trip per peer per wave (``check`` op, tuple columns + depth +
+  ``deadline_ms`` in the frame meta); the owner answers them against its
+  own local cascade, bit-identically;
+* **heartbeats** — each owner publishes its load, shard count, drained
+  cursor, and hot-key replica plan every ``heartbeat_ms``; the reply
+  carries the peer's own payload, so one call refreshes both directions.
+  ``miss_budget`` consecutive failures mark the peer DOWN — every shard
+  it owns at once — and a later answered beat (or a received one) marks
+  it back up;
+* **segment shipping** — ``bootstrap`` ships the owner's projected base
+  snapshot (the checkpoint codec's flat array dict) so a joining or
+  restarted peer adopts warm instead of re-projecting the store.
+
+The wire is the same framed protocol as the same-host worker socket
+(server/wire.py) — but TCP across hosts is not a trusted channel, so the
+lane is hardened: a shared-secret ``hello`` handshake (constant-time
+compare) gates every connection, per-frame size caps tighten the global
+wire limits, shared-memory frames are refused outright (``recv_frame``
+with no shm cache raises ``WireError``), and any framing violation
+closes the connection — the strict one-response-per-request discipline
+of ``workers._Conn`` is reused verbatim, just over TCP.
+
+Chaos knobs (ketotpu/faults.py): ``peer_down`` silences a named host's
+server (connections close unanswered — the whole-host-failure
+simulation), ``peer_drop_rate`` drops client calls before the frame is
+sent, ``peer_latency_ms`` stalls every cross-host call.
+"""
+
+from __future__ import annotations
+
+import hmac
+import socketserver
+import threading
+import time
+import zlib
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ketotpu import faults
+from ketotpu.api.types import KetoAPIError
+from ketotpu.server import wire
+
+PROTO = 1
+
+#: per-frame caps for the DCN lane (tighter than the same-host wire's
+#: global limits): meta is small structured JSON, payloads are bounded by
+#: ``max_frame_mb`` — a hostile or desynced peer cannot make a length
+#: prefix allocate gigabytes
+MAX_PEER_META = 4 * 1024 * 1024
+
+_HOST_SALT = b"\x00keto-mesh-host"
+
+
+def host_of(namespace: str, obj: str, n_hosts: int) -> int:
+    """Owner host for a root key.  Hashes the key STRINGS (crc32 — stable
+    across processes, unlike per-process vocab ids or salted ``hash()``),
+    so every host computes the same coordinate for the same key."""
+    if n_hosts <= 1:
+        return 0
+    h = zlib.crc32(
+        namespace.encode("utf-8") + b"\x1f" + obj.encode("utf-8")
+        + _HOST_SALT
+    )
+    return int(h % n_hosts)
+
+
+def host_of_queries(queries, n_hosts: int) -> np.ndarray:
+    """Vectorized-enough host coordinates for a wave's root queries."""
+    return np.fromiter(
+        (host_of(q.namespace, q.object, n_hosts) for q in queries),
+        dtype=np.int32, count=len(queries),
+    )
+
+
+def _parse_addr(addr) -> Tuple[str, int]:
+    if isinstance(addr, (tuple, list)):
+        return str(addr[0]), int(addr[1])
+    host, _, port = str(addr).rpartition(":")
+    if not host:
+        raise ValueError(f"peer address {addr!r} is not host:port")
+    return host, int(port)
+
+
+class _Pending:
+    """One in-flight cross-host frontier exchange (thread-backed)."""
+
+    __slots__ = ("_evt", "value", "error")
+
+    def __init__(self):
+        self._evt = threading.Event()
+        self.value: Optional[np.ndarray] = None
+        self.error: Optional[BaseException] = None
+
+    def wait(self, timeout: Optional[float]) -> Optional[np.ndarray]:
+        """Verdict array, or None on failure/timeout (caller degrades)."""
+        if not self._evt.wait(timeout):
+            return None
+        return self.value if self.error is None else None
+
+
+class _PeerState:
+    __slots__ = ("last_seen", "misses", "down", "load", "shards",
+                 "cursor", "replica_keys", "roundtrips", "rtts",
+                 "bootstraps")
+
+    def __init__(self):
+        self.last_seen = 0.0   # monotonic; 0 = never heard from
+        self.misses = 0
+        self.down = False
+        self.load = 0.0
+        self.shards = 0
+        self.cursor = -1
+        self.replica_keys = 0
+        self.roundtrips = 0    # frontier (check) round trips completed
+        self.rtts: deque = deque(maxlen=256)  # frontier RTTs, seconds
+        self.bootstraps = 0
+
+
+class _PeerHandler(socketserver.StreamRequestHandler):
+    def handle(self):  # noqa: C901 - one linear connection loop
+        link: HostLink = self.server.link  # type: ignore[attr-defined]
+        caps = dict(max_meta=MAX_PEER_META, max_bin=link.max_frame_bytes)
+        try:
+            got = wire.recv_frame(self.rfile, **caps)
+        except (wire.WireError, OSError):
+            return
+        if got is None:
+            return
+        hello, _, _ = got
+        # shared-secret handshake gates everything else on the connection;
+        # constant-time compare, and a failure answers once then closes
+        if (
+            hello.get("op") != "hello"
+            or int(hello.get("proto", 0)) != PROTO
+            or not hmac.compare_digest(
+                str(hello.get("secret", "")), link.secret
+            )
+        ):
+            try:
+                wire.send_frame(self.connection, {"error": {
+                    "msg": "peerlink handshake refused", "status": 403,
+                }})
+            except OSError:
+                pass
+            return
+        try:
+            wire.send_frame(
+                self.connection, {"ok": True, "host": link.host_id},
+            )
+        except OSError:
+            return
+        link._note_heard(hello.get("host"))
+        while True:
+            try:
+                got = wire.recv_frame(self.rfile, **caps)
+            except (wire.WireError, OSError):
+                return  # desynced/hostile/gone: drop the connection
+            if got is None:
+                return
+            if faults.peer_silenced(link.host_id):
+                # whole-host-failure simulation: this host stops
+                # answering DCN frames — close unanswered so the peer
+                # sees exactly what a dead process looks like
+                return
+            meta, arrays, _ = got
+            try:
+                resp, resp_arrays = link._serve(meta, arrays)
+            except KetoAPIError as e:
+                resp, resp_arrays = {"error": {
+                    "msg": str(e),
+                    "status": getattr(e, "status_code", 500),
+                }}, None
+            except Exception as e:  # noqa: BLE001 - answered, not fatal
+                resp, resp_arrays = {"error": {
+                    "msg": f"{type(e).__name__}: {e}", "status": 500,
+                }}, None
+            try:
+                wire.send_frame(self.connection, resp, resp_arrays)
+            except OSError:
+                return
+
+
+class _PeerServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class _PeerClient:
+    """One peer's outbound lane: a pooled framed TCP connection with the
+    hello handshake on (re)connect.  Transport errors discard the
+    connection (strict framing); the next call reconnects."""
+
+    def __init__(self, link: "HostLink", hid: int):
+        self._link = link
+        self._hid = hid
+        self._lock = threading.Lock()
+        self._conn = None
+
+    def close(self) -> None:
+        with self._lock:
+            conn, self._conn = self._conn, None
+        if conn is not None:
+            conn.close()
+
+    def _connect(self, timeout: Optional[float]):
+        from ketotpu.server.workers import _Conn
+
+        link = self._link
+        conn = _Conn(
+            _parse_addr(link.addrs[self._hid]),
+            metrics=link.metrics, shm_threshold=0,
+            connect_timeout=timeout,
+        )
+        try:
+            resp, _ = conn.call(
+                {"op": "hello", "proto": PROTO, "host": link.host_id,
+                 "secret": link.secret},
+                timeout=timeout,
+            )
+        except BaseException:
+            conn.close()
+            raise
+        if not resp.get("ok"):
+            conn.close()
+            raise ConnectionError("peerlink handshake refused")
+        return conn
+
+    def call(self, meta: dict, arrays=None,
+             timeout: Optional[float] = None):
+        faults.peer_latency()
+        if faults.peer_dropped():
+            self.close()
+            raise ConnectionError("injected peer drop")
+        with self._lock:
+            had_conn = self._conn is not None
+            if self._conn is None:
+                self._conn = self._connect(timeout)
+            try:
+                return self._conn.call(meta, arrays, timeout=timeout)
+            except KetoAPIError:
+                raise  # typed error: exchange completed, stream aligned
+            except Exception:
+                self._conn = None
+                if not had_conn:
+                    raise
+            # the cached connection was stale (peer restarted between
+            # waves): one fresh connect inside the same budget
+            self._conn = self._connect(timeout)
+            try:
+                return self._conn.call(meta, arrays, timeout=timeout)
+            except KetoAPIError:
+                raise
+            except Exception:
+                self._conn = None
+                raise
+
+
+class HostLink:
+    """This host's view of the mesh topology: the PeerLink server, one
+    outbound client per peer, the heartbeat/liveness loop, and the
+    per-peer counters behind ``/debug/mesh`` and the
+    ``keto_mesh_peer_*`` gauges."""
+
+    def __init__(
+        self,
+        host_id: int,
+        addrs: List,
+        secret: str,
+        *,
+        heartbeat_ms: float = 500.0,
+        miss_budget: int = 3,
+        rpc_timeout_ms: float = 2000.0,
+        max_frame_mb: int = 64,
+        metrics=None,
+    ):
+        if not secret:
+            raise ValueError(
+                "peerlink requires a shared secret "
+                "(engine.mesh.hosts.secret)"
+            )
+        self.host_id = int(host_id)
+        self.addrs = [_parse_addr(a) for a in addrs]
+        self.n_hosts = len(self.addrs)
+        if not (0 <= self.host_id < self.n_hosts):
+            raise ValueError(
+                f"host_id {host_id} outside the {self.n_hosts}-host "
+                f"topology"
+            )
+        self.secret = str(secret)
+        self.heartbeat_ms = float(heartbeat_ms)
+        self.miss_budget = int(miss_budget)
+        self.rpc_timeout_s = float(rpc_timeout_ms) / 1000.0
+        self.max_frame_bytes = int(max_frame_mb) << 20
+        self.metrics = metrics
+        self._engine = None
+        self._server: Optional[_PeerServer] = None
+        self._server_thread: Optional[threading.Thread] = None
+        self._hb_stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+        self._state_lock = threading.Lock()
+        self._peers: Dict[int, _PeerState] = {
+            h: _PeerState() for h in range(self.n_hosts)
+            if h != self.host_id
+        }
+        self._clients: Dict[int, _PeerClient] = {}
+        self.host_downs = 0        # peers declared down (cumulative)
+        self.peer_recoveries = 0   # peers that came back after down
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def attach_engine(self, engine) -> None:
+        """Bind the serving engine: it answers frontier exchanges
+        (``_peer_serve_check``), feeds heartbeat payloads
+        (``_hb_payload``), and absorbs topology events
+        (``_merge_peer_replicas`` / ``_on_peer_down`` / ``_on_peer_up``)."""
+        self._engine = engine
+
+    def bind(self) -> Tuple[str, int]:
+        """Start the PeerLink server on this host's address.  Port 0
+        binds ephemerally and rewrites the topology entry — callers then
+        exchange real addresses via :meth:`set_peer_addr` (tests)."""
+        host, port = self.addrs[self.host_id]
+        srv = _PeerServer((host, port), _PeerHandler)
+        srv.link = self  # type: ignore[attr-defined]
+        self._server = srv
+        self.addrs[self.host_id] = srv.server_address[:2]
+        t = threading.Thread(
+            target=srv.serve_forever, kwargs={"poll_interval": 0.05},
+            name=f"keto-peerlink-{self.host_id}", daemon=True,
+        )
+        self._server_thread = t
+        t.start()
+        return self.addrs[self.host_id]
+
+    def start(self) -> None:
+        """Start the heartbeat loop (after :meth:`bind` and topology
+        exchange)."""
+        if self._hb_thread is not None or self.n_hosts < 2:
+            return
+        t = threading.Thread(
+            target=self._hb_loop,
+            name=f"keto-peerlink-hb-{self.host_id}", daemon=True,
+        )
+        self._hb_thread = t
+        t.start()
+
+    def stop(self) -> None:
+        self._hb_stop.set()
+        t = self._hb_thread
+        if t is not None and t.is_alive():
+            t.join(timeout=5.0)
+        srv = self._server
+        if srv is not None:
+            srv.shutdown()
+            srv.server_close()
+            self._server = None
+        for c in list(self._clients.values()):
+            c.close()
+
+    def set_peer_addr(self, hid: int, addr) -> None:
+        self.addrs[int(hid)] = _parse_addr(addr)
+
+    @property
+    def addr(self) -> Tuple[str, int]:
+        return self.addrs[self.host_id]
+
+    def _client(self, hid: int) -> _PeerClient:
+        c = self._clients.get(hid)
+        if c is None:
+            c = self._clients.setdefault(hid, _PeerClient(self, hid))
+        return c
+
+    # -- liveness -----------------------------------------------------------
+
+    def _hb_loop(self) -> None:
+        interval = max(self.heartbeat_ms, 10.0) / 1000.0
+        while not self._hb_stop.wait(interval):
+            try:
+                self.heartbeat_now()
+            except Exception:  # noqa: BLE001 - liveness must keep polling
+                pass
+
+    def heartbeat_now(self) -> None:
+        """One synchronous heartbeat round to every peer (the loop's
+        body, callable directly so tests drive liveness without
+        sleeping)."""
+        if faults.peer_silenced(self.host_id):
+            return  # a silenced host is fully dead: it stops sending too
+        eng = self._engine
+        payload = eng._hb_payload() if eng is not None else {}
+        for hid in list(self._peers):
+            try:
+                resp, _ = self._client(hid).call(
+                    {"op": "heartbeat", "host": self.host_id, **payload},
+                    timeout=self.rpc_timeout_s,
+                )
+            except (KetoAPIError, OSError, ConnectionError):
+                self._note_miss(hid)
+                continue
+            self._note_alive(hid, resp)
+
+    def _note_alive(self, hid: int, payload: dict) -> None:
+        eng = self._engine
+        with self._state_lock:
+            st = self._peers.get(hid)
+            if st is None:
+                return
+            was_down = st.down
+            st.last_seen = time.monotonic()
+            st.misses = 0
+            st.down = False
+            st.load = float(payload.get("load", st.load) or 0.0)
+            st.shards = int(payload.get("shards", st.shards) or 0)
+            cur = payload.get("cursor")
+            if cur is not None:
+                st.cursor = int(cur)
+            replicas = payload.get("replicas")
+            if replicas is not None:
+                st.replica_keys = len(replicas)
+            if was_down:
+                self.peer_recoveries += 1
+        if eng is not None:
+            if replicas is not None:
+                eng._merge_peer_replicas(hid, replicas)
+            if was_down:
+                eng._on_peer_up(hid)
+
+    def _note_miss(self, hid: int) -> None:
+        eng = self._engine
+        went_down = False
+        with self._state_lock:
+            st = self._peers.get(hid)
+            if st is None:
+                return
+            st.misses += 1
+            if not st.down and st.misses >= self.miss_budget:
+                st.down = True
+                self.host_downs += 1
+                went_down = True
+        if went_down and eng is not None:
+            eng._on_peer_down(hid)
+
+    def _note_heard(self, hid) -> None:
+        """An inbound frame from a peer is liveness evidence too — a
+        returning peer's first heartbeat marks it up here before our own
+        next outbound round."""
+        try:
+            hid = int(hid)
+        except (TypeError, ValueError):
+            return
+        if hid in self._peers:
+            self._note_alive(hid, {})
+
+    def peer_down(self, hid: int) -> bool:
+        st = self._peers.get(int(hid))
+        return bool(st is not None and st.down)
+
+    def peer_load(self, hid: int) -> float:
+        st = self._peers.get(int(hid))
+        return float(st.load) if st is not None else 0.0
+
+    def live_hosts(self) -> List[int]:
+        """Every host currently believed up, self included."""
+        return [self.host_id] + [
+            h for h, st in self._peers.items() if not st.down
+        ]
+
+    # -- cross-host ops -----------------------------------------------------
+
+    def check_rows_async(
+        self, hid: int, rows, rest_depth: int,
+        timeout_s: Optional[float],
+    ) -> _Pending:
+        """Ship one wave's cross-host rows to their serving host as ONE
+        framed round trip, concurrently with the local device dispatch.
+        The returned pending resolves to the verdict array, or None —
+        the caller degrades those rows to the oracle."""
+        pending = _Pending()
+        meta = {
+            "op": "check", "host": self.host_id,
+            "depth": int(rest_depth), "n": len(rows),
+        }
+        if timeout_s is not None:
+            meta["deadline_ms"] = max(1, int(timeout_s * 1000))
+        arrays: Dict[str, np.ndarray] = {}
+        wire.pack_tuplecols(arrays, "q", rows)
+
+        def _run():
+            t0 = time.monotonic()
+            try:
+                resp, resp_arrays = self._client(hid).call(
+                    meta, arrays, timeout=timeout_s,
+                )
+                ok = np.asarray(resp_arrays["ok"], np.uint8)
+                if ok.shape[0] != len(rows):
+                    raise wire.WireError(
+                        "peer check verdict count mismatch"
+                    )
+                pending.value = ok.astype(bool)
+            except BaseException as e:  # noqa: BLE001 - reported via wait
+                pending.error = e
+            else:
+                with self._state_lock:
+                    st = self._peers.get(hid)
+                    if st is not None:
+                        st.roundtrips += 1
+                        st.rtts.append(time.monotonic() - t0)
+            pending._evt.set()
+
+        threading.Thread(
+            target=_run, name=f"keto-peerlink-check-{hid}", daemon=True,
+        ).start()
+        return pending
+
+    def bootstrap_from(self, hid: int, *, timeout_s: float = 60.0):
+        """Pull the peer's projected base snapshot (segment ship): the
+        checkpoint codec's array dict + the cursor it was captured at.
+        Returns ``(snap, cursor)`` ready for ``adopt_snapshot``."""
+        from ketotpu.engine import checkpoint as ckpt
+
+        resp, arrays = self._client(hid).call(
+            {"op": "bootstrap", "host": self.host_id},
+            timeout=timeout_s,
+        )
+        snap = ckpt.snapshot_from_arrays(arrays)
+        with self._state_lock:
+            st = self._peers.get(hid)
+            if st is not None:
+                st.bootstraps += 1
+        return snap, int(resp["cursor"])
+
+    # -- server dispatch ----------------------------------------------------
+
+    def _serve(self, meta: dict, arrays) -> Tuple[dict, Optional[dict]]:
+        from ketotpu import deadline
+
+        op = meta.get("op")
+        if op == "ping":
+            return {"ok": True, "host": self.host_id}, None
+        if op == "heartbeat":
+            self._note_alive_from_wire(meta)
+            eng = self._engine
+            payload = eng._hb_payload() if eng is not None else {}
+            return {"ok": True, "host": self.host_id, **payload}, None
+        if op == "check":
+            eng = self._engine
+            if eng is None:
+                raise KetoAPIError("no engine attached to this peer")
+            rows = wire.unpack_tuplecols(arrays, "q")
+            ms = meta.get("deadline_ms")
+            with deadline.scope(None if ms is None else ms / 1000.0):
+                ok = eng._peer_serve_check(
+                    rows, int(meta.get("depth", 0))
+                )
+            return (
+                {"ok": True, "n": len(ok)},
+                {"ok": np.asarray(ok, np.uint8)},
+            )
+        if op == "bootstrap":
+            from ketotpu.engine import checkpoint as ckpt
+
+            eng = self._engine
+            if eng is None:
+                raise KetoAPIError("no engine attached to this peer")
+            snap, cursor, fingerprint, _rows, _tail, _head, _version = (
+                eng.replication_snapshot()
+            )
+            return (
+                {"ok": True, "cursor": int(cursor),
+                 "fingerprint": int(fingerprint)},
+                ckpt.snapshot_to_arrays(snap),
+            )
+        raise KetoAPIError(f"unknown peerlink op {op!r}")
+
+    def _note_alive_from_wire(self, meta: dict) -> None:
+        try:
+            hid = int(meta.get("host", -1))
+        except (TypeError, ValueError):
+            return
+        if hid in self._peers:
+            self._note_alive(hid, meta)
+
+    # -- observability ------------------------------------------------------
+
+    def frontier_rtt_p50_ms(self) -> float:
+        samples: List[float] = []
+        with self._state_lock:
+            for st in self._peers.values():
+                samples.extend(st.rtts)
+        if not samples:
+            return 0.0
+        samples.sort()
+        return round(1000.0 * samples[len(samples) // 2], 3)
+
+    def peer_rows(self) -> List[dict]:
+        """Per-peer rows for ``/debug/mesh`` and the wave ledger: id,
+        heartbeat age, liveness, shards owned, replica keys, frontier
+        round trips."""
+        now = time.monotonic()
+        out = []
+        with self._state_lock:
+            for hid in sorted(self._peers):
+                st = self._peers[hid]
+                rtts = sorted(st.rtts)
+                out.append({
+                    "peer": hid,
+                    "addr": "%s:%d" % self.addrs[hid],
+                    "down": bool(st.down),
+                    "heartbeat_age_s": (
+                        round(now - st.last_seen, 3)
+                        if st.last_seen else -1.0
+                    ),
+                    "misses": int(st.misses),
+                    "load": float(st.load),
+                    "shards_owned": int(st.shards),
+                    "cursor": int(st.cursor),
+                    "replica_keys": int(st.replica_keys),
+                    "frontier_roundtrips": int(st.roundtrips),
+                    "frontier_rtt_p50_ms": (
+                        round(1000.0 * rtts[len(rtts) // 2], 3)
+                        if rtts else 0.0
+                    ),
+                    "bootstraps": int(st.bootstraps),
+                })
+        return out
+
+    def stats(self) -> dict:
+        rows = self.peer_rows()
+        return {
+            "host_id": self.host_id,
+            "n_hosts": self.n_hosts,
+            "addr": "%s:%d" % self.addr,
+            "hosts_down": sum(1 for r in rows if r["down"]),
+            "host_downs_total": int(self.host_downs),
+            "peer_recoveries": int(self.peer_recoveries),
+            "frontier_rtt_p50_ms": self.frontier_rtt_p50_ms(),
+            "peers": rows,
+        }

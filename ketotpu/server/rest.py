@@ -785,13 +785,17 @@ def metrics_router(registry) -> Router:
     def get_mesh(req):
         # sharded-serving state (parallel/meshengine.py): per-shard
         # batches/fallbacks/replica keys/down flags, the published
-        # replica map, and the replication/rebalance/failover counters;
+        # replica map, the replication/rebalance/failover counters, and
+        # — on a multi-host topology — per-peer rows (id, liveness,
+        # heartbeat age, shards owned, replica keys, frontier round
+        # trips) so `status --debug` explains a degraded topology;
         # {} when the engine is not sharded
         eng = registry.check_engine()
         eng = getattr(eng, "inner", eng)
         stats_fn = getattr(eng, "mesh_stats", None)
         if stats_fn is None:
             return 200, {}
+        peers_fn = getattr(eng, "peer_stats", None)
         return 200, {
             **stats_fn(),
             "shards": eng.shard_stats(),
@@ -799,6 +803,7 @@ def metrics_router(registry) -> Router:
                 {"ns": k[0], "obj": k[1], "replicas": list(v)}
                 for k, v in sorted(eng._replica_map.items())
             ],
+            "hosts": peers_fn() if peers_fn is not None else [],
         }
 
     rt.add("GET", "/debug/mesh", get_mesh)

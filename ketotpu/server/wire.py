@@ -254,16 +254,24 @@ def send_frame(
 
 def recv_frame(
     rfile, *, shm_cache: Optional[ShmCache] = None,
+    max_meta: int = 0, max_bin: int = 0,
 ) -> Optional[Tuple[dict, Dict[str, np.ndarray], int]]:
     """Read one frame from a buffered file object; None on clean EOF.
-    Returns (meta, arrays, socket_bytes_read)."""
+    Returns (meta, arrays, socket_bytes_read).
+
+    ``max_meta``/``max_bin`` tighten the global caps per channel: the
+    cross-host PeerLink lane faces untrusted networks and refuses frames
+    a same-host worker wire would still accept (0 keeps the defaults).
+    """
     head = rfile.read(HEADER.size)
     if not head:
         return None
     if len(head) < HEADER.size:
         raise WireError("truncated frame header")
     meta_len, bin_len = HEADER.unpack(head)
-    if meta_len > MAX_META or bin_len > MAX_BIN:
+    meta_cap = min(MAX_META, max_meta) if max_meta > 0 else MAX_META
+    bin_cap = min(MAX_BIN, max_bin) if max_bin > 0 else MAX_BIN
+    if meta_len > meta_cap or bin_len > bin_cap:
         raise WireError(
             f"frame sizes out of range (meta={meta_len}, bin={bin_len})"
         )

@@ -671,16 +671,39 @@ class ReplicationGate:
 
 
 class _Conn:
-    def __init__(self, path: str, *, metrics=None, shm_threshold: int = 0):
-        self.sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        self.sock.connect(path)
+    def __init__(self, path, *, metrics=None, shm_threshold: int = 0,
+                 connect_timeout: Optional[float] = None):
+        # ``path`` is a unix-socket path (the same-host worker wire) or a
+        # ``(host, port)`` tuple — the TCP form the cross-host PeerLink
+        # lane (parallel/peerlink.py) reuses; the framing discipline
+        # (strict one-response-per-request, discard on any transport
+        # error) is identical on both transports
+        if isinstance(path, tuple):
+            self.sock = socket.create_connection(
+                path, timeout=connect_timeout
+            )
+            self.sock.setsockopt(
+                socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+            )
+            self.sock.settimeout(None)
+        else:
+            self.sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            if connect_timeout is not None:
+                self.sock.settimeout(connect_timeout)
+            self.sock.connect(path)
+            self.sock.settimeout(None)
         self.rfile = self.sock.makefile("rb")
         self.lock = threading.Lock()
         self.broken = False
         self._metrics = metrics
-        self._shm_threshold = int(shm_threshold)
-        self._ring = wire.ShmRing()
-        self._shm_cache = wire.ShmCache()
+        # the shared-memory hop is a SAME-HOST optimization: on TCP the
+        # peer is (potentially) another machine, so large payloads stay
+        # on the socket and an inbound shm descriptor is a protocol
+        # violation (recv_frame with no cache raises WireError)
+        self._tcp = isinstance(path, tuple)
+        self._shm_threshold = 0 if self._tcp else int(shm_threshold)
+        self._ring = None if self._tcp else wire.ShmRing()
+        self._shm_cache = None if self._tcp else wire.ShmCache()
 
     def close(self) -> None:
         self.broken = True
@@ -688,8 +711,10 @@ class _Conn:
             self.sock.close()
         except OSError:
             pass
-        self._ring.close()
-        self._shm_cache.close()
+        if self._ring is not None:
+            self._ring.close()
+        if self._shm_cache is not None:
+            self._shm_cache.close()
 
     def _count(self, direction: str, nbytes: int) -> None:
         if self._metrics is not None:

@@ -85,12 +85,17 @@ class WaveLedger:
         # single-fetch-per-wave invariant the serving bench asserts
         fused_waves = fused_d2h = 0
         fused_tiers: Dict[str, int] = {}
+        # multi-host mesh: ring-wide sums of each wave's per-peer
+        # shipped-row deltas — how much of the recent window crossed DCN
+        peer_rows: Dict[str, int] = {}
         for e in entries:
             f = e.get("fused") or {}
             fused_waves += int(f.get("waves", 0))
             fused_d2h += int(f.get("d2h_fetches", 0))
             for t, d in (f.get("tiers") or {}).items():
                 fused_tiers[t] = fused_tiers.get(t, 0) + int(d)
+            for h, d in (e.get("peers") or {}).items():
+                peer_rows[h] = peer_rows.get(h, 0) + int(d)
         return {
             "waves_recorded": recorded,
             "waves_in_ring": n,
@@ -104,4 +109,5 @@ class WaveLedger:
             "fused_waves": fused_waves,
             "fused_d2h_fetches": fused_d2h,
             "fused_tier_rows": fused_tiers,
+            "peer_rows": peer_rows,
         }

@@ -1252,3 +1252,105 @@ class TestShadowZeroDivergenceUnderChaos:
         finally:
             stop.set()
             srv.stop()
+
+
+# -- ISSUE 14: whole-host kill mid-storm against the 2-host mesh -------------
+
+
+class TestMeshHostKillStorm:
+    """Killing one of two owner hosts mid-storm must not stop (or skew)
+    a single wave: heartbeat loss marks every shard the dead peer owns
+    down AT ONCE, its rows degrade to cross-host replicas or the host
+    oracle, verdicts stay bit-identical throughout (zero divergence),
+    the fallback attribution moves ONLY on the dead peer — local shard
+    gauges stay flat — and the returning peer rejoins warm."""
+
+    @pytest.mark.slow
+    def test_host_kill_mid_storm_zero_divergence(self):
+        from ketotpu.parallel import HostLink, MeshCheckEngine
+        from ketotpu.utils.synth import build_synth, synth_queries
+
+        graph = build_synth(n_users=128, n_groups=8, n_folders=64,
+                            n_docs=256, seed=9)
+        links = [
+            HostLink(
+                h, ["127.0.0.1:0", "127.0.0.1:0"], "chaos-secret",
+                heartbeat_ms=100, miss_budget=2, rpc_timeout_ms=180000,
+            )
+            for h in range(2)
+        ]
+        a0, a1 = links[0].bind(), links[1].bind()
+        links[0].set_peer_addr(1, a1)
+        links[1].set_peer_addr(0, a0)
+        engs = [
+            MeshCheckEngine(
+                graph.store, graph.manager, mesh_devices=4,
+                frontier=1024, arena=4096, max_batch=512,
+                hostlink=links[h],
+            )
+            for h in range(2)
+        ]
+        try:
+            # warm both hosts locally (XLA compile) before the storm
+            warm = synth_queries(graph, 96, seed=61)
+            for e in (engs[1], engs[0]):
+                e._peer_serve_check(warm, 0)
+            for l in links:
+                l.heartbeat_now()
+
+            rounds = [
+                synth_queries(graph, 64, seed=300 + r) for r in range(8)
+            ]
+            wants = [
+                [engs[0].oracle.check_is_member(q) for q in qs]
+                for qs in rounds
+            ]
+            # absorb first-shape compiles on both sides of the lane so
+            # the storm below runs at steady state
+            assert engs[0].batch_check(rounds[0]) == wants[0]
+            shard_fb0 = int(engs[0]._shard_fallbacks.sum())
+            mismatches = []
+
+            def fire(qs, want):
+                got = engs[0].batch_check(qs)
+                if got != want:
+                    mismatches.append((got, want))
+
+            threads = [
+                threading.Thread(target=fire, args=(qs, w), daemon=True)
+                for qs, w in zip(rounds, wants)
+            ]
+            for t in threads:
+                t.start()
+            # kill host 1 mid-storm: its PeerLink goes silent (frames
+            # unanswered, heartbeats stop) exactly like a dead process
+            time.sleep(0.2)
+            faults.configure(peer_down=1)
+            for _ in range(links[0].miss_budget):
+                links[0].heartbeat_now()
+            assert links[0].peer_down(1)
+            for t in threads:
+                t.join(timeout=300.0)
+            assert not any(t.is_alive() for t in threads), "storm wedged"
+            assert not mismatches, mismatches[:2]  # zero divergence
+            assert engs[0].mesh_stats()["hosts_down"] == 1
+            # every degraded verdict is attributed to the dead PEER;
+            # the local shard fallback gauges must not move at all
+            assert int(engs[0]._peer_fallbacks[1]) > 0
+            assert int(engs[0]._shard_fallbacks.sum()) == shard_fb0
+
+            # recovery: clearing the fault and answering one beat marks
+            # the host up; rows route cross-host again, still exact
+            faults.reset()
+            rec0 = links[0].peer_recoveries
+            links[0].heartbeat_now()
+            assert not links[0].peer_down(1)
+            assert links[0].peer_recoveries == rec0 + 1
+            routed0 = int(engs[0].peer_route_counts()[1])
+            assert engs[0].batch_check(rounds[0]) == wants[0]
+            assert int(engs[0].peer_route_counts()[1]) > routed0
+            assert engs[0].mesh_stats()["hosts_down"] == 0
+        finally:
+            faults.reset()
+            for e in engs:
+                e.close()

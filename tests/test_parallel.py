@@ -740,3 +740,61 @@ def test_mesh_shard_failover_and_recovery():
     assert not eng._shard_down.any()
     assert eng.shard_stats()[victim]["fallbacks"] == 0
     assert eng.mesh_stats()["shard_recoveries"] >= 1
+
+
+# -- ISSUE 14: cross-host topology plumbing ----------------------------------
+
+
+def test_host_of_is_a_frozen_wire_contract():
+    """Every host of the mesh must compute the same owner for the same
+    key across processes, versions, and restarts — the coordinate is
+    part of the DCN wire contract, so its values are frozen here.  A
+    deliberate hash change must bump the peerlink PROTO."""
+    from ketotpu.parallel import host_of
+
+    assert host_of("Doc", "d1", 2) == 0
+    assert host_of("Group", "g0", 2) == 0
+    assert host_of("Folder", "f3", 5) == 3
+    assert host_of("File", "keto/README.md", 3) == 0
+    # 1-host topologies short-circuit; the separator keys (ns, obj)
+    # unambiguously
+    assert host_of("anything", "at-all", 1) == 0
+    assert all(0 <= host_of("Doc", f"d{i}", 7) < 7 for i in range(64))
+
+
+def test_mesh_hosts_config_validation():
+    from ketotpu.driver import ConfigError, Provider
+
+    # peers + secret round-trip
+    p = Provider({"engine": {"mesh": {"hosts": {
+        "host_id": 1,
+        "peers": ["10.0.0.1:7701", "10.0.0.2:7701"],
+        "secret": "s3",
+    }}}})
+    assert p.get("engine.mesh.hosts.host_id") == 1
+    # host_id must index the peer list
+    with pytest.raises(ConfigError) as e:
+        Provider({"engine": {"mesh": {"hosts": {
+            "host_id": 2,
+            "peers": ["10.0.0.1:7701", "10.0.0.2:7701"],
+            "secret": "s3",
+        }}}})
+    assert "engine.mesh.hosts.host_id" in str(e.value)
+    # a topology needs at least two hosts
+    with pytest.raises(ConfigError):
+        Provider({"engine": {"mesh": {"hosts": {
+            "host_id": 0, "peers": ["10.0.0.1:7701"], "secret": "s3",
+        }}}})
+    # and a shared secret (untrusted TCP)
+    with pytest.raises(ConfigError) as e:
+        Provider({"engine": {"mesh": {"hosts": {
+            "host_id": 0,
+            "peers": ["10.0.0.1:7701", "10.0.0.2:7701"],
+        }}}})
+    assert "engine.mesh.hosts.secret" in str(e.value)
+    # peers must be host:port strings
+    with pytest.raises(ConfigError):
+        Provider({"engine": {"mesh": {"hosts": {
+            "host_id": 0, "peers": ["nope", "10.0.0.2:7701"],
+            "secret": "s3",
+        }}}})
