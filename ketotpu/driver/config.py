@@ -301,6 +301,33 @@ def _defaults() -> Dict[str, Any]:
                 "queue_cap": 1024,
                 "ledger_size": 256,
             },
+            # SLO burn-rate engine (ketotpu/slo.py): windowed availability
+            # + latency SLIs per op from the outcome histogram, exposed as
+            # keto_slo_* gauges and GET /debug/slo.  latency_target_ms is
+            # snapped to the nearest histogram bucket bound.
+            "slo": {
+                "enabled": True,
+                "latency_target_ms": 25.0,
+                "fast_window_s": 300,
+                "slow_window_s": 3600,
+                "availability_objective": 0.999,
+                "latency_objective": 0.99,
+            },
+            # regression watchdog (ketotpu/watchdog.py): background rule
+            # loop filing incidents (GET /debug/incidents) on after-warm
+            # compiles, wave device-ms drift, shadow divergences, and
+            # fast-window burn alarms; auto_profile arms one automatic
+            # profiler capture per cooldown on incident
+            "watchdog": {
+                "enabled": True,
+                "interval_s": 5.0,
+                "baseline_waves": 32,
+                "drift_pct": 75.0,
+                "incident_cap": 64,
+                "burn_threshold": 2.0,
+                "auto_profile": False,
+                "profile_cooldown_s": 600,
+            },
         },
         # warm-standby durability (ketotpu/standby.py + server/workers.py):
         # `socket` publishes the owner's engine-host unix socket (the
@@ -422,7 +449,13 @@ class Provider:
                           "standby_port", "tail_drop_rate",
                           "peer_down", "peer_drop_rate",
                           "peer_latency_ms", "host_id",
-                          "max_frame_mb", "rpc_timeout_ms"):
+                          "max_frame_mb", "rpc_timeout_ms",
+                          "latency_target_ms", "fast_window_s",
+                          "slow_window_s", "availability_objective",
+                          "latency_objective", "interval_s",
+                          "baseline_waves", "drift_pct", "incident_cap",
+                          "burn_threshold", "auto_profile",
+                          "profile_cooldown_s"):
                 suffix = known.split("_")
                 if len(joined) > len(suffix) and joined[-len(suffix):] == suffix:
                     joined = joined[: -len(suffix)] + [known]
@@ -812,4 +845,41 @@ class Provider:
             raise ConfigError(
                 "observability.trace.slow_ms",
                 f"must be a non-negative number, got {val!r}",
+            )
+        for key in ("observability.slo.enabled",
+                    "observability.watchdog.enabled",
+                    "observability.watchdog.auto_profile"):
+            val = self.get(key)
+            if not isinstance(val, bool):
+                raise ConfigError(key, f"must be a boolean, got {val!r}")
+        for key in ("observability.slo.latency_target_ms",
+                    "observability.slo.fast_window_s",
+                    "observability.slo.slow_window_s",
+                    "observability.watchdog.interval_s",
+                    "observability.watchdog.burn_threshold",
+                    "observability.watchdog.profile_cooldown_s"):
+            val = self.get(key)
+            if not isinstance(val, (int, float)) or val <= 0:
+                raise ConfigError(
+                    key, f"must be a positive number, got {val!r}"
+                )
+        for key in ("observability.slo.availability_objective",
+                    "observability.slo.latency_objective"):
+            val = self.get(key)
+            if not isinstance(val, (int, float)) or not 0.0 < val < 1.0:
+                raise ConfigError(
+                    key, f"must be a fraction in (0, 1), got {val!r}"
+                )
+        for key in ("observability.watchdog.baseline_waves",
+                    "observability.watchdog.incident_cap"):
+            val = self.get(key)
+            if not isinstance(val, int) or val < 1:
+                raise ConfigError(
+                    key, f"must be a positive integer, got {val!r}"
+                )
+        val = self.get("observability.watchdog.drift_pct")
+        if not isinstance(val, (int, float)) or val <= 0:
+            raise ConfigError(
+                "observability.watchdog.drift_pct",
+                f"must be a positive number, got {val!r}",
             )

@@ -23,12 +23,13 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 import uuid
 from typing import Any, Callable, Dict, Optional
 
 import numpy as np
 
-from ketotpu import __version__
+from ketotpu import __version__, compilewatch
 from ketotpu.api.mapper import Mapper
 from ketotpu.api.uuid_map import UUIDMapper
 from ketotpu.driver.config import ConfigError, Provider
@@ -87,6 +88,10 @@ class Registry:
         self._trace_store_built = False
         self._shadow = None
         self._shadow_built = False
+        self._slo = None
+        self._slo_built = False
+        self._watchdog = None
+        self._watchdog_built = False
         self._profiler = None
         self._compile_watch = None
         self._admission = None
@@ -266,6 +271,161 @@ class Registry:
                         ),
                     )
             return self._shadow
+
+    def slo(self):
+        """Lazy multi-window SLO burn-rate engine (ketotpu/slo.py): the
+        windowed availability/latency SLIs behind GET /debug/slo, the
+        keto_slo_* gauges, and the fleet digest's burn numbers.  None
+        when ``observability.slo.enabled`` is false."""
+        with self._lock:
+            if not self._slo_built:
+                self._slo_built = True
+                if bool(self.config.get("observability.slo.enabled", True)):
+                    from ketotpu.slo import SLOEngine
+
+                    self._slo = SLOEngine(
+                        self.metrics(),
+                        latency_target_ms=float(
+                            self.config.get(
+                                "observability.slo.latency_target_ms", 25.0
+                            )
+                        ),
+                        fast_window_s=float(
+                            self.config.get(
+                                "observability.slo.fast_window_s", 300
+                            ) or 300
+                        ),
+                        slow_window_s=float(
+                            self.config.get(
+                                "observability.slo.slow_window_s", 3600
+                            ) or 3600
+                        ),
+                        availability_objective=float(
+                            self.config.get(
+                                "observability.slo.availability_objective",
+                                0.999,
+                            )
+                        ),
+                        latency_objective=float(
+                            self.config.get(
+                                "observability.slo.latency_objective", 0.99
+                            )
+                        ),
+                    )
+            return self._slo
+
+    def watchdog(self):
+        """Lazy regression watchdog (ketotpu/watchdog.py): the background
+        rule evaluator behind GET /debug/incidents.  None when
+        ``observability.watchdog.enabled`` is false; started by
+        :meth:`init` (daemon boot), stopped by :meth:`close_engines`."""
+        with self._lock:
+            if not self._watchdog_built:
+                self._watchdog_built = True
+                if bool(
+                    self.config.get("observability.watchdog.enabled", True)
+                ):
+                    from ketotpu.watchdog import Watchdog
+
+                    self._watchdog = Watchdog(
+                        self,
+                        interval_s=float(
+                            self.config.get(
+                                "observability.watchdog.interval_s", 5.0
+                            ) or 5.0
+                        ),
+                        baseline_waves=int(
+                            self.config.get(
+                                "observability.watchdog.baseline_waves", 32
+                            ) or 32
+                        ),
+                        drift_pct=float(
+                            self.config.get(
+                                "observability.watchdog.drift_pct", 75.0
+                            ) or 75.0
+                        ),
+                        incident_cap=int(
+                            self.config.get(
+                                "observability.watchdog.incident_cap", 64
+                            ) or 64
+                        ),
+                        burn_threshold=float(
+                            self.config.get(
+                                "observability.watchdog.burn_threshold", 2.0
+                            ) or 2.0
+                        ),
+                        auto_profile=bool(
+                            self.config.get(
+                                "observability.watchdog.auto_profile", False
+                            )
+                        ),
+                        profile_cooldown_s=float(
+                            self.config.get(
+                                "observability.watchdog.profile_cooldown_s",
+                                600,
+                            ) or 600
+                        ),
+                    )
+            return self._watchdog
+
+    def hostlink(self):
+        """The multi-host DCN lane of the BUILT serving engine, or None
+        (single host, or the engine is not built yet) — a fleet/health
+        probe must never trigger the lazy engine build."""
+        with self._lock:
+            outer = self._check_engine
+        eng = getattr(outer, "inner", outer)
+        return getattr(eng, "hostlink", None)
+
+    def health_digest(self) -> dict:
+        """The compact per-host health digest that rides every heartbeat
+        (both directions) and heads the local half of GET /debug/fleet:
+        SLO burn rates, wave device-ms p50, after-warm compile count,
+        shed/divergence counters, standby lag, incident count.  Built
+        only from already-built components — it runs on the heartbeat
+        cadence and must stay cheap."""
+        link = self.hostlink()
+        metrics = self.metrics()
+        shed = sum(
+            metrics.get_counter(
+                "keto_requests_shed_total", transport=t
+            ) for t in ("rest", "grpc", "batch")
+        )
+        with self._lock:
+            shadow = self._shadow
+            ledger = self._wave_ledger
+            watchdog = self._watchdog
+            standby_fn = self.standby_state_fn
+        digest = {
+            "host": int(link.host_id) if link is not None else 0,
+            "pid": os.getpid(),
+            "ts": round(time.time(), 3),
+            "shed_total": int(shed),
+            "divergences": int(
+                getattr(shadow, "divergences", 0) if shadow else 0
+            ),
+            "compiles_after_warm": int(
+                compilewatch.get().compiles_after_warm
+            ),
+            "incidents": int(
+                watchdog.stats()["incidents_filed"] if watchdog else 0
+            ),
+        }
+        slo = self.slo()
+        if slo is not None:
+            digest["burn"] = slo.digest()
+        if ledger is not None:
+            digest["wave_device_ms_p50"] = (
+                ledger.stats()["device_ms_p50"]
+            )
+        if standby_fn is not None:
+            try:
+                digest["standby_lag_entries"] = int(
+                    standby_fn().get("lag_entries", 0)
+                )
+            except Exception:  # noqa: BLE001 - health must not raise
+                pass
+        return digest
 
     def compile_watch(self):
         """The process-global XLA compile observatory
@@ -577,6 +737,11 @@ class Registry:
         listen = str(self.config.get("engine.mesh.hosts.listen") or "")
         if listen:
             link.set_peer_addr(hid, listen)
+        # fleet-health seams: inbound frontier checks record under the
+        # caller's trace id (span shipping), and every heartbeat carries
+        # this host's health digest
+        link.registry = self
+        link.digest_fn = self.health_digest
         link.bind()
         link.start()
         return link
@@ -932,6 +1097,12 @@ class Registry:
                     "resumed" if resumed else "stale/absent, will refresh",
                 )
             eng.snapshot()
+        # arm the fleet health plane: the SLO engine pre-registers its
+        # gauge vocabulary, the watchdog starts its rule-evaluation loop
+        self.slo()
+        wd = self.watchdog()
+        if wd is not None:
+            wd.start()
         return self
 
     def sample_engine_metrics(self) -> None:
@@ -966,6 +1137,37 @@ class Registry:
             m.gauge("keto_shadow_divergence_ledger_size",
                     len(shadow.ledger()),
                     help="divergence records currently held")
+        # SLO plane: advance the delta ring and refresh keto_slo_* gauges
+        # on every scrape, so burn rates stay live without request-path work
+        slo = self.slo()
+        if slo is not None:
+            try:
+                slo.publish()
+            except Exception:  # noqa: BLE001 - scrape must not fail
+                pass
+        # fleet view: how many DCN peers are reporting health digests and
+        # the worst fast-window burn heard across them via heartbeats
+        link = self.hostlink()
+        if link is not None:
+            m = self.metrics()
+            reporting = 0
+            peer_burn = 0.0
+            for row in link.peer_rows():
+                digest = row.get("digest")
+                if isinstance(digest, dict):
+                    reporting += 1
+                    burn = digest.get("burn")
+                    if isinstance(burn, dict):
+                        try:
+                            peer_burn = max(
+                                peer_burn, float(burn.get("fast", 0.0))
+                            )
+                        except (TypeError, ValueError):
+                            pass
+            m.gauge("keto_fleet_peers_reporting", reporting,
+                    help="DCN peers whose heartbeats carry a health digest")
+            m.gauge("keto_fleet_peer_burn_fast_max", peer_burn,
+                    help="worst fast-window SLO burn reported by any peer")
         with self._lock:
             ledger = self._wave_ledger
         if ledger is not None:
@@ -1222,7 +1424,8 @@ class Registry:
             shadows = [self._shadow] + [
                 t._shadow for t in self._tenants.values()
             ]
-        for eng in engines + hubs + shadows:
+            watchdogs = [self._watchdog]
+        for eng in engines + hubs + shadows + watchdogs:
             close = getattr(eng, "close", None)
             if close is not None:
                 try:

@@ -38,6 +38,12 @@ _local = threading.local()
 STAGE_METRIC = "keto_rpc_stage_seconds"
 _STAGE_HELP = "per-RPC stage wall time decomposition"
 
+#: per-request end-to-end latency bucketed by op and outcome — the SLO
+#: engine's sole feed (slo.py): availability = ok / all outcomes,
+#: latency compliance = ok requests under the target bucket / ok total
+OUTCOME_METRIC = "keto_request_outcome_seconds"
+_OUTCOME_HELP = "request latency by op and outcome (ok/shed/error)"
+
 #: per-request span-buffer cap — a runaway fan-out must not grow an
 #: unbounded timeline; the rpc-level span is always appended last
 MAX_SPANS = 128
@@ -276,6 +282,18 @@ def rpc_recording(registry, op: str, *, traceparent: Optional[str] = None,
     finally:
         _local.ctx = None
         total = time.perf_counter() - ctx.t0
+        if metrics is not None:
+            status = ctx.info.get("status")
+            outcome = "ok"
+            if isinstance(status, int):
+                if status == 429:
+                    outcome = "shed"
+                elif status >= 500:
+                    outcome = "error"
+            metrics.observe(
+                OUTCOME_METRIC, total, help=_OUTCOME_HELP,
+                op=op, outcome=outcome,
+            )
         if recorder is not None:
             entry = {
                 "op": op,

@@ -39,6 +39,10 @@ RELATIONTUPLES_CHANGED = "RelationtuplesChanged"
 _BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
             1.0, 2.5, 5.0, 10.0)
 
+#: public histogram bucket bounds (seconds) — the SLO engine snaps its
+#: latency target onto one of these so "fraction under target" is exact
+BUCKETS = _BUCKETS
+
 
 # -- W3C trace context (traceparent) -----------------------------------------
 
@@ -129,6 +133,19 @@ class Metrics:
         with self._lock:
             return {
                 labels: (h[1], h[2])
+                for (n, labels), h in self._hists.items()
+                if n == name
+            }
+
+    def histogram_buckets(
+        self, name: str
+    ) -> Dict[Tuple[Tuple[str, str], ...], Tuple[List[int], float, int]]:
+        """{label-tuple: (per-bucket counts incl. +Inf, sum, count)} for
+        every series of ``name``.  Bucket bounds are :data:`BUCKETS`; the
+        SLO engine reads cumulative-under-target counts off this."""
+        with self._lock:
+            return {
+                labels: (list(h[0]), h[1], h[2])
                 for (n, labels), h in self._hists.items()
                 if n == name
             }

@@ -897,6 +897,12 @@ class MeshCheckEngine(DeviceCheckEngine):
                 if ok is not None:
                     allowed[idx] = ok
                     fallback[idx] = False
+                    if pending.spans:
+                        # the peer recorded under OUR trace id and shipped
+                        # its host-stamped timeline back with the verdicts
+                        # — adopt it into this request's open span buffer
+                        # (no-op when no ctx is open, e.g. wave threads)
+                        flightrec.merge_spans(pending.spans)
                     continue
                 # the peer never answered inside the budget: those rows
                 # ride the oracle.  A clean timeout is deadline

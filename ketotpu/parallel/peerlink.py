@@ -48,7 +48,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ketotpu import faults
+from ketotpu import faults, flightrec
 from ketotpu.api.types import KetoAPIError
 from ketotpu.server import wire
 
@@ -96,12 +96,15 @@ def _parse_addr(addr) -> Tuple[str, int]:
 class _Pending:
     """One in-flight cross-host frontier exchange (thread-backed)."""
 
-    __slots__ = ("_evt", "value", "error")
+    __slots__ = ("_evt", "value", "error", "spans")
 
     def __init__(self):
         self._evt = threading.Event()
         self.value: Optional[np.ndarray] = None
         self.error: Optional[BaseException] = None
+        # peer-host span timeline shipped back with the verdicts; the
+        # collector merges it into the origin request's open trace ctx
+        self.spans: Optional[list] = None
 
     def wait(self, timeout: Optional[float]) -> Optional[np.ndarray]:
         """Verdict array, or None on failure/timeout (caller degrades)."""
@@ -113,7 +116,7 @@ class _Pending:
 class _PeerState:
     __slots__ = ("last_seen", "misses", "down", "load", "shards",
                  "cursor", "replica_keys", "roundtrips", "rtts",
-                 "bootstraps")
+                 "bootstraps", "digest")
 
     def __init__(self):
         self.last_seen = 0.0   # monotonic; 0 = never heard from
@@ -126,6 +129,10 @@ class _PeerState:
         self.roundtrips = 0    # frontier (check) round trips completed
         self.rtts: deque = deque(maxlen=256)  # frontier RTTs, seconds
         self.bootstraps = 0
+        # last health digest this peer's heartbeat carried; None until
+        # one arrives (older PROTO payloads never send the field, so the
+        # fleet view renders those peers as digest-unavailable)
+        self.digest: Optional[dict] = None
 
 
 class _PeerHandler(socketserver.StreamRequestHandler):
@@ -317,6 +324,14 @@ class HostLink:
         self._clients: Dict[int, _PeerClient] = {}
         self.host_downs = 0        # peers declared down (cumulative)
         self.peer_recoveries = 0   # peers that came back after down
+        # fleet-health seams, wired by Registry._build_hostlink: with a
+        # registry, inbound frontier checks record under the caller's
+        # traceparent and ship their spans back; with a digest_fn, every
+        # heartbeat (both directions) carries this host's health digest.
+        # Bare links (tests, older topologies) leave both None and the
+        # lane behaves exactly as before.
+        self.registry = None
+        self.digest_fn = None
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -398,8 +413,7 @@ class HostLink:
         sleeping)."""
         if faults.peer_silenced(self.host_id):
             return  # a silenced host is fully dead: it stops sending too
-        eng = self._engine
-        payload = eng._hb_payload() if eng is not None else {}
+        payload = self._local_payload()
         for hid in list(self._peers):
             try:
                 resp, _ = self._client(hid).call(
@@ -410,6 +424,20 @@ class HostLink:
                 self._note_miss(hid)
                 continue
             self._note_alive(hid, resp)
+
+    def _local_payload(self) -> dict:
+        """This host's heartbeat payload: the engine's topology fields
+        plus (when the registry wired one) the compact health digest —
+        absent entirely on bare links, which is what the legacy-payload
+        compatibility guard on the receive side expects."""
+        eng = self._engine
+        payload = eng._hb_payload() if eng is not None else {}
+        if self.digest_fn is not None:
+            try:
+                payload = dict(payload, digest=self.digest_fn())
+            except Exception:  # noqa: BLE001 - health must not kill beats
+                pass
+        return payload
 
     def _note_alive(self, hid: int, payload: dict) -> None:
         eng = self._engine
@@ -429,6 +457,11 @@ class HostLink:
             replicas = payload.get("replicas")
             if replicas is not None:
                 st.replica_keys = len(replicas)
+            digest = payload.get("digest")
+            if isinstance(digest, dict):
+                # legacy peers never send the field; keep whatever we
+                # last heard (None = never) instead of erasing it
+                st.digest = digest
             if was_down:
                 self.peer_recoveries += 1
         if eng is not None:
@@ -494,6 +527,12 @@ class HostLink:
         }
         if timeout_s is not None:
             meta["deadline_ms"] = max(1, int(timeout_s * 1000))
+        # captured HERE, on the dispatching thread, while the request's
+        # flightrec ctx is still open — the exchange thread below has no
+        # thread-local ctx of its own
+        tp = flightrec.current_traceparent()
+        if tp:
+            meta["traceparent"] = tp
         arrays: Dict[str, np.ndarray] = {}
         wire.pack_tuplecols(arrays, "q", rows)
 
@@ -508,6 +547,7 @@ class HostLink:
                     raise wire.WireError(
                         "peer check verdict count mismatch"
                     )
+                pending.spans = resp.get("spans") or None
                 pending.value = ok.astype(bool)
             except BaseException as e:  # noqa: BLE001 - reported via wait
                 pending.error = e
@@ -551,15 +591,45 @@ class HostLink:
             return {"ok": True, "host": self.host_id}, None
         if op == "heartbeat":
             self._note_alive_from_wire(meta)
-            eng = self._engine
-            payload = eng._hb_payload() if eng is not None else {}
-            return {"ok": True, "host": self.host_id, **payload}, None
+            # the reply carries this host's own payload (digest included)
+            # so one heartbeat call refreshes health in both directions
+            return {
+                "ok": True, "host": self.host_id, **self._local_payload(),
+            }, None
         if op == "check":
             eng = self._engine
             if eng is None:
                 raise KetoAPIError("no engine attached to this peer")
             rows = wire.unpack_tuplecols(arrays, "q")
             ms = meta.get("deadline_ms")
+            tp = meta.get("traceparent")
+            if tp and self.registry is not None:
+                # open a span buffer under the CALLER's trace id (the
+                # PR-11 owner↔worker pattern on the DCN lane): stage
+                # notes from the local cascade land here, and the whole
+                # timeline ships back with the verdicts — host-stamped
+                # so the stitched trace attributes every span
+                with flightrec.rpc_recording(
+                    self.registry, "peer_check", traceparent=tp,
+                    detail=(
+                        f"peer host {meta.get('host')} -> "
+                        f"host {self.host_id} frontier check"
+                    ),
+                ):
+                    with deadline.scope(
+                        None if ms is None else ms / 1000.0
+                    ):
+                        ok = eng._peer_serve_check(
+                            rows, int(meta.get("depth", 0))
+                        )
+                    spans = [
+                        dict(s, host=self.host_id)
+                        for s in flightrec.export_spans()
+                    ]
+                return (
+                    {"ok": True, "n": len(ok), "spans": spans},
+                    {"ok": np.asarray(ok, np.uint8)},
+                )
             with deadline.scope(None if ms is None else ms / 1000.0):
                 ok = eng._peer_serve_check(
                     rows, int(meta.get("depth", 0))
@@ -633,6 +703,9 @@ class HostLink:
                         if rtts else 0.0
                     ),
                     "bootstraps": int(st.bootstraps),
+                    # None = this peer has never sent one (legacy
+                    # payload); /debug/fleet renders that "unavailable"
+                    "digest": st.digest,
                 })
         return out
 

@@ -70,6 +70,7 @@ _ADMISSION_EXEMPT = {
     "/debug/flight-recorder", "/debug/waves", "/debug/compiles",
     "/debug/profile", "/debug/projection", "/debug/mesh",
     "/debug", "/debug/trace", "/debug/divergence", "/debug/handoff",
+    "/debug/slo", "/debug/fleet", "/debug/incidents",
 }
 
 # REST paths that get the full stage decomposition (flightrec context);
@@ -209,10 +210,30 @@ class Router:
         cors_for = getattr(registry.config, "cors_config", None)
         self.cors = cors_for(endpoint) if cors_for else None
         self.routes: Dict[Tuple[str, str], Callable] = {}
+        # one-line operator docs per route; /debug derives its index from
+        # these so a new surface can never be forgotten from the listing
+        self.route_docs: Dict[Tuple[str, str], str] = {}
         self._register_common()
 
-    def add(self, method: str, path: str, fn: Callable) -> None:
+    def add(self, method: str, path: str, fn: Callable,
+            describe: Optional[str] = None) -> None:
         self.routes[(method, path)] = fn
+        if describe:
+            self.route_docs[(method, path)] = describe
+
+    def debug_surfaces(self) -> Dict[str, str]:
+        """{path: one-liner} for every routed /debug/* surface (the
+        /debug index body) — generated from the routing table, so the
+        index and the routes cannot drift apart."""
+        surfaces: Dict[str, str] = {}
+        for (method, path) in sorted(self.routes):
+            if path == "/debug" or not path.startswith("/debug/"):
+                continue
+            doc = self.route_docs.get((method, path), "")
+            if method != "GET" and not doc.startswith(method):
+                doc = f"{method}: {doc}" if doc else method
+            surfaces[path] = doc
+        return surfaces
 
     # -- common routes (healthx + metrics on every router) -------------------
 
@@ -743,7 +764,9 @@ def metrics_router(registry) -> Router:
             "projection": registry.projection_stats(),
         }
 
-    rt.add("GET", "/debug/flight-recorder", get_flight_recorder)
+    rt.add("GET", "/debug/flight-recorder", get_flight_recorder,
+           describe="N slowest recent requests with stage vectors + "
+                    "hot keys")
 
     def get_waves(req):
         # wave ledger (ketotpu/waveledger.py): the last N dispatched
@@ -763,7 +786,9 @@ def metrics_router(registry) -> Router:
             "waves": ledger.snapshot(n=n, wave=wave),
         }
 
-    rt.add("GET", "/debug/waves", get_waves)
+    rt.add("GET", "/debug/waves", get_waves,
+           describe="wave ledger: recent device dispatch windows "
+                    "(?wave=<id>)")
 
     def get_compiles(req):
         # XLA compile observatory (ketotpu/compilewatch.py): totals per
@@ -771,7 +796,8 @@ def metrics_router(registry) -> Router:
         # whether the next compile would fire the after-warm alarm
         return 200, registry.compile_watch().snapshot()
 
-    rt.add("GET", "/debug/compiles", get_compiles)
+    rt.add("GET", "/debug/compiles", get_compiles,
+           describe="XLA compile observatory: totals + bounded event log")
 
     def get_projection(req):
         # projection/compaction observability (engine/tpu.py): snapshot
@@ -780,7 +806,9 @@ def metrics_router(registry) -> Router:
         # engine kind has no device projection
         return 200, registry.projection_stats()
 
-    rt.add("GET", "/debug/projection", get_projection)
+    rt.add("GET", "/debug/projection", get_projection,
+           describe="device projection: generation, folds, overlay, "
+                    "cursors")
 
     def get_mesh(req):
         # sharded-serving state (parallel/meshengine.py): per-shard
@@ -806,7 +834,8 @@ def metrics_router(registry) -> Router:
             "hosts": peers_fn() if peers_fn is not None else [],
         }
 
-    rt.add("GET", "/debug/mesh", get_mesh)
+    rt.add("GET", "/debug/mesh", get_mesh,
+           describe="sharded serving: per-shard state + replica map")
 
     def post_profile(req):
         # on-demand jax.profiler capture: config-gated (403 unarmed),
@@ -825,7 +854,8 @@ def metrics_router(registry) -> Router:
             return 409, {"error": {"code": 409, "message": str(e)}}
         return 200, artifact
 
-    rt.add("POST", "/debug/profile", post_profile)
+    rt.add("POST", "/debug/profile", post_profile,
+           describe="POST: on-demand jax.profiler capture (config-gated)")
 
     def post_handoff(req):
         # deliberate takeover (rolling restart): tells the warm-standby
@@ -842,34 +872,17 @@ def metrics_router(registry) -> Router:
         reason = str(req.query.get("reason", "handoff") or "handoff")
         return 200, dict(fn(reason) or {}, reason=reason)
 
-    rt.add("POST", "/debug/handoff", post_handoff)
+    rt.add("POST", "/debug/handoff", post_handoff,
+           describe="POST: promote the attached warm standby now "
+                    "(rolling restart; 409 when none)")
 
     def get_debug_index(req):
         # one stop for "what can I look at?": every debug surface on this
         # port with a one-liner, so an operator paging through an incident
-        # doesn't need the README open to find the next probe
-        return 200, {"surfaces": {
-            "/debug/flight-recorder":
-                "N slowest recent requests with stage vectors + hot keys",
-            "/debug/trace":
-                "tail-sampled promoted traces (?trace=<id> for one "
-                "stitched timeline)",
-            "/debug/divergence":
-                "shadow-verification divergence ledger + sampler stats",
-            "/debug/waves":
-                "wave ledger: recent device dispatch windows (?wave=<id>)",
-            "/debug/compiles":
-                "XLA compile observatory: totals + bounded event log",
-            "/debug/projection":
-                "device projection: generation, folds, overlay, cursors",
-            "/debug/mesh":
-                "sharded serving: per-shard state + replica map",
-            "/debug/profile":
-                "POST: on-demand jax.profiler capture (config-gated)",
-            "/debug/handoff":
-                "POST: promote the attached warm standby now (rolling "
-                "restart; 409 when none)",
-        }}
+        # doesn't need the README open to find the next probe.  Generated
+        # from the routing table (Router.debug_surfaces) so adding a
+        # surface automatically lists it here.
+        return 200, {"surfaces": rt.debug_surfaces()}
 
     rt.add("GET", "/debug", get_debug_index)
 
@@ -897,7 +910,9 @@ def metrics_router(registry) -> Router:
             "traces": ts.promoted(n=n),
         }
 
-    rt.add("GET", "/debug/trace", get_trace)
+    rt.add("GET", "/debug/trace", get_trace,
+           describe="tail-sampled promoted traces (?trace=<id> for one "
+                    "stitched timeline)")
 
     def get_divergence(req):
         # shadow-verification plane: the divergence ledger (each record
@@ -912,7 +927,72 @@ def metrics_router(registry) -> Router:
             "divergences": sh.ledger(),
         }
 
-    rt.add("GET", "/debug/divergence", get_divergence)
+    rt.add("GET", "/debug/divergence", get_divergence,
+           describe="shadow-verification divergence ledger + sampler "
+                    "stats")
+
+    def get_slo(req):
+        # SLO burn-rate engine (ketotpu/slo.py): per-op availability and
+        # latency-compliance SLIs over the fast (~5 min) and slow (~1 h)
+        # windows, with the burn rate against the configured objectives
+        slo = registry.slo()
+        if slo is None:
+            return 200, {"enabled": False}
+        slo.sample()
+        return 200, {"enabled": True, **slo.snapshot()}
+
+    rt.add("GET", "/debug/slo", get_slo,
+           describe="SLO burn rates: per-op availability/latency SLIs "
+                    "over fast + slow windows")
+
+    def get_fleet(req):
+        # fleet health: this host's digest plus the last digest each DCN
+        # peer shipped on its heartbeat.  A peer that has never sent one
+        # (a pre-fleet-health binary) renders "unavailable" rather than
+        # erroring — mixed-version meshes happen during rollouts.
+        local = registry.health_digest()
+        link = registry.hostlink()
+        if link is None:
+            return 200, {"multihost": False, "local": local, "peers": []}
+        peers = []
+        for row in link.peer_rows():
+            digest = row.get("digest")
+            peers.append({
+                "peer": row.get("peer"),
+                "addr": row.get("addr"),
+                "down": row.get("down"),
+                "heartbeat_age_s": row.get("heartbeat_age_s"),
+                "digest": (
+                    digest if isinstance(digest, dict) else "unavailable"
+                ),
+            })
+        return 200, {"multihost": True, "local": local, "peers": peers}
+
+    rt.add("GET", "/debug/fleet", get_fleet,
+           describe="per-host health digests: local + last heartbeat "
+                    "digest from every DCN peer")
+
+    def get_incidents(req):
+        # regression watchdog (ketotpu/watchdog.py): bounded incident
+        # records, newest first; each names the firing rule, the detail
+        # that tripped it, and the trace ids it force-promoted
+        wd = registry.watchdog()
+        if wd is None:
+            return 200, {"enabled": False, "incidents": []}
+        n = req.query.get("n")
+        try:
+            n = int(n) if n is not None else 0
+        except ValueError:
+            raise BadRequestError("n must be an integer")
+        return 200, {
+            "enabled": True,
+            "stats": wd.stats(),
+            "incidents": wd.incidents(n=n),
+        }
+
+    rt.add("GET", "/debug/incidents", get_incidents,
+           describe="watchdog incidents: rule, detail, force-promoted "
+                    "trace ids (newest first)")
     return rt
 
 

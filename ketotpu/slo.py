@@ -1,0 +1,294 @@
+"""Multi-window SLO burn-rate engine (Zanzibar-style serving objectives).
+
+The ROADMAP north star is a latency objective (p99 ≤ 2 ms at ≥100k
+check/s) — but until this module nothing in the stack computed whether
+an objective was actually being *met over time*.  The engine turns the
+cumulative per-request outcome histogram flightrec.py already emits
+(``keto_request_outcome_seconds{op,outcome}``) into windowed SLI rates:
+
+* **availability** — ok / (ok + shed + error) over the window; sheds and
+  5xx both burn the availability budget (a 429 is the server refusing
+  work it promised to absorb).
+* **latency compliance** — among *ok* requests, the fraction whose
+  end-to-end latency landed at or under ``observability.slo.
+  latency_target_ms``.  The target is snapped to the nearest histogram
+  bucket bound (observability.BUCKETS) so the fraction is exact, not
+  interpolated; sheds/errors are excluded so a fast 429 cannot flatter
+  the latency SLI.
+* **burn rate** — the classic multi-window form: ``(1 - sli) /
+  (1 - objective)`` for each SLI, and the per-op burn gauge is the worse
+  of the two.  Burn 1.0 = consuming error budget exactly at the rate
+  that exhausts it at the window's end; the watchdog alarms on the fast
+  window crossing ``observability.watchdog.burn_threshold``.
+
+Two windows ride one ring of delta buckets: a fast window (~5 min,
+page-worthy burn) and a slow window (~1 h, budget trend).  ``sample()``
+is called from the metrics scrape path (`Registry.sample_engine_metrics`)
+and from every watchdog tick, so the ring advances whenever anyone is
+watching; between samples the cumulative histogram holds the truth and
+no request-path work is added.
+
+Exposed as ``keto_slo_{availability,latency_compliance,burn_rate}
+{op,window}`` gauges, ``GET /debug/slo``, and the compact fleet digest
+(`Registry.health_digest`) that rides the DCN heartbeat.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ketotpu import flightrec
+from ketotpu.observability import BUCKETS
+
+AVAILABILITY_GAUGE = "keto_slo_availability"
+LATENCY_GAUGE = "keto_slo_latency_compliance"
+BURN_GAUGE = "keto_slo_burn_rate"
+
+#: ring granularity: the fast window is split into this many buckets, so
+#: a 300 s fast window advances every 5 s — fine enough that the fast
+#: burn alarm reacts within one watchdog tick of a storm starting
+_FAST_BUCKETS = 60
+
+
+def snap_target_bucket(latency_target_ms: float) -> Tuple[int, float]:
+    """(bucket index, snapped target seconds): the smallest histogram
+    bound >= the requested target; +Inf (index len(BUCKETS)) when the
+    target exceeds every finite bound."""
+    target_s = float(latency_target_ms) / 1000.0
+    idx = bisect.bisect_left(BUCKETS, target_s)
+    snapped = BUCKETS[idx] if idx < len(BUCKETS) else float("inf")
+    return idx, snapped
+
+
+class _OpTotals:
+    """Cumulative (total, ok, under-target) read off the metrics registry."""
+
+    __slots__ = ("total", "ok", "under")
+
+    def __init__(self, total: int = 0, ok: int = 0, under: int = 0):
+        self.total = total
+        self.ok = ok
+        self.under = under
+
+
+class SLOEngine:
+    """Windowed availability/latency SLIs + burn rates per op."""
+
+    def __init__(
+        self,
+        metrics,
+        *,
+        latency_target_ms: float = 25.0,
+        fast_window_s: float = 300.0,
+        slow_window_s: float = 3600.0,
+        availability_objective: float = 0.999,
+        latency_objective: float = 0.99,
+        clock=time.monotonic,
+    ):
+        self._metrics = metrics
+        self.latency_target_ms = float(latency_target_ms)
+        self.fast_window_s = float(fast_window_s)
+        self.slow_window_s = max(float(slow_window_s), self.fast_window_s)
+        self.availability_objective = float(availability_objective)
+        self.latency_objective = float(latency_objective)
+        self._clock = clock
+        self._target_idx, self._target_s = snap_target_bucket(
+            latency_target_ms
+        )
+        self._bucket_s = max(self.fast_window_s / _FAST_BUCKETS, 0.5)
+        self._ring_len = int(self.slow_window_s / self._bucket_s) + 2
+        self._lock = threading.Lock()
+        # ring of {op: (d_total, d_ok, d_under)} deltas keyed by slot id
+        self._ring: List[Optional[Tuple[int, Dict]]] = (
+            [None] * self._ring_len
+        )
+        self._last: Dict[str, _OpTotals] = {}
+        self._primed = False
+        if metrics is not None:
+            # pre-register the gauge vocabulary (healthy values) so a
+            # fresh daemon's first scrape already carries the names
+            for window in ("fast", "slow"):
+                metrics.gauge(
+                    AVAILABILITY_GAUGE, 1.0,
+                    help="windowed availability SLI (1.0 = no errors/sheds)",
+                    op="check", window=window,
+                )
+                metrics.gauge(
+                    LATENCY_GAUGE, 1.0,
+                    help="fraction of ok requests under the latency target",
+                    op="check", window=window,
+                )
+                metrics.gauge(
+                    BURN_GAUGE, 0.0,
+                    help="error-budget burn rate (1.0 = budget gone at "
+                         "window end)", op="check", window=window,
+                )
+
+    # -- sampling -------------------------------------------------------------
+
+    def _read_cumulative(self) -> Dict[str, _OpTotals]:
+        """Fold the outcome histogram's series into per-op totals."""
+        out: Dict[str, _OpTotals] = {}
+        if self._metrics is None:
+            return out
+        series = self._metrics.histogram_buckets(flightrec.OUTCOME_METRIC)
+        for labels, (buckets, _sum, count) in series.items():
+            lab = dict(labels)
+            op = lab.get("op", "other")
+            outcome = lab.get("outcome", "ok")
+            t = out.setdefault(op, _OpTotals())
+            t.total += count
+            if outcome == "ok":
+                t.ok += count
+                # cumulative count at or under the snapped target bucket
+                t.under += sum(buckets[: self._target_idx + 1])
+        return out
+
+    def sample(self, now: Optional[float] = None) -> None:
+        """Advance the ring: cumulative deltas since the last sample land
+        in the bucket of *now*.  Threadsafe; cheap enough for every
+        scrape and watchdog tick."""
+        t = self._clock() if now is None else float(now)
+        slot = int(t // self._bucket_s)
+        cum = self._read_cumulative()
+        with self._lock:
+            if not self._primed:
+                # first sample: adopt the cumulative state as the floor so
+                # pre-engine traffic does not land in one giant bucket
+                self._last = cum
+                self._primed = True
+                return
+            deltas: Dict[str, Tuple[int, int, int]] = {}
+            for op, c in cum.items():
+                p = self._last.get(op, _OpTotals())
+                d = (c.total - p.total, c.ok - p.ok, c.under - p.under)
+                if d[0] > 0 or d[1] > 0 or d[2] > 0:
+                    deltas[op] = d
+            self._last = cum
+            idx = slot % self._ring_len
+            held = self._ring[idx]
+            if held is None or held[0] != slot:
+                self._ring[idx] = (slot, dict(deltas))
+            else:
+                merged = held[1]
+                for op, (dt, dok, du) in deltas.items():
+                    pt, pok, pu = merged.get(op, (0, 0, 0))
+                    merged[op] = (pt + dt, pok + dok, pu + du)
+
+    # -- window math ----------------------------------------------------------
+
+    def _window_totals(
+        self, window_s: float, now: float
+    ) -> Dict[str, Tuple[int, int, int]]:
+        slot_now = int(now // self._bucket_s)
+        first = slot_now - int(window_s / self._bucket_s)
+        out: Dict[str, Tuple[int, int, int]] = {}
+        for held in self._ring:
+            if held is None:
+                continue
+            slot, deltas = held
+            if slot < first or slot > slot_now:
+                continue
+            for op, (dt, dok, du) in deltas.items():
+                pt, pok, pu = out.get(op, (0, 0, 0))
+                out[op] = (pt + dt, pok + dok, pu + du)
+        return out
+
+    @staticmethod
+    def _slis(total: int, ok: int, under: int) -> Tuple[float, float]:
+        availability = (ok / total) if total > 0 else 1.0
+        compliance = (under / ok) if ok > 0 else 1.0
+        return availability, min(compliance, 1.0)
+
+    def _burn(self, availability: float, compliance: float) -> float:
+        a_budget = max(1.0 - self.availability_objective, 1e-9)
+        l_budget = max(1.0 - self.latency_objective, 1e-9)
+        return max(
+            (1.0 - availability) / a_budget,
+            (1.0 - compliance) / l_budget,
+        )
+
+    def window_report(
+        self, window_s: float, now: Optional[float] = None
+    ) -> Dict[str, Dict]:
+        """{op: {total, availability, latency_compliance, burn_rate}}
+        over the trailing ``window_s`` seconds."""
+        t = self._clock() if now is None else float(now)
+        with self._lock:
+            totals = self._window_totals(window_s, t)
+        report: Dict[str, Dict] = {}
+        for op, (total, ok, under) in sorted(totals.items()):
+            availability, compliance = self._slis(total, ok, under)
+            report[op] = {
+                "total": total,
+                "availability": round(availability, 6),
+                "latency_compliance": round(compliance, 6),
+                "burn_rate": round(self._burn(availability, compliance), 4),
+            }
+        return report
+
+    # -- read side ------------------------------------------------------------
+
+    def snapshot(self, now: Optional[float] = None) -> Dict:
+        """The ``GET /debug/slo`` body."""
+        return {
+            "objectives": {
+                "availability": self.availability_objective,
+                "latency": self.latency_objective,
+                "latency_target_ms": self.latency_target_ms,
+                "latency_target_bucket_s": (
+                    None if self._target_s == float("inf")
+                    else self._target_s
+                ),
+            },
+            "windows": {
+                "fast_s": self.fast_window_s,
+                "slow_s": self.slow_window_s,
+            },
+            "fast": self.window_report(self.fast_window_s, now),
+            "slow": self.window_report(self.slow_window_s, now),
+        }
+
+    def max_burn(
+        self, window: str = "fast", now: Optional[float] = None
+    ) -> float:
+        """Worst per-op burn rate over one window — the watchdog's alarm
+        input and the fleet digest's headline number."""
+        window_s = (
+            self.fast_window_s if window == "fast" else self.slow_window_s
+        )
+        report = self.window_report(window_s, now)
+        return max(
+            (r["burn_rate"] for r in report.values()), default=0.0
+        )
+
+    def digest(self, now: Optional[float] = None) -> Dict[str, float]:
+        """Compact burn summary for the heartbeat health digest."""
+        return {
+            "fast": round(self.max_burn("fast", now), 4),
+            "slow": round(self.max_burn("slow", now), 4),
+        }
+
+    def publish(self, now: Optional[float] = None) -> None:
+        """Refresh the ``keto_slo_*`` gauges (scrape path)."""
+        if self._metrics is None:
+            return
+        self.sample(now)
+        for window, window_s in (
+            ("fast", self.fast_window_s), ("slow", self.slow_window_s),
+        ):
+            for op, r in self.window_report(window_s, now).items():
+                self._metrics.gauge(
+                    AVAILABILITY_GAUGE, r["availability"],
+                    op=op, window=window,
+                )
+                self._metrics.gauge(
+                    LATENCY_GAUGE, r["latency_compliance"],
+                    op=op, window=window,
+                )
+                self._metrics.gauge(
+                    BURN_GAUGE, r["burn_rate"], op=op, window=window,
+                )

@@ -1,0 +1,762 @@
+"""Fleet health plane: SLO burn-rate engine + regression watchdog +
+cross-host health digests and trace stitching.
+
+The acceptance gate for the fleet-health PR:
+
+* unit: SLO window math against synthetic outcome-histogram feeds with
+  known burn rates (availability vs latency branch, window expiry,
+  target-bucket snapping), and every watchdog rule driven one tick at a
+  time over controllable diagnostic surfaces (forced after-warm compile,
+  injected shadow divergence, device-ms drift with edge filtering, shed
+  storm crossing the fast-window burn threshold) — each filing exactly
+  one incident that force-promotes the implicated traces;
+* routing: the ``GET /debug`` index is generated from the routing table,
+  so the drift test asserts set-equality in BOTH directions, and the
+  fleet surfaces are admission-exempt;
+* peerlink compatibility: a hand-built legacy heartbeat frame (no digest
+  field) renders ``digest: unavailable`` in ``/debug/fleet`` instead of
+  erroring, and a digest-bearing frame replaces it;
+* e2e (in-process daemon): ``GET /debug/slo`` + ``/debug/fleet`` +
+  ``/debug/incidents`` answer on the metrics port with the keto_slo_* /
+  keto_incidents_* vocabulary on the scrape;
+* e2e (slow, two processes): a batch check routed across two owner
+  processes over the DCN lane promotes exactly ONE trace whose spans
+  carry BOTH host pids, with the remote leg's timings inside the
+  client-observed total.
+"""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from ketotpu import flightrec, slo as slo_mod
+from ketotpu.api.types import RelationTuple
+from ketotpu.driver import Provider, Registry
+from ketotpu.observability import BUCKETS, Metrics, parse_traceparent
+from ketotpu.parallel import HostLink
+from ketotpu.server import serve_all
+from ketotpu.server.rest import _ADMISSION_EXEMPT, metrics_router
+from ketotpu.server.workers import _Conn
+from ketotpu.slo import SLOEngine, snap_target_bucket
+from ketotpu.tracing import TraceStore
+from ketotpu.watchdog import Watchdog
+
+TUPLES = [
+    "Group:admin#members@alice",
+    "Doc:readme#viewers@Group:admin#members",
+]
+
+
+def _registry(observability=None, engine=None):
+    cfg = Provider({
+        "namespaces": [{"name": "Group"}, {"name": "Doc"}],
+        "engine": engine or {"kind": "oracle"},
+        "observability": observability or {},
+        "log": {"request_log": False},
+    })
+    reg = Registry(cfg).init()
+    reg.store().write_relation_tuples(
+        *[RelationTuple.from_string(s) for s in TUPLES]
+    )
+    return reg
+
+
+def _http(method, url, body=None, headers=None, timeout=30.0):
+    req = urllib.request.Request(
+        url, data=body, method=method, headers=headers or {}
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+class _Req:
+    """Minimal request object for driving route callables directly."""
+
+    def __init__(self, query=None):
+        self.query = query or {}
+
+
+def _feed(metrics, n, *, op="check", outcome="ok", seconds=0.001):
+    for _ in range(n):
+        metrics.observe(
+            flightrec.OUTCOME_METRIC, seconds, op=op, outcome=outcome,
+        )
+
+
+# -- SLO window math ---------------------------------------------------------
+
+
+class TestSnapTarget:
+    def test_exact_bound_is_kept(self):
+        idx, snapped = snap_target_bucket(25.0)
+        assert snapped == 0.025 and BUCKETS[idx] == 0.025
+
+    def test_between_bounds_snaps_up(self):
+        _, snapped = snap_target_bucket(3.0)
+        assert snapped == 0.005  # 3 ms has no bucket; 5 ms is next
+
+    def test_beyond_every_bound_is_inf(self):
+        idx, snapped = snap_target_bucket(1e9)
+        assert snapped == float("inf") and idx == len(BUCKETS)
+
+
+class TestSLOEngine:
+    def _engine(self, m, **kw):
+        kw.setdefault("latency_target_ms", 25.0)
+        kw.setdefault("fast_window_s", 60.0)
+        kw.setdefault("slow_window_s", 600.0)
+        kw.setdefault("availability_objective", 0.99)
+        kw.setdefault("latency_objective", 0.9)
+        return SLOEngine(m, **kw)
+
+    def test_availability_burn_is_exact(self):
+        m = Metrics()
+        eng = self._engine(m)
+        eng.sample(now=0.0)  # prime: adopt the cumulative floor
+        _feed(m, 99, outcome="ok")
+        _feed(m, 1, outcome="error")
+        eng.sample(now=10.0)
+        r = eng.window_report(60.0, now=10.0)["check"]
+        assert r["total"] == 100
+        assert r["availability"] == pytest.approx(0.99)
+        assert r["latency_compliance"] == 1.0
+        # (1 - 0.99) / (1 - 0.99) = exactly sustainable burn
+        assert r["burn_rate"] == pytest.approx(1.0)
+
+    def test_latency_burn_branch_and_ok_only_denominator(self):
+        m = Metrics()
+        eng = self._engine(m)
+        eng.sample(now=0.0)
+        _feed(m, 80, outcome="ok", seconds=0.001)   # under 25 ms
+        _feed(m, 20, outcome="ok", seconds=0.1)     # over 25 ms
+        eng.sample(now=5.0)
+        r = eng.window_report(60.0, now=5.0)["check"]
+        assert r["availability"] == 1.0
+        assert r["latency_compliance"] == pytest.approx(0.8)
+        # latency branch dominates: (1 - 0.8) / (1 - 0.9) = 2.0
+        assert r["burn_rate"] == pytest.approx(2.0)
+        assert eng.max_burn("fast", now=5.0) == pytest.approx(2.0)
+
+    def test_sheds_burn_availability_but_not_latency(self):
+        m = Metrics()
+        eng = self._engine(m)
+        eng.sample(now=0.0)
+        _feed(m, 50, outcome="ok", seconds=0.001)
+        # a fast 429 must not flatter the latency SLI
+        _feed(m, 50, outcome="shed", seconds=0.0001)
+        eng.sample(now=5.0)
+        r = eng.window_report(60.0, now=5.0)["check"]
+        assert r["availability"] == pytest.approx(0.5)
+        assert r["latency_compliance"] == 1.0
+        assert r["burn_rate"] == pytest.approx(0.5 / 0.01)
+
+    def test_fast_window_expires_slow_window_remembers(self):
+        m = Metrics()
+        eng = self._engine(m)  # fast 60 s, slow 600 s
+        eng.sample(now=0.0)
+        _feed(m, 10, outcome="error")
+        eng.sample(now=10.0)
+        # half an hour later the errors left the fast window but still
+        # burn the slow one
+        fast = eng.window_report(60.0, now=400.0)
+        slow = eng.window_report(600.0, now=400.0)
+        assert "check" not in fast
+        assert slow["check"]["availability"] == 0.0
+        assert eng.max_burn("fast", now=400.0) == 0.0
+        assert eng.max_burn("slow", now=400.0) > 0.0
+
+    def test_digest_and_snapshot_shape(self):
+        m = Metrics()
+        eng = self._engine(m)
+        eng.sample(now=0.0)
+        _feed(m, 4, outcome="ok")
+        eng.sample(now=1.0)
+        d = eng.digest(now=1.0)
+        assert set(d) == {"fast", "slow"} and d["fast"] == 0.0
+        snap = eng.snapshot(now=1.0)
+        assert snap["objectives"]["latency_target_bucket_s"] == 0.025
+        assert snap["fast"]["check"]["total"] == 4
+
+    def test_publish_refreshes_gauges(self):
+        m = Metrics()
+        eng = self._engine(m)
+        eng.sample(now=0.0)
+        _feed(m, 90, outcome="ok")
+        _feed(m, 10, outcome="error")
+        eng.publish(now=5.0)
+        assert m.get_gauge(
+            slo_mod.AVAILABILITY_GAUGE, op="check", window="fast"
+        ) == pytest.approx(0.9)
+        assert m.get_gauge(
+            slo_mod.BURN_GAUGE, op="check", window="fast"
+        ) == pytest.approx(10.0)
+
+
+# -- watchdog rules ----------------------------------------------------------
+
+
+class _Surface:
+    """Attribute bag standing in for one diagnostic surface."""
+
+    def __init__(self, **kw):
+        self.__dict__.update(kw)
+
+
+class _WDRegistry:
+    """Registry facade with hand-controlled diagnostic surfaces, so each
+    watchdog rule is driven one deterministic tick at a time."""
+
+    def __init__(self):
+        self._metrics = Metrics()
+        self._trace = TraceStore(slow_ms=1e9)  # nothing promotes on its own
+        self.compile_snap = {"compiles_after_warm": 0, "log": []}
+        self.wave_stats = {"waves_in_ring": 0, "device_ms_p50": 0.0}
+        self.waves = []
+        self.shadow_obj = None
+        self.slo_obj = None
+
+    def metrics(self):
+        return self._metrics
+
+    def trace_store(self):
+        return self._trace
+
+    def compile_watch(self):
+        return _Surface(snapshot=lambda: dict(self.compile_snap))
+
+    def wave_ledger(self):
+        return _Surface(
+            stats=lambda: dict(self.wave_stats),
+            snapshot=lambda n: list(self.waves),
+        )
+
+    def shadow(self):
+        return self.shadow_obj
+
+    def slo(self):
+        return self.slo_obj
+
+
+def _armed(reg, **kw):
+    """A watchdog past its priming tick (tick 1 adopts counter floors)."""
+    wd = Watchdog(reg, **kw)
+    assert wd.tick(now=0.0) == []
+    return wd
+
+
+class TestWatchdogRules:
+    def test_after_warm_compile_files_once_per_delta(self):
+        reg = _WDRegistry()
+        # park a trace in the recent ring and implicate it via the wave
+        # ledger's slowest[] traceparents
+        tid = "ab" * 16
+        reg._trace.complete({
+            "trace_id": tid, "op": "check", "detail": "", "total_ms": 1.0,
+            "ts": 0.0, "spans": [], "stages_ms": {}, "info": {},
+        }, [])
+        reg.waves = [{"slowest": [
+            {"traceparent": f"00-{tid}-{'cd' * 8}-01", "wait_ms": 1.0},
+        ]}]
+        wd = _armed(reg)
+        reg.compile_snap = {
+            "compiles_after_warm": 1,
+            "log": [{"fn": "wave", "signature": "s1", "duration_ms": 9.0,
+                     "ts": 1.0, "after_warm": True}],
+        }
+        filed = wd.tick(now=1.0)
+        assert [i["rule"] for i in filed] == ["after_warm_compile"]
+        inc = filed[0]
+        assert inc["detail"]["compiles"][0]["signature"] == "s1"
+        assert inc["promoted"] == [tid]
+        assert reg._trace.promoted()[0]["promoted"] == [
+            "incident:after_warm_compile"
+        ]
+        assert reg._metrics.get_counter(
+            "keto_incidents_total", rule="after_warm_compile"
+        ) == 1.0
+        # no new compiles -> no new incident
+        assert wd.tick(now=2.0) == []
+
+    def test_priming_tick_absorbs_preexisting_counters(self):
+        reg = _WDRegistry()
+        reg.compile_snap = {"compiles_after_warm": 3, "log": []}
+        reg.shadow_obj = _Surface(divergences=2, ledger=lambda: [])
+        wd = Watchdog(reg)
+        assert wd.tick(now=0.0) == []   # prime adopts 3 and 2 as floors
+        assert wd.tick(now=1.0) == []   # history is not a regression
+
+    def test_shadow_divergence_names_its_traces(self):
+        reg = _WDRegistry()
+        records = [{"tuple": "Doc:readme#view@alice", "served": True,
+                    "oracle": False, "tier": "fastpath", "wave": 7,
+                    "trace_id": "ee" * 16}]
+        reg.shadow_obj = _Surface(divergences=0, ledger=lambda: records)
+        wd = _armed(reg)
+        reg.shadow_obj.divergences = 1
+        filed = wd.tick(now=1.0)
+        assert [i["rule"] for i in filed] == ["shadow_divergence"]
+        assert filed[0]["trace_ids"] == ["ee" * 16]
+        assert filed[0]["detail"]["records"][0]["tier"] == "fastpath"
+
+    def test_device_ms_drift_learns_then_edge_triggers(self):
+        reg = _WDRegistry()
+        wd = _armed(reg, baseline_waves=2, drift_pct=50.0)
+        # learning phase: two healthy observations build the baseline
+        reg.wave_stats = {"waves_in_ring": 1, "device_ms_p50": 10.0}
+        assert wd.tick(now=1.0) == []
+        reg.wave_stats = {"waves_in_ring": 2, "device_ms_p50": 10.0}
+        assert wd.tick(now=2.0) == []
+        # 3x the baseline: one incident, held level does not re-file
+        reg.wave_stats = {"waves_in_ring": 3, "device_ms_p50": 30.0}
+        filed = wd.tick(now=3.0)
+        assert [i["rule"] for i in filed] == ["device_ms_drift"]
+        assert filed[0]["detail"]["baseline_ms"] == pytest.approx(10.0)
+        assert wd.tick(now=4.0) == []
+        # recovery clears the edge; a second excursion files again
+        reg.wave_stats = {"waves_in_ring": 4, "device_ms_p50": 10.0}
+        assert wd.tick(now=5.0) == []
+        reg.wave_stats = {"waves_in_ring": 5, "device_ms_p50": 40.0}
+        assert [i["rule"] for i in wd.tick(now=6.0)] == ["device_ms_drift"]
+
+    def test_shed_storm_trips_the_burn_alarm(self):
+        reg = _WDRegistry()
+        fake_now = {"t": 0.0}
+        # the burn rule samples with the engine's own clock; pin it so the
+        # storm's deltas land in the window the rule inspects
+        reg.slo_obj = SLOEngine(
+            reg._metrics, fast_window_s=60.0, slow_window_s=600.0,
+            availability_objective=0.99, latency_objective=0.9,
+            clock=lambda: fake_now["t"],
+        )
+        reg.slo_obj.sample(now=0.0)
+        wd = _armed(reg, burn_threshold=2.0)
+        fake_now["t"] = 1.0
+        # a shed storm: half the window's requests answered 429
+        _feed(reg._metrics, 50, outcome="ok")
+        _feed(reg._metrics, 50, outcome="shed")
+        reg.slo_obj.sample(now=1.0)
+        filed = wd.tick(now=1.0)
+        assert [i["rule"] for i in filed] == ["burn_alarm"]
+        assert filed[0]["detail"]["fast_burn"] >= 2.0
+        # level-triggered: still burning, no second incident
+        assert wd.tick(now=2.0) == []
+
+    def test_incident_log_is_bounded_and_newest_first(self):
+        reg = _WDRegistry()
+        wd = _armed(reg, incident_cap=2)
+        for k in range(3):
+            reg.compile_snap = {
+                "compiles_after_warm": k + 1, "log": [],
+            }
+            wd.tick(now=float(k))
+        held = wd.incidents()
+        assert len(held) == 2 and held[0]["id"] == 3
+        assert wd.stats()["incidents_filed"] == 3
+        assert wd.incidents(n=1)[0]["id"] == 3
+
+    def test_auto_profile_honors_cooldown(self):
+        reg = _WDRegistry()
+        wd = _armed(reg, auto_profile=True, profile_cooldown_s=100.0)
+        wd._r.profiler = lambda: _Surface(capture=lambda s: {"ok": True})
+        reg.compile_snap = {"compiles_after_warm": 1, "log": []}
+        first = wd.tick(now=10.0)[0]
+        assert first["profile"] == "armed"
+        reg.compile_snap = {"compiles_after_warm": 2, "log": []}
+        second = wd.tick(now=20.0)[0]
+        assert second["profile"] == "cooldown"
+
+
+# -- debug index drift + fleet surfaces --------------------------------------
+
+
+@pytest.fixture(scope="module")
+def oracle_reg():
+    return _registry()
+
+
+class TestDebugRouting:
+    def test_index_matches_routes_both_directions(self, oracle_reg):
+        rt = metrics_router(oracle_reg)
+        _, body = rt.routes[("GET", "/debug")](_Req())
+        surfaces = body["surfaces"]
+        routed = {p for (_m, p) in rt.routes if p.startswith("/debug/")}
+        # every routed surface is indexed, every indexed surface routed
+        assert set(surfaces) == routed
+        assert {"/debug/slo", "/debug/fleet", "/debug/incidents"} <= routed
+        assert all(isinstance(v, str) and v for v in surfaces.values())
+
+    def test_fleet_surfaces_are_admission_exempt(self):
+        assert {"/debug/slo", "/debug/fleet", "/debug/incidents"} <= (
+            _ADMISSION_EXEMPT
+        )
+
+    def test_slo_surface_reports_objectives(self, oracle_reg):
+        rt = metrics_router(oracle_reg)
+        status, body = rt.routes[("GET", "/debug/slo")](_Req())
+        assert status == 200 and body["enabled"] is True
+        assert body["objectives"]["availability"] == 0.999
+        assert body["windows"]["fast_s"] == 300.0
+
+    def test_incidents_surface_empty_and_bounded(self, oracle_reg):
+        rt = metrics_router(oracle_reg)
+        status, body = rt.routes[("GET", "/debug/incidents")](_Req())
+        assert status == 200 and body["enabled"] is True
+        assert body["incidents"] == []
+        assert body["stats"]["incidents_filed"] == 0
+
+    def test_incidents_surface_renders_filed_incident(self, monkeypatch):
+        # an injected after-warm compile must be visible END to END:
+        # rule trips -> incident filed -> /debug/incidents renders it
+        # with the implicated trace force-promoted
+        surf = _WDRegistry()
+        tid = "fa" * 16
+        surf._trace.complete({
+            "trace_id": tid, "op": "check", "detail": "", "total_ms": 1.0,
+            "ts": 0.0, "spans": [], "stages_ms": {}, "info": {},
+        }, [])
+        surf.waves = [{"slowest": [
+            {"traceparent": f"00-{tid}-{'cd' * 8}-01", "wait_ms": 1.0},
+        ]}]
+        wd = _armed(surf)
+        surf.compile_snap = {
+            "compiles_after_warm": 1,
+            "log": [{"fn": "wave", "signature": "s1", "duration_ms": 9.0,
+                     "ts": 1.0, "after_warm": True}],
+        }
+        assert wd.tick(now=1.0)
+        reg = _registry()
+        monkeypatch.setattr(reg, "watchdog", lambda: wd)
+        rt = metrics_router(reg)
+        status, body = rt.routes[("GET", "/debug/incidents")](_Req())
+        assert status == 200 and body["enabled"] is True
+        assert body["stats"]["incidents_filed"] == 1
+        inc = body["incidents"][0]
+        assert inc["rule"] == "after_warm_compile"
+        assert inc["promoted"] == [tid]
+        assert surf._trace.promoted()[0]["promoted"] == [
+            "incident:after_warm_compile"
+        ]
+
+    def test_fleet_single_host_reports_local_only(self, oracle_reg):
+        rt = metrics_router(oracle_reg)
+        status, body = rt.routes[("GET", "/debug/fleet")](_Req())
+        assert status == 200
+        assert body["multihost"] is False and body["peers"] == []
+        local = body["local"]
+        assert local["pid"] == os.getpid()
+        assert "burn" in local and "compiles_after_warm" in local
+
+    def test_disabled_plane_answers_disabled(self):
+        reg = _registry(observability={
+            "slo": {"enabled": False}, "watchdog": {"enabled": False},
+        })
+        rt = metrics_router(reg)
+        assert rt.routes[("GET", "/debug/slo")](_Req())[1] == {
+            "enabled": False,
+        }
+        _, body = rt.routes[("GET", "/debug/incidents")](_Req())
+        assert body["enabled"] is False
+
+
+# -- peerlink heartbeat digest compatibility ---------------------------------
+
+
+class TestHeartbeatDigestCompat:
+    def _link(self):
+        link = HostLink(
+            0, ["127.0.0.1:0", "127.0.0.1:0"], "fleet-test-secret",
+            heartbeat_ms=200, miss_budget=2, rpc_timeout_ms=30000,
+        )
+        link.bind()
+        return link
+
+    def _hello(self, conn):
+        from ketotpu.parallel import peerlink
+
+        resp, _ = conn.call({
+            "op": "hello", "proto": peerlink.PROTO, "host": 1,
+            "secret": "fleet-test-secret",
+        }, timeout=5.0)
+        assert resp.get("ok")
+
+    def test_legacy_heartbeat_without_digest_renders_unavailable(self):
+        link = self._link()
+        try:
+            conn = _Conn(link.addr, shm_threshold=0, connect_timeout=5.0)
+            try:
+                self._hello(conn)
+                # a pre-fleet-health peer's heartbeat: topology fields
+                # only, no digest key anywhere in the frame
+                resp, _ = conn.call({
+                    "op": "heartbeat", "host": 1, "load": 0.25, "shards": 4,
+                }, timeout=5.0)
+                assert resp.get("ok")
+            finally:
+                conn.close()
+            rows = {r["peer"]: r for r in link.peer_rows()}
+            assert rows[1]["digest"] is None  # never heard one
+
+            # the /debug/fleet rendering of that peer says so instead of
+            # erroring on the absent field
+            reg = _registry()
+            reg.hostlink = lambda: link
+            rt = metrics_router(reg)
+            _, body = rt.routes[("GET", "/debug/fleet")](_Req())
+            assert body["multihost"] is True
+            peer = {p["peer"]: p for p in body["peers"]}[1]
+            assert peer["digest"] == "unavailable"
+        finally:
+            link.stop()
+
+    def test_digest_bearing_heartbeat_is_absorbed(self):
+        link = self._link()
+        try:
+            digest = {"host": 1, "pid": 4242, "burn": {"fast": 0.5},
+                      "shed_total": 3}
+            conn = _Conn(link.addr, shm_threshold=0, connect_timeout=5.0)
+            try:
+                self._hello(conn)
+                resp, _ = conn.call({
+                    "op": "heartbeat", "host": 1, "load": 0.0,
+                    "digest": digest,
+                }, timeout=5.0)
+                assert resp.get("ok")
+                # a later legacy frame must NOT erase the known digest
+                resp, _ = conn.call(
+                    {"op": "heartbeat", "host": 1, "load": 0.0},
+                    timeout=5.0,
+                )
+                assert resp.get("ok")
+            finally:
+                conn.close()
+            rows = {r["peer"]: r for r in link.peer_rows()}
+            assert rows[1]["digest"] == digest
+        finally:
+            link.stop()
+
+
+# -- e2e: live daemon scrape -------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def fleet_server():
+    cfg = Provider({
+        "serve": {
+            n: {"host": "127.0.0.1", "port": 0}
+            for n in ("read", "write", "metrics", "opl")
+        },
+        "namespaces": [{"name": "Group"}, {"name": "Doc"}],
+        "engine": {"kind": "oracle"},
+        "log": {"request_log": False},
+    })
+    reg = Registry(cfg).init()
+    reg.store().write_relation_tuples(
+        *[RelationTuple.from_string(s) for s in TUPLES]
+    )
+    srv = serve_all(reg)
+    read = "http://%s:%d" % tuple(srv.addresses["read"])
+    for subject in ("alice", "mallory"):
+        _http(
+            "GET",
+            f"{read}/relation-tuples/check/openapi?namespace=Doc"
+            f"&object=readme&relation=viewers&subject_id={subject}",
+        )
+    yield srv
+    srv.stop()
+
+
+class TestFleetDaemonSurfaces:
+    def test_slo_fleet_incidents_scrape(self, fleet_server):
+        metrics = "http://%s:%d" % tuple(fleet_server.addresses["metrics"])
+
+        status, body = _http("GET", f"{metrics}/debug/slo")
+        assert status == 200
+        slo_body = json.loads(body)
+        assert slo_body["enabled"] is True
+        assert slo_body["objectives"]["latency_target_ms"] == 25.0
+
+        status, body = _http("GET", f"{metrics}/debug/fleet")
+        assert status == 200
+        fleet = json.loads(body)
+        assert fleet["local"]["pid"] > 0
+        assert fleet["local"]["incidents"] == 0
+
+        status, body = _http("GET", f"{metrics}/debug/incidents")
+        assert status == 200
+        assert json.loads(body)["incidents"] == []
+
+        status, body = _http("GET", f"{metrics}/debug")
+        assert status == 200
+        surfaces = json.loads(body)["surfaces"]
+        assert {"/debug/slo", "/debug/fleet", "/debug/incidents"} <= set(
+            surfaces
+        )
+
+        _, text = _http("GET", f"{metrics}/metrics/prometheus")
+        assert 'keto_slo_availability{op="check",window="fast"}' in text
+        assert 'keto_slo_burn_rate{op="check",window="slow"}' in text
+        assert 'keto_incidents_total{rule="burn_alarm"} 0' in text
+        assert "keto_request_outcome_seconds_count" in text
+
+
+# -- e2e (slow): one trace id stitched across two owner hosts over DCN -------
+
+
+_CHILD_HOST = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("KETO_ENGINE_FUSED_DISPATCH", "false")
+
+from ketotpu.driver import Provider, Registry
+from ketotpu.engine.oracle import CheckEngine
+from ketotpu.parallel import HostLink
+from ketotpu.utils.synth import build_synth
+
+graph = build_synth(n_users=64, n_groups=8, n_folders=32, n_docs=128)
+oracle = CheckEngine(graph.store, graph.manager)
+
+
+class ServeShim:
+    # answers frontier checks via the host oracle: no XLA, no compiles --
+    # the serve-side rpc_recording + span export is what is under test
+    def _peer_serve_check(self, rows, depth):
+        return [oracle.check_is_member(r, depth) for r in rows]
+
+    def _hb_payload(self):
+        return {}
+
+    def _merge_peer_replicas(self, hid, replicas):
+        pass
+
+    def _on_peer_down(self, hid):
+        pass
+
+    def _on_peer_up(self, hid):
+        pass
+
+
+link = HostLink(
+    1, [sys.argv[1], "127.0.0.1:0"], "fleet-stitch-secret",
+    heartbeat_ms=200, miss_budget=1000, rpc_timeout_ms=180000,
+)
+addr = link.bind()
+link.attach_engine(ServeShim())
+# a bare registry gives the serve side metrics/recorder/tracer/trace
+# store, so inbound traced checks record spans under the caller's id
+link.registry = Registry(Provider({"log": {"request_log": False}}))
+print("ADDR %s:%d" % addr, flush=True)
+import time
+while True:
+    time.sleep(1.0)
+"""
+
+
+@pytest.mark.slow
+def test_cross_host_trace_stitching_two_processes(tmp_path):
+    """A batch check whose rows route to a second owner PROCESS over the
+    DCN lane promotes exactly ONE trace: the origin's trace id, with
+    spans from both host pids, and the remote rpc.peer_check leg timed
+    inside the client-observed total."""
+    from ketotpu.parallel import MeshCheckEngine, host_of
+    from ketotpu.utils.synth import build_synth, synth_queries_mixed
+
+    script = tmp_path / "fleet_child_host.py"
+    script.write_text(_CHILD_HOST)
+
+    graph = build_synth(n_users=64, n_groups=8, n_folders=32, n_docs=128)
+    link = HostLink(
+        0, ["127.0.0.1:0", "127.0.0.1:0"], "fleet-stitch-secret",
+        heartbeat_ms=200, miss_budget=1000, rpc_timeout_ms=180000,
+    )
+    a0 = link.bind()
+
+    repo_root = str(pathlib.Path(__file__).parent.parent)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (repo_root, env.get("PYTHONPATH")) if p
+    )
+    proc = subprocess.Popen(
+        [sys.executable, str(script), "%s:%d" % a0],
+        env=env, cwd=repo_root,
+        stdout=subprocess.PIPE, text=True,
+    )
+    eng = None
+    try:
+        line = proc.stdout.readline().strip()
+        assert line.startswith("ADDR "), line
+        host, port = line[len("ADDR "):].rsplit(":", 1)
+        link.set_peer_addr(1, (host, int(port)))
+
+        reg = Registry(Provider({
+            "observability": {"trace": {"slow_ms": 0.0}},
+            "log": {"request_log": False},
+        }))
+        link.registry = reg
+        link.digest_fn = reg.health_digest
+
+        eng = MeshCheckEngine(
+            graph.store, graph.manager, mesh_devices=4,
+            frontier=512, arena=2048, max_batch=256, hostlink=link,
+        )
+        warm = synth_queries_mixed(graph, 64, seed=3)
+        eng._peer_serve_check(warm, 0)  # local warm: compiles happen now
+
+        queries = synth_queries_mixed(graph, 96, seed=11)
+        cross = [
+            q for q in queries
+            if host_of(q.namespace, q.object, 2) == 1
+        ]
+        assert cross, "synth wave must cross hosts"
+
+        t0 = time.perf_counter()
+        with flightrec.rpc_recording(reg, "check", detail="fleet stitch"):
+            got = eng.batch_check(queries)
+            flightrec.note(status=200)
+        total_s = time.perf_counter() - t0
+
+        oracle = eng.oracle
+        assert got == [oracle.check_is_member(q) for q in queries]
+
+        store = reg.trace_store()
+        promoted = store.promoted()
+        assert len(promoted) == 1, [e["trace_id"] for e in promoted]
+        ent = promoted[0]
+        pids = {s.get("pid") for s in ent["spans"]}
+        assert os.getpid() in pids
+        assert proc.pid in pids, (
+            f"no spans from the remote host pid {proc.pid}: {sorted(pids)}"
+        )
+        remote = [
+            s for s in ent["spans"]
+            if s.get("pid") == proc.pid and s["name"] == "rpc.peer_check"
+        ]
+        assert remote and remote[0].get("host") == 1
+        # the remote leg happened INSIDE the client-observed window
+        slack_ms = 250.0
+        assert remote[0]["ms"] <= total_s * 1000.0 + slack_ms
+        assert ent["total_ms"] <= total_s * 1000.0 + slack_ms
+
+        # the heartbeat carries this host's digest to the peer; the
+        # response direction needs the peer to run a digest_fn, which the
+        # shim does not -- so its row renders as digest unavailable
+        link.heartbeat_now()
+        rows = {r["peer"]: r for r in link.peer_rows()}
+        assert rows[1]["digest"] is None
+    finally:
+        proc.kill()
+        proc.wait(timeout=30)
+        if eng is not None:
+            eng.close()
+        else:
+            link.stop()

@@ -437,7 +437,8 @@ class TestDebugSurfaces:
         assert set(surfaces) == {
             "/debug/flight-recorder", "/debug/trace", "/debug/divergence",
             "/debug/waves", "/debug/compiles", "/debug/projection",
-            "/debug/mesh", "/debug/profile",
+            "/debug/mesh", "/debug/profile", "/debug/handoff",
+            "/debug/slo", "/debug/fleet", "/debug/incidents",
         }
         assert all(isinstance(v, str) and v for v in surfaces.values())
 
