@@ -789,6 +789,233 @@ def run_batch_bench(
         srv.stop(grace=2.0)
 
 
+def _paced_mixed_load(
+    target: str, requests, read_addr, batch_bodies, *,
+    rate: float, duration: float, clients: int = 16,
+) -> Dict[str, object]:
+    """Offer ``rate`` interactive Checks/sec (paced, spread over
+    ``clients`` gRPC threads) plus batch POSTs at ~1/16 of that request
+    rate; returns per-class admitted/shed/error counts and the latency
+    list of ADMITTED interactive checks (sheds answer fast by design —
+    mixing them in would flatter the percentile)."""
+    import http.client
+
+    import grpc
+
+    from ketotpu.proto.services import CheckServiceStub
+
+    stop = threading.Event()
+    lock = threading.Lock()
+    counts = {"inter_ok": 0, "inter_shed": 0, "inter_err": 0,
+              "batch_ok": 0, "batch_shed": 0, "batch_err": 0}
+    lat: List[float] = []
+
+    def inter_client(idx: int) -> None:
+        rng = np.random.default_rng(idx)
+        interval = clients / max(rate, 1e-6)
+        with grpc.insecure_channel(target) as ch:
+            stub = CheckServiceStub(ch)
+            nxt = time.perf_counter() + rng.uniform(0, interval)
+            n_req = len(requests)
+            while not stop.is_set():
+                now = time.perf_counter()
+                if now < nxt:
+                    time.sleep(min(nxt - now, 0.05))
+                    continue
+                nxt += interval
+                r = requests[int(rng.integers(n_req))]
+                t0 = time.perf_counter()
+                try:
+                    stub.Check(r, timeout=20.0)
+                    dt = time.perf_counter() - t0
+                    with lock:
+                        counts["inter_ok"] += 1
+                        lat.append(dt)
+                except grpc.RpcError as e:
+                    key = (
+                        "inter_shed"
+                        if e.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+                        else "inter_err"
+                    )
+                    with lock:
+                        counts[key] += 1
+
+    def batch_client() -> None:
+        rng = np.random.default_rng(997)
+        host, port = read_addr
+        interval = 8.0 / max(rate, 1e-6)
+        conn = http.client.HTTPConnection(host, port, timeout=30.0)
+        nxt = time.perf_counter()
+        try:
+            while not stop.is_set():
+                now = time.perf_counter()
+                if now < nxt:
+                    time.sleep(min(nxt - now, 0.05))
+                    continue
+                nxt += interval
+                body = batch_bodies[int(rng.integers(len(batch_bodies)))]
+                try:
+                    conn.request(
+                        "POST", "/relation-tuples/batch/check", body,
+                        {"Content-Type": "application/json"},
+                    )
+                    resp = conn.getresponse()
+                    resp.read()
+                    key = ("batch_ok" if resp.status == 200 else
+                           "batch_shed" if resp.status == 429 else
+                           "batch_err")
+                    with lock:
+                        counts[key] += 1
+                except (OSError, http.client.HTTPException):
+                    with lock:
+                        counts["batch_err"] += 1
+                    conn.close()
+                    conn = http.client.HTTPConnection(
+                        host, port, timeout=30.0
+                    )
+        finally:
+            conn.close()
+
+    threads = [
+        threading.Thread(target=inter_client, args=(i,), daemon=True)
+        for i in range(clients)
+    ] + [threading.Thread(target=batch_client, daemon=True)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    time.sleep(duration)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30.0)
+    elapsed = time.perf_counter() - t0
+    arr = np.array(lat) if lat else np.array([])
+    return {
+        **counts,
+        "offered_rps": round(rate, 1),
+        "goodput_rps": round(counts["inter_ok"] / elapsed, 1),
+        "inter_p99_ms": round(float(np.percentile(arr, 99)) * 1000, 2)
+        if len(arr) else -1.0,
+        "seconds": round(elapsed, 1),
+    }
+
+
+def run_overload_bench(
+    graph=None,
+    *,
+    duration: float = 6.0,
+    frontier: int = 4096,
+    arena: int = 16384,
+) -> Dict[str, object]:
+    """ISSUE 17 acceptance sweep: estimate single-check capacity, then
+    offer a paced interactive+batch mix at 0.5x/1x/2x/4x of it and
+    measure what the overload plane preserves.  The gates (applied by
+    __main__, exit 3): goodput at 2x holds >= 80% of goodput at 1x, and
+    the interactive p99 of ADMITTED checks at 2x stays within 2x of its
+    1x value — i.e. shedding keeps the served work fast instead of
+    letting a queue rot everyone's latency."""
+    import grpc
+
+    from ketotpu.driver import Provider, Registry
+    from ketotpu.proto.services import CheckServiceStub
+    from ketotpu.server import serve_all
+    from ketotpu.utils.synth import build_synth, synth_queries
+
+    if graph is None:
+        graph = build_synth(
+            n_users=2000, n_groups=100, n_folders=2000, n_docs=20000, seed=0
+        )
+    cfg = Provider(
+        {
+            "serve": {
+                n: {"host": "127.0.0.1", "port": 0}
+                for n in ("read", "write", "metrics", "opl")
+            },
+            "engine": {
+                "kind": "tpu", "frontier": frontier, "arena": arena,
+                "max_batch": frontier,
+            },
+            # a small fixed seed capacity makes a laptop-sized flood a
+            # genuine overload; the AIMD limit adapts inside [16, 256]
+            "limit": {"max_inflight": 64, "request_timeout_ms": 15000},
+            "overload": {"floor": 16, "ceiling": 256, "increase": 16,
+                         "interval_ms": 100, "hold_ms": 1000},
+            "log": {"request_log": False},
+        }
+    )
+    reg = Registry(
+        cfg, store=graph.store, namespace_manager=graph.manager
+    ).init()
+    srv = serve_all(reg)
+    try:
+        host, port = srv.addresses["read"]
+        target = f"{host}:{port}"
+        requests = _build_requests(graph)
+        # 8-item bodies: small enough to fit under the AIMD floor's
+        # batch headroom when idle, big enough to shed first under load
+        batch_bodies = [
+            json.dumps({"tuples": [
+                q.to_json() for q in synth_queries(graph, 8, seed=100 + i)
+            ]}).encode()
+            for i in range(8)
+        ]
+        # warmup (cold XLA compiles can outlive the request budget:
+        # retry until the wave cache is hot)
+        with grpc.insecure_channel(target) as ch:
+            stub = CheckServiceStub(ch)
+            for r in requests[:4]:
+                for attempt in range(10):
+                    try:
+                        stub.Check(r)
+                        break
+                    except grpc.RpcError as e:
+                        if (
+                            e.code()
+                            != grpc.StatusCode.DEADLINE_EXCEEDED
+                            or attempt == 9
+                        ):
+                            raise
+        # capacity estimate: short closed-loop burst
+        base = _hammer(
+            target, requests, concurrency=16,
+            duration=min(3.0, duration),
+        )
+        base_rps = max(base["rps"], 10.0)
+        ov = reg.overload()
+        legs: Dict[str, object] = {}
+        for mult in (0.5, 1.0, 2.0, 4.0):
+            leg = _paced_mixed_load(
+                target, requests, srv.addresses["read"], batch_bodies,
+                rate=base_rps * mult, duration=duration,
+            )
+            if ov is not None:
+                leg["stage_peak"] = max(
+                    leg.get("stage_peak", 0), ov.stage
+                )
+            legs["x%g" % mult] = leg
+            # settle between legs so one leg's brownout does not bleed
+            # into the next leg's numbers
+            deadline = time.monotonic() + 10.0
+            while (ov is not None and ov.stage > 0
+                   and time.monotonic() < deadline):
+                time.sleep(0.2)
+        snap = ov.snapshot() if ov is not None else {}
+        return {
+            "overload_base_rps": base_rps,
+            "overload_legs": legs,
+            "overload_goodput_1x": legs["x1"]["goodput_rps"],
+            "overload_goodput_2x": legs["x2"]["goodput_rps"],
+            "overload_inter_p99_1x": legs["x1"]["inter_p99_ms"],
+            "overload_inter_p99_2x": legs["x2"]["inter_p99_ms"],
+            "overload_shed_total": snap.get("admission", {}).get("shed", 0),
+            "overload_shed_by_class": snap.get("admission", {}).get(
+                "shed_by_class", {}
+            ),
+            "overload_transitions": len(snap.get("transitions", ())),
+        }
+    finally:
+        srv.stop(grace=2.0)
+
+
 def run_sharded_child(
     shards: int,
     *,
@@ -1646,6 +1873,19 @@ if __name__ == "__main__":
             3 if res.get("northstar_steady_state_compiles")
             or res.get("northstar_divergence") else 0
         )
+    elif len(sys.argv) > 3 and sys.argv[3] == "overload":
+        res = run_overload_bench(duration=secs)
+        print(json.dumps(res))
+        # acceptance gate: shedding must PRESERVE goodput and the
+        # latency of admitted work at 2x offered load — a plane that
+        # lets the queue rot fails both
+        g1, g2 = res["overload_goodput_1x"], res["overload_goodput_2x"]
+        p1, p2 = res["overload_inter_p99_1x"], res["overload_inter_p99_2x"]
+        bad = (
+            g1 <= 0 or g2 < 0.8 * g1
+            or (p1 > 0 and p2 > 2.0 * p1)
+        )
+        sys.exit(3 if bad else 0)
     elif len(sys.argv) > 3 and sys.argv[3] == "trace":
         print(json.dumps(
             run_trace_overhead_bench(concurrency=conc, duration=secs)

@@ -361,7 +361,37 @@ def _defaults() -> Dict[str, Any]:
             "peer_down": -1,
             "peer_drop_rate": 0.0,
             "peer_latency_ms": 0.0,
+            "retry_storm_rate": 0.0,
+            "worker_error_rate": 0.0,
             "seed": 0,
+        },
+        # adaptive overload control (server/overload.py): AIMD admission
+        # limit between floor/ceiling driven by wave wait + fast-window
+        # burn, a brownout ladder that sheds batch/bulk before
+        # interactive, load-derived Retry-After hints, client retry
+        # budgets, and per-lane circuit breakers (worker wire, DCN
+        # peers).  enabled=false freezes the admission limit at
+        # limit.max_inflight and disables the ladder; admission itself
+        # (limit.max_inflight=0) disabling also disables this plane.
+        "overload": {
+            "enabled": True,
+            "interval_ms": 500,
+            "floor": 64,
+            "ceiling": 8192,
+            "increase": 64,
+            "decrease": 0.8,
+            "target_wait_ms": 25.0,
+            "burn_enter": 2.0,
+            "burn_exit": 1.0,
+            "hold_ms": 10000,
+            "retry_after_max_s": 30,
+            "retry_budget_ratio": 0.1,
+            "breaker": {
+                "window_ms": 10000,
+                "min_volume": 8,
+                "failure_ratio": 0.5,
+                "cooldown_ms": 2000,
+            },
         },
     }
 
@@ -652,7 +682,8 @@ class Provider:
             )
         for key in ("faults.device_error_rate", "faults.socket_drop_rate",
                     "faults.tail_drop_rate", "faults.latency_rate",
-                    "faults.shard_error_rate", "faults.peer_drop_rate"):
+                    "faults.shard_error_rate", "faults.peer_drop_rate",
+                    "faults.retry_storm_rate", "faults.worker_error_rate"):
             val = self.get(key, 0)
             if not isinstance(val, (int, float)) or not (0 <= val <= 1):
                 raise ConfigError(key, f"must be a rate in [0, 1], got {val!r}")
@@ -668,6 +699,38 @@ class Provider:
                 "faults.peer_down",
                 f"must be an integer host id (-1 = none), got {val!r}",
             )
+        if not isinstance(self.get("overload.enabled", True), bool):
+            raise ConfigError("overload.enabled", "must be a boolean")
+        for key in ("overload.interval_ms", "overload.floor",
+                    "overload.ceiling", "overload.increase",
+                    "overload.hold_ms", "overload.retry_after_max_s",
+                    "overload.breaker.window_ms",
+                    "overload.breaker.min_volume",
+                    "overload.breaker.cooldown_ms"):
+            val = self.get(key, 0)
+            if not isinstance(val, int) or val < 0:
+                raise ConfigError(
+                    key, f"must be a non-negative integer, got {val!r}"
+                )
+        for key in ("overload.decrease", "overload.retry_budget_ratio",
+                    "overload.breaker.failure_ratio"):
+            val = self.get(key, 0)
+            if not isinstance(val, (int, float)) or not (0 <= val <= 1):
+                raise ConfigError(
+                    key, f"must be a ratio in [0, 1], got {val!r}"
+                )
+        val = self.get("overload.target_wait_ms", 0)
+        if not isinstance(val, (int, float)) or val < 0:
+            raise ConfigError(
+                "overload.target_wait_ms",
+                f"must be a non-negative number, got {val!r}",
+            )
+        for key in ("overload.burn_enter", "overload.burn_exit"):
+            val = self.get(key, 0)
+            if not isinstance(val, (int, float)) or val < 0:
+                raise ConfigError(
+                    key, f"must be a non-negative number, got {val!r}"
+                )
         ns = v.get("namespaces")
         if isinstance(ns, dict):
             if "location" not in ns and "experimental_strict_mode" not in ns:

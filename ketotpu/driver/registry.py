@@ -95,6 +95,8 @@ class Registry:
         self._profiler = None
         self._compile_watch = None
         self._admission = None
+        self._overload = None
+        self._overload_built = False
         self._mapper = None
         self._ro_mapper = None
         self._uuid_mapper = None
@@ -386,21 +388,26 @@ class Registry:
         cadence and must stay cheap."""
         link = self.hostlink()
         metrics = self.metrics()
-        shed = sum(
-            metrics.get_counter(
-                "keto_requests_shed_total", transport=t
-            ) for t in ("rest", "grpc", "batch")
-        )
+        # counter_total: the shed counter is labelled by transport AND
+        # priority class — sum the whole family, not one exact series
+        shed = metrics.counter_total("keto_requests_shed_total")
         with self._lock:
             shadow = self._shadow
             ledger = self._wave_ledger
             watchdog = self._watchdog
+            admission = self._admission
             standby_fn = self.standby_state_fn
         digest = {
             "host": int(link.host_id) if link is not None else 0,
             "pid": os.getpid(),
             "ts": round(time.time(), 3),
             "shed_total": int(shed),
+            "overload_stage": int(
+                admission.stage if admission is not None else 0
+            ),
+            "admission_limit": int(
+                admission.limit if admission is not None else 0
+            ),
             "divergences": int(
                 getattr(shadow, "divergences", 0) if shadow else 0
             ),
@@ -733,6 +740,7 @@ class Registry:
                 self.config.get("engine.mesh.hosts.max_frame_mb", 64)
             ),
             metrics=self.metrics(),
+            breaker_config=self.breaker_config(),
         )
         listen = str(self.config.get("engine.mesh.hosts.listen") or "")
         if listen:
@@ -769,6 +777,11 @@ class Registry:
                             self.config.get("engine.wire_shm_threshold")
                             or 262144
                         ),
+                        breaker_config=self.breaker_config(),
+                        retry_budget_ratio=float(self.config.get(
+                            "overload.retry_budget_ratio", 0.1
+                        )),
+                        logger=self.logger(),
                     )
                 elif kind == "tpu":
                     common = dict(
@@ -916,6 +929,90 @@ class Registry:
                     int(self.config.get("limit.max_inflight", 1024) or 0)
                 )
             return self._admission
+
+    def overload(self):
+        """The adaptive overload-control plane (server/overload.py):
+        AIMD admission limit, brownout ladder, Retry-After hints.  None
+        when disabled (overload.enabled false) or when admission itself
+        is off (limit.max_inflight 0)."""
+        ctl = self.admission()
+        with self._lock:
+            if not self._overload_built:
+                self._overload_built = True
+                enabled = bool(self.config.get("overload.enabled", True))
+                if enabled and ctl.enabled:
+                    from ketotpu.server.overload import OverloadController
+
+                    cfg = self.config
+                    self._overload = OverloadController(
+                        self, ctl,
+                        floor=int(cfg.get("overload.floor", 64)),
+                        ceiling=int(cfg.get("overload.ceiling", 8192)),
+                        increase=int(cfg.get("overload.increase", 64)),
+                        decrease=float(cfg.get("overload.decrease", 0.8)),
+                        target_wait_ms=float(
+                            cfg.get("overload.target_wait_ms", 25.0)
+                        ),
+                        interval_s=float(
+                            cfg.get("overload.interval_ms", 500)
+                        ) / 1000.0,
+                        burn_enter=float(
+                            cfg.get("overload.burn_enter", 2.0)
+                        ),
+                        burn_exit=float(cfg.get("overload.burn_exit", 1.0)),
+                        hold_s=float(
+                            cfg.get("overload.hold_ms", 10000)
+                        ) / 1000.0,
+                        retry_after_max_s=int(
+                            cfg.get("overload.retry_after_max_s", 30)
+                        ),
+                    )
+            return self._overload
+
+    def retry_after_hint(self) -> str:
+        """Load-derived, jittered Retry-After seconds for 429/503
+        responses (str, for direct header use); "1" when the overload
+        plane is off — the old static hint."""
+        try:
+            ov = self.overload()
+        except Exception:  # noqa: BLE001 - a hint must never fail a shed
+            ov = None
+        return str(ov.retry_after()) if ov is not None else "1"
+
+    def breaker_lanes(self) -> list:
+        """Every live circuit breaker in this process — the worker wire
+        (RemoteCheckEngine.breaker) and the per-peer DCN lanes
+        (HostLink.breakers()).  Collected from BUILT components only, so
+        scrapes and debug probes never trigger an engine build."""
+        with self._lock:
+            outer = self._check_engine
+        out = []
+        br = getattr(outer, "breaker", None)
+        if br is not None:
+            out.append(br)
+        link = self.hostlink()
+        if link is not None:
+            fn = getattr(link, "breakers", None)
+            if fn is not None:
+                out.extend(fn())
+        return out
+
+    def breaker_config(self) -> dict:
+        """Shared circuit-breaker knobs for the worker wire and DCN peer
+        lanes (overload.breaker.*)."""
+        cfg = self.config
+        return {
+            "window_s": float(
+                cfg.get("overload.breaker.window_ms", 10000)
+            ) / 1000.0,
+            "min_volume": int(cfg.get("overload.breaker.min_volume", 8)),
+            "failure_ratio": float(
+                cfg.get("overload.breaker.failure_ratio", 0.5)
+            ),
+            "cooldown_s": float(
+                cfg.get("overload.breaker.cooldown_ms", 2000)
+            ) / 1000.0,
+        }
 
     def _device_engine(self) -> Optional[DeviceCheckEngine]:
         """The underlying device engine, unwrapping the coalescer facade."""
@@ -1103,6 +1200,11 @@ class Registry:
         wd = self.watchdog()
         if wd is not None:
             wd.start()
+        # the overload plane (server/overload.py) is built lazily via
+        # overload() and its 2Hz control thread is started by the
+        # serving daemon (server/daemon.py), not here: a bare registry
+        # (tests, tooling, bench probes) must not spawn — and leak — a
+        # background ticker per instance
         return self
 
     def sample_engine_metrics(self) -> None:
@@ -1145,6 +1247,32 @@ class Registry:
                 slo.publish()
             except Exception:  # noqa: BLE001 - scrape must not fail
                 pass
+        # overload plane: adaptive limit + ladder stage gauges stay live
+        # even between ticks; breaker lanes publish their state codes
+        with self._lock:
+            admission = self._admission
+            overload = self._overload
+        if admission is not None and admission.enabled:
+            m = self.metrics()
+            m.gauge("keto_admission_limit", float(admission.limit),
+                    help="current adaptive in-flight admission limit")
+            m.gauge("keto_admission_inflight", float(admission.inflight),
+                    help="units of work currently admitted")
+            m.gauge("keto_overload_stage", float(admission.stage),
+                    help="brownout ladder stage (0=normal .. 3=full shed)")
+        lanes = (
+            overload.breakers() if overload is not None
+            else self.breaker_lanes()
+        )
+        if lanes:
+            m = self.metrics()
+            for br in lanes:
+                m.gauge(
+                    "keto_breaker_state", float(br.state_code()),
+                    help="circuit breaker state "
+                         "(0=closed 1=open 2=half_open)",
+                    lane=br.lane,
+                )
         # fleet view: how many DCN peers are reporting health digests and
         # the worst fast-window burn heard across them via heartbeats
         link = self.hostlink()
@@ -1424,7 +1552,7 @@ class Registry:
             shadows = [self._shadow] + [
                 t._shadow for t in self._tenants.values()
             ]
-            watchdogs = [self._watchdog]
+            watchdogs = [self._watchdog, self._overload]
         for eng in engines + hubs + shadows + watchdogs:
             close = getattr(eng, "close", None)
             if close is not None:

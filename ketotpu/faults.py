@@ -42,6 +42,13 @@ Knobs (env var / ``configure`` kwarg):
   cross-host PeerLink call drops its connection before sending;
 * ``KETO_FAULT_PEER_LATENCY_MS`` / ``peer_latency_ms`` — latency spike
   added to every cross-host PeerLink call (DCN congestion simulation);
+* ``KETO_FAULT_RETRY_STORM`` / ``retry_storm_rate`` — probability an SDK
+  retry ignores the cooperative protocol (no Retry-After wait, no retry
+  budget): the misbehaving-client simulation the overload plane must
+  survive server-side;
+* ``KETO_FAULT_WORKER_ERROR_RATE`` / ``worker_error_rate`` — probability
+  the owner wedges an exchange mid-frame (connection breaks with no
+  response), exercising the worker-wire circuit breaker;
 * ``KETO_FAULT_SEED`` / ``seed`` — deterministic RNG seed.
 """
 
@@ -73,6 +80,8 @@ class FaultPlan:
         peer_down: int = -1,
         peer_drop_rate: float = 0.0,
         peer_latency_ms: float = 0.0,
+        retry_storm_rate: float = 0.0,
+        worker_error_rate: float = 0.0,
         seed: Optional[int] = None,
     ):
         self.device_error_rate = float(device_error_rate)
@@ -84,6 +93,8 @@ class FaultPlan:
         self.peer_down = int(peer_down)
         self.peer_drop_rate = float(peer_drop_rate)
         self.peer_latency_ms = float(peer_latency_ms)
+        self.retry_storm_rate = float(retry_storm_rate)
+        self.worker_error_rate = float(worker_error_rate)
         self.latency_ms = float(latency_ms)
         if latency_rate is None:
             latency_rate = 1.0 if latency_ms > 0 else 0.0
@@ -106,6 +117,8 @@ class FaultPlan:
             or self.peer_down >= 0
             or self.peer_drop_rate
             or self.peer_latency_ms
+            or self.retry_storm_rate
+            or self.worker_error_rate
             or (self.latency_ms and self.latency_rate)
         )
 
@@ -148,6 +161,8 @@ class FaultPlan:
             peer_down=int(peer_raw) if peer_raw else -1,
             peer_drop_rate=f("KETO_FAULT_PEER_DROP_RATE"),
             peer_latency_ms=f("KETO_FAULT_PEER_LATENCY_MS"),
+            retry_storm_rate=f("KETO_FAULT_RETRY_STORM"),
+            worker_error_rate=f("KETO_FAULT_WORKER_ERROR_RATE"),
             seed=int(seed_raw) if seed_raw else None,
         )
 
@@ -197,6 +212,8 @@ def configure_from_config(cfg) -> None:
         peer_down=block.get("peer_down", -1),
         peer_drop_rate=block.get("peer_drop_rate", 0.0),
         peer_latency_ms=block.get("peer_latency_ms", 0.0),
+        retry_storm_rate=block.get("retry_storm_rate", 0.0),
+        worker_error_rate=block.get("worker_error_rate", 0.0),
         seed=block.get("seed") or None,
     )
 
@@ -225,7 +242,8 @@ def inject(site: str) -> None:
 
 
 def should(kind: str) -> bool:
-    """Roll for a boolean fault (``socket_drop`` / ``tail_drop``)."""
+    """Roll for a boolean fault (``socket_drop`` / ``tail_drop`` /
+    ``retry_storm`` / ``worker_error``)."""
     p = _plan
     if not p.active:
         return False
@@ -234,6 +252,12 @@ def should(kind: str) -> bool:
         return True
     if kind == "tail_drop" and p._roll(p.tail_drop_rate):
         p._count("tail_drop")
+        return True
+    if kind == "retry_storm" and p._roll(p.retry_storm_rate):
+        p._count("retry_storm")
+        return True
+    if kind == "worker_error" and p._roll(p.worker_error_rate):
+        p._count("worker_error")
         return True
     return False
 

@@ -122,6 +122,17 @@ class Metrics:
         with self._lock:
             return self._counters.get(key, 0.0)
 
+    def counter_total(self, name: str, **match) -> float:
+        """Sum over every series of ``name`` whose labels include ``match``
+        — unlike :meth:`get_counter` this does not require knowing the
+        full label set, so readers survive a series gaining a label."""
+        want = set(match.items())
+        with self._lock:
+            return sum(
+                v for (n, labels), v in self._counters.items()
+                if n == name and want.issubset(labels)
+            )
+
     def get_gauge(self, name: str, **labels) -> float:
         key = (name, tuple(sorted(labels.items())))
         with self._lock:

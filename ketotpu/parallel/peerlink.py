@@ -291,6 +291,7 @@ class HostLink:
         rpc_timeout_ms: float = 2000.0,
         max_frame_mb: int = 64,
         metrics=None,
+        breaker_config: Optional[dict] = None,
     ):
         if not secret:
             raise ValueError(
@@ -322,6 +323,13 @@ class HostLink:
             if h != self.host_id
         }
         self._clients: Dict[int, _PeerClient] = {}
+        # per-peer circuit breakers on the frontier-exchange lane: an
+        # erroring/timing-out peer fails fast to the oracle degrade path
+        # (verdicts stay exact) instead of eating the RPC timeout on
+        # every wave; heartbeats bypass the breaker — they are the probe
+        # that keeps liveness honest while the lane is open
+        self._breaker_config = dict(breaker_config or {})
+        self._breakers: Dict[int, "CircuitBreaker"] = {}
         self.host_downs = 0        # peers declared down (cumulative)
         self.peer_recoveries = 0   # peers that came back after down
         # fleet-health seams, wired by Registry._build_hostlink: with a
@@ -512,6 +520,24 @@ class HostLink:
 
     # -- cross-host ops -----------------------------------------------------
 
+    def breaker(self, hid: int):
+        """The (lazily built) circuit breaker guarding peer ``hid``'s
+        frontier-exchange lane."""
+        from ketotpu.server.overload import CircuitBreaker
+
+        with self._state_lock:
+            br = self._breakers.get(hid)
+            if br is None:
+                br = self._breakers[hid] = CircuitBreaker(
+                    f"peer{hid}", metrics=self.metrics,
+                    **self._breaker_config,
+                )
+            return br
+
+    def breakers(self) -> List:
+        with self._state_lock:
+            return list(self._breakers.values())
+
     def check_rows_async(
         self, hid: int, rows, rest_depth: int,
         timeout_s: Optional[float],
@@ -521,6 +547,17 @@ class HostLink:
         The returned pending resolves to the verdict array, or None —
         the caller degrades those rows to the oracle."""
         pending = _Pending()
+        breaker = self.breaker(hid)
+        if not breaker.allow():
+            # lane open: pre-failed pending, no exchange thread — the
+            # caller degrades these rows to the oracle immediately
+            # (exact verdicts, just slower) instead of waiting out the
+            # RPC timeout against a peer that keeps failing
+            pending.error = ConnectionError(
+                f"peer{hid} circuit breaker open; degrading to oracle"
+            )
+            pending._evt.set()
+            return pending
         meta = {
             "op": "check", "host": self.host_id,
             "depth": int(rest_depth), "n": len(rows),
@@ -551,7 +588,9 @@ class HostLink:
                 pending.value = ok.astype(bool)
             except BaseException as e:  # noqa: BLE001 - reported via wait
                 pending.error = e
+                breaker.record_failure()
             else:
+                breaker.record_success()
                 with self._state_lock:
                     st = self._peers.get(hid)
                     if st is not None:
@@ -703,6 +742,10 @@ class HostLink:
                         if rtts else 0.0
                     ),
                     "bootstraps": int(st.bootstraps),
+                    "breaker": (
+                        self._breakers[hid].state
+                        if hid in self._breakers else "closed"
+                    ),
                     # None = this peer has never sent one (legacy
                     # payload); /debug/fleet renders that "unavailable"
                     "digest": st.digest,

@@ -22,10 +22,14 @@ Stdlib-only (urllib), synchronous, one class per API port pairing:
 from __future__ import annotations
 
 import json
+import random
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
 from typing import List, Optional, Sequence, Tuple
+
+from ketotpu.server.overload import RetryBudget
 
 from ketotpu.api.types import (
     BadRequestError,
@@ -59,6 +63,8 @@ class KetoClient:
         *,
         opl_url: Optional[str] = None,
         timeout: float = 30.0,
+        max_retries: int = 2,
+        retry_budget_ratio: float = 0.1,
     ):
         self.read_url = read_url.rstrip("/")
         self.write_url = (write_url or read_url).rstrip("/")
@@ -68,12 +74,21 @@ class KetoClient:
         #: (X-Keto-Snaptoken response header); feed it back into
         #: ``check(..., snaptoken=...)`` for read-your-writes
         self.last_snaptoken: Optional[str] = None
+        # cooperative retry protocol: a 429/503 is retried, honoring the
+        # server's Retry-After hint (jittered, capped by the remaining
+        # client timeout) — but only within a token-bucket retry budget
+        # (retries capped to a fraction of successes), so a fleet of
+        # SDKs cannot amplify an overload into a retry storm.
+        # max_retries=0 disables retries entirely.
+        self.max_retries = max(0, int(max_retries))
+        self.retry_budget = RetryBudget(ratio=retry_budget_ratio)
+        self.retries = 0  # observability: retries actually performed
 
     # -- transport ----------------------------------------------------------
 
-    def _request(
+    def _request_once(
         self, method: str, url: str, body: Optional[dict | list] = None
-    ) -> Tuple[int, str]:
+    ) -> Tuple[int, str, dict]:
         data = json.dumps(body).encode() if body is not None else None
         req = urllib.request.Request(
             url,
@@ -86,9 +101,49 @@ class KetoClient:
                 token = resp.headers.get("X-Keto-Snaptoken")
                 if token:
                     self.last_snaptoken = token
-                return resp.status, resp.read().decode()
+                return resp.status, resp.read().decode(), dict(resp.headers)
         except urllib.error.HTTPError as e:
-            return e.code, e.read().decode()
+            return e.code, e.read().decode(), dict(e.headers or {})
+
+    @staticmethod
+    def _retry_delay(headers: dict, attempt: int) -> float:
+        """Backoff before a retry: the server's Retry-After hint when it
+        sent one (already jittered server-side), exponential backoff
+        otherwise — re-jittered here so a shed cohort spreads out."""
+        hint = 0.0
+        for k, v in headers.items():
+            if str(k).lower() == "retry-after":
+                try:
+                    hint = float(v)
+                except (TypeError, ValueError):
+                    hint = 0.0
+                break
+        if hint <= 0.0:
+            hint = 0.25 * (2 ** attempt)
+        return hint * (0.5 + random.random() * 0.5)
+
+    def _request(
+        self, method: str, url: str, body: Optional[dict | list] = None
+    ) -> Tuple[int, str]:
+        from ketotpu import faults
+
+        status, text, headers = self._request_once(method, url, body)
+        for attempt in range(self.max_retries):
+            if status not in (429, 503):
+                break
+            storm = faults.should("retry_storm")
+            if not storm and not self.retry_budget.allow_retry():
+                break  # budget dry: surface the 429/503 as-is
+            delay = 0.0 if storm else min(
+                self._retry_delay(headers, attempt), max(0.0, self.timeout)
+            )
+            if delay > 0.0:
+                time.sleep(delay)
+            self.retries += 1
+            status, text, headers = self._request_once(method, url, body)
+        if status < 500 and status != 429:
+            self.retry_budget.record_success()
+        return status, text
 
     @staticmethod
     def _raise_for(status: int, body: str):

@@ -1,4 +1,4 @@
-"""Bounded in-flight admission control with load shedding.
+"""Bounded in-flight admission control with priority-class load shedding.
 
 One controller per registry, shared by the REST handler threads and the
 gRPC interceptor of every port: the budget bounds total concurrent
@@ -7,11 +7,54 @@ backlog and the owner socket pool from unbounded queueing.  When the
 budget is exhausted new work is shed immediately with 429 /
 ``RESOURCE_EXHAUSTED`` and a ``Retry-After`` hint — a fast no is the
 whole point; queueing here would just move the hang.
+
+Two refinements over a plain semaphore:
+
+* **Dynamic limit** — ``limit`` is rewritten at runtime by the AIMD
+  controller in :mod:`ketotpu.server.overload`.  ``try_acquire``
+  therefore returns the *granted token* (the clamped weight) and
+  ``release`` takes exactly that token back: re-clamping the weight
+  against the *current* limit on release would leak budget whenever the
+  limit shrank mid-flight.
+* **Priority classes** — each request is admitted under a class
+  (interactive check > expand/list > batch items > watch/bootstrap)
+  whose budget is a fraction of the shared limit.  Lower classes hit
+  their ceiling first, so under pressure batch and list traffic sheds
+  while interactive checks keep landing; the brownout ladder tightens
+  the fractions stage by stage until only exempt probes remain.
 """
 
 from __future__ import annotations
 
+import math
 import threading
+from typing import Dict, Optional
+
+# priority classes, best-served first
+CLASS_INTERACTIVE = "interactive"  # single check / openapi check
+CLASS_BULK = "bulk"                # expand, list, admin reads/writes
+CLASS_BATCH = "batch"              # batch front doors + per-item weight
+CLASS_BACKGROUND = "background"    # watch, bootstrap, changefeed
+
+CLASSES = (CLASS_INTERACTIVE, CLASS_BULK, CLASS_BATCH, CLASS_BACKGROUND)
+
+# occupancy ceilings as fractions of the shared limit, per brownout
+# stage.  Stage 0 leaves headroom above batch/background so interactive
+# checks always find room; stage 1 sheds batch/background outright and
+# halves bulk; stage 2 is interactive-only; stage 3 sheds everything
+# (admission-exempt debug/health surfaces never reach this table).
+STAGE_FRACTIONS: Dict[int, Dict[str, float]] = {
+    0: {CLASS_INTERACTIVE: 1.00, CLASS_BULK: 0.95,
+        CLASS_BATCH: 0.90, CLASS_BACKGROUND: 0.85},
+    1: {CLASS_INTERACTIVE: 1.00, CLASS_BULK: 0.50,
+        CLASS_BATCH: 0.00, CLASS_BACKGROUND: 0.00},
+    2: {CLASS_INTERACTIVE: 1.00, CLASS_BULK: 0.00,
+        CLASS_BATCH: 0.00, CLASS_BACKGROUND: 0.00},
+    3: {CLASS_INTERACTIVE: 0.00, CLASS_BULK: 0.00,
+        CLASS_BATCH: 0.00, CLASS_BACKGROUND: 0.00},
+}
+
+STAGE_NAMES = ("normal", "brownout-1", "brownout-2", "full-shed")
 
 
 class AdmissionController:
@@ -20,36 +63,95 @@ class AdmissionController:
     def __init__(self, limit: int = 0):
         self.limit = int(limit)
         self.inflight = 0
-        self.shed = 0  # observability: requests refused at admission
+        self.shed = 0  # observability: units refused at admission
+        # capacity sheds: refused because the request would not fit under
+        # the raw limit even ignoring class caps — ORGANIC pressure.  The
+        # remainder (total - capacity) are policy sheds: the stage/class
+        # fraction refused them, i.e. the brownout ladder doing its job.
+        # The OverloadController walks the ladder on capacity sheds only,
+        # otherwise a full-shed stage wedges itself: every probe it sheds
+        # would read as fresh pressure and de-escalation could never start.
+        self.shed_capacity = 0
+        self.stage = 0  # brownout ladder stage, written by OverloadController
+        self.shed_by_class: Dict[str, int] = {k: 0 for k in CLASSES}
         self._lock = threading.Lock()
 
     @property
     def enabled(self) -> bool:
         return self.limit > 0
 
-    def try_acquire(self, weight: int = 1) -> bool:
+    def class_cap(self, klass: Optional[str]) -> int:
+        """Occupancy ceiling for ``klass`` under the current stage.
+
+        ``ceil`` keeps tiny test budgets honest: a fraction of 0.9 on a
+        limit of 2 still admits 2 units, it only bites once the limit is
+        large enough for the headroom to be a whole unit.
+        """
+        fractions = STAGE_FRACTIONS.get(self.stage, STAGE_FRACTIONS[3])
+        frac = fractions.get(klass or CLASS_INTERACTIVE,
+                             fractions[CLASS_BULK])
+        if frac <= 0.0:
+            return 0
+        return min(self.limit, int(math.ceil(self.limit * frac)))
+
+    def try_acquire(self, weight: int = 1,
+                    klass: str = CLASS_INTERACTIVE) -> int:
         """Admit ``weight`` units of work, or refuse without blocking.
 
-        Batch RPCs are admitted by ITEM count, not request count — one
-        4096-item batch costs 4096 units, so a flood of batches sheds at
-        the same engine pressure a flood of singles would.  A single
-        batch larger than the whole budget is clamped to the budget:
-        it can still run, but only alone (otherwise any batch above
-        ``limit`` would be unservable by construction).
+        Returns the granted token (the clamped weight, truthy) when
+        admitted and ``0`` when shed — pass the token verbatim to
+        :meth:`release`.  Batch RPCs are admitted by ITEM count, not
+        request count — one 4096-item batch costs 4096 units, so a flood
+        of batches sheds at the same engine pressure a flood of singles
+        would.  A single batch larger than its class ceiling is clamped
+        to that ceiling: it can still run, but only alone (otherwise any
+        batch above the cap would be unservable by construction).
+        """
+        weight = max(1, int(weight))
+        if self.limit <= 0:
+            return weight
+        with self._lock:
+            weight = min(weight, self.limit)
+            cap = self.class_cap(klass)
+            # clamp against the CLASS cap, not just the limit: a batch
+            # wider than the class ceiling must stay servable when the
+            # lane is idle (granted == cap admits it only alone).  A cap
+            # of 0 is a policy shed — nothing to clamp to.
+            granted = min(weight, cap) if cap > 0 else weight
+            if self.inflight + granted > cap:
+                self.shed += weight
+                if self.inflight + weight > self.limit:
+                    self.shed_capacity += weight
+                if klass in self.shed_by_class:
+                    self.shed_by_class[klass] += 1
+                else:
+                    self.shed_by_class[klass] = 1
+                return 0
+            self.inflight += granted
+            return granted
+
+    def release(self, token: int = 1) -> None:
+        """Return exactly the units granted by :meth:`try_acquire`.
+
+        The token is NOT re-clamped against the current limit: the limit
+        is dynamic, and clamping a release after a mid-flight shrink
+        would free fewer units than were taken, leaking budget forever.
         """
         if self.limit <= 0:
-            return True
-        weight = min(max(1, int(weight)), self.limit)
-        with self._lock:
-            if self.inflight + weight > self.limit:
-                self.shed += weight
-                return False
-            self.inflight += weight
-            return True
-
-    def release(self, weight: int = 1) -> None:
-        if self.limit <= 0:
             return
-        weight = min(max(1, int(weight)), self.limit)
         with self._lock:
-            self.inflight = max(0, self.inflight - weight)
+            self.inflight = max(0, self.inflight - max(0, int(token)))
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "limit": self.limit,
+                "inflight": self.inflight,
+                "shed": self.shed,
+                "shed_capacity": self.shed_capacity,
+                "stage": self.stage,
+                "stage_name": STAGE_NAMES[min(self.stage,
+                                              len(STAGE_NAMES) - 1)],
+                "shed_by_class": dict(self.shed_by_class),
+                "class_caps": {k: self.class_cap(k) for k in CLASSES},
+            }

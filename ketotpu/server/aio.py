@@ -43,6 +43,7 @@ from urllib.parse import parse_qs, urlparse
 
 from ketotpu import deadline, flightrec
 from ketotpu.api.types import KetoAPIError
+from ketotpu.server import overload
 
 _ALLOWED_METHODS = {"GET", "POST", "PUT", "DELETE", "PATCH"}
 _MAX_HEADER_BYTES = 65536
@@ -318,11 +319,15 @@ class AsyncHTTPServer:
                 registry.admission()
                 if parsed.path not in rest._ADMISSION_EXEMPT else None
             )
-            if ctl is not None and not ctl.try_acquire():
+            token = 0
+            klass = overload.classify_rest_path(parsed.path)
+            if ctl is not None and not (
+                token := ctl.try_acquire(klass=klass)
+            ):
                 registry.metrics().counter(
                     "keto_requests_shed_total", 1.0,
                     help="requests refused by admission control",
-                    transport="rest",
+                    transport="rest", klass=klass,
                 )
                 registry.metrics().observe(
                     flightrec.STAGE_METRIC, 0.0,
@@ -336,7 +341,7 @@ class AsyncHTTPServer:
                         f"in-flight limit reached ({ctl.limit}); "
                         "retry later",
                     ),
-                    {"Retry-After": "1"},
+                    {"Retry-After": registry.retry_after_hint()},
                 )
             else:
                 try:
@@ -359,7 +364,7 @@ class AsyncHTTPServer:
                             )
                 finally:
                     if ctl is not None:
-                        ctl.release()
+                        ctl.release(token)
             flightrec.note_stage("compute", time.perf_counter() - t_parse)
             flightrec.note(status=status)
             if (op == "check" and isinstance(payload, dict)

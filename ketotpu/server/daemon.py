@@ -396,6 +396,14 @@ class Server:
             self.logger.info(
                 "serving engine host (replication wire) on %s", repl_sock
             )
+        # close the signal->actuation loop: the overload plane starts
+        # AIMD-adjusting the admission limit off SLO burn + wave wait.
+        # Started here — not in Registry.init() — so only serving
+        # processes pay for the 2Hz control thread; stop() retires it
+        # via close_engines()
+        ov = r.overload()
+        if ov is not None:
+            ov.start()
         return self
 
     # -- lifecycle ----------------------------------------------------------

@@ -109,21 +109,30 @@ def batch_admission(r, n: int):
     flood of singles would.  Refusal raises the typed 429 that both
     transports already map (Retry-After on REST, RESOURCE_EXHAUSTED on
     gRPC)."""
+    from ketotpu.server.admission import CLASS_BATCH
+
     ctl = r.admission()
     extra = max(0, int(n) - 1)
     if extra == 0 or ctl is None or not ctl.enabled:
         yield
         return
     # the front door already holds this REQUEST's unit, so clamp the
-    # batch's extra weight to limit-1: an oversized batch can still run,
-    # but only alone (try_acquire's own clamp stops at limit, which on
-    # top of the held unit would make any batch > limit unservable)
-    extra = min(extra, max(1, ctl.limit - 1))
-    if not ctl.try_acquire(extra):
+    # batch's extra weight to the batch CLASS ceiling minus that held
+    # unit: an oversized batch can still run, but only alone (clamping
+    # to the raw limit would put the total above the class cap and make
+    # any batch > cap unservable by construction).  Under brownout the
+    # ladder clamps batch weight much harder — a brownout-1 batch may
+    # only take a small slice of the budget.
+    cap = max(1, ctl.class_cap(CLASS_BATCH) - 1)
+    if ctl.stage >= 1:
+        cap = min(cap, max(1, ctl.limit // 8))
+    extra = min(extra, cap)
+    token = ctl.try_acquire(extra, klass=CLASS_BATCH)
+    if not token:
         r.metrics().counter(
             "keto_requests_shed_total", 1.0,
             help="requests refused by admission control",
-            transport="batch",
+            transport="batch", klass=CLASS_BATCH,
         )
         raise TooManyRequestsError(
             f"in-flight limit reached ({ctl.limit}); "
@@ -132,7 +141,7 @@ def batch_admission(r, n: int):
     try:
         yield
     finally:
-        ctl.release(extra)
+        ctl.release(token)
 
 
 def record_batch(r, op: str, n: int) -> None:

@@ -21,6 +21,7 @@ import time
 import grpc
 
 from ketotpu import deadline, flightrec
+from ketotpu.server import overload
 
 
 class AdmissionInterceptor(grpc.ServerInterceptor):
@@ -48,20 +49,27 @@ class AdmissionInterceptor(grpc.ServerInterceptor):
         registry = self.registry
         inner = handler.unary_unary
         op = method.rsplit("/", 1)[-1].lower()
+        klass = overload.classify_grpc_op(op)
 
         def wrapped(request, context):
             ctl = registry.admission()
-            if not ctl.try_acquire():
+            token = ctl.try_acquire(klass=klass)
+            if not token:
                 m = registry.metrics()
                 m.counter(
                     "keto_requests_shed_total", 1.0,
                     help="requests refused by admission control",
-                    transport="grpc",
+                    transport="grpc", klass=klass,
                 )
                 m.observe(
                     flightrec.STAGE_METRIC, 0.0,
                     help="per-RPC stage wall time decomposition",
                     op=op, stage="shed",
+                )
+                # the trailing-metadata twin of the REST Retry-After
+                # header: load-derived + jittered backoff hint
+                context.set_trailing_metadata(
+                    (("retry-after", registry.retry_after_hint()),)
                 )
                 context.abort(
                     grpc.StatusCode.RESOURCE_EXHAUSTED,
@@ -71,7 +79,7 @@ class AdmissionInterceptor(grpc.ServerInterceptor):
                 with deadline.scope(context.time_remaining()):
                     return inner(request, context)
             finally:
-                ctl.release()
+                ctl.release(token)
 
         return grpc.unary_unary_rpc_method_handler(
             wrapped,

@@ -1,0 +1,575 @@
+"""Adaptive overload control: AIMD limit, brownout ladder, retry budgets,
+circuit breakers.
+
+Zanzibar survives hotspots with request prioritization, load shedding and
+throttled retries (SURVEY §3/§5); this module is that loop closed for the
+TPU serving stack.  The SLO burn-rate engine and wave ledger provide the
+pressure *signals* — this plane turns them into *actuation*:
+
+* :class:`OverloadController` — a background tick (watchdog-style thread,
+  directly tickable in tests) that
+
+  - **AIMD-adjusts** ``AdmissionController.limit`` between a configured
+    floor and ceiling: additive growth while wave wait and fast-window
+    SLO burn stay under target, multiplicative shrink on latency
+    inflation or burn, published as ``keto_admission_limit``;
+  - drives the **brownout ladder** (normal → brownout-1: shed
+    batch/list → brownout-2: interactive-only → full shed) off fast
+    burn + shed pressure, every transition edge-logged and counted in
+    ``keto_overload_transitions_total``;
+  - computes the cooperative **Retry-After hint** — load-derived and
+    jittered so shed clients do not stampede back in lockstep.
+
+* :class:`RetryBudget` — token bucket capping retries to a fraction of
+  successes (client SDK and the owner RPC wire), so retry storms cannot
+  multiply offered load; exhaustion counts into
+  ``keto_retry_budget_exhausted_total``.
+
+* :class:`CircuitBreaker` — windowed error-rate breaker for the worker
+  wire and DCN peer lanes: trips open on failure bursts, fails fast to
+  the existing oracle/replica degrade paths (verdicts stay exact), and
+  half-open probes to recover.  State in ``keto_breaker_state``, trips
+  in ``keto_breaker_trips_total``.
+
+Priority classification for both transports lives here too so REST and
+gRPC agree on what sheds first.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from .admission import (
+    CLASS_BACKGROUND,
+    CLASS_BATCH,
+    CLASS_BULK,
+    CLASS_INTERACTIVE,
+    STAGE_NAMES,
+    AdmissionController,
+)
+
+__all__ = [
+    "OverloadController", "RetryBudget", "CircuitBreaker",
+    "classify_rest_path", "classify_grpc_op",
+    "BREAKER_CLOSED", "BREAKER_OPEN", "BREAKER_HALF_OPEN",
+]
+
+
+# -- priority classification --------------------------------------------------
+
+# exact REST front doors; anything unlisted (admin CRUD, OPTIONS-able
+# surfaces) rides in the bulk class — it is neither latency-critical nor
+# weight-amplified
+_REST_CLASSES = {
+    "/relation-tuples/check": CLASS_INTERACTIVE,
+    "/relation-tuples/check/openapi": CLASS_INTERACTIVE,
+    "/relation-tuples/check/batch": CLASS_BATCH,
+    "/relation-tuples/batch/check": CLASS_BATCH,
+    "/relation-tuples/batch/expand": CLASS_BATCH,
+    "/relation-tuples/expand": CLASS_BULK,
+    "/relation-tuples/list-objects": CLASS_BULK,
+    "/relation-tuples/list-subjects": CLASS_BULK,
+    "/relation-tuples/watch": CLASS_BACKGROUND,
+}
+
+
+def classify_rest_path(path: str) -> str:
+    """Priority class for a REST front door (debug/probes never get here —
+    they are admission-exempt upstream)."""
+    return _REST_CLASSES.get(path, CLASS_BULK)
+
+
+def classify_grpc_op(op: str) -> str:
+    """Priority class for a gRPC method suffix (already lowercased by the
+    admission interceptor)."""
+    if "batch" in op:
+        return CLASS_BATCH
+    if op == "check":
+        return CLASS_INTERACTIVE
+    if "watch" in op or "bootstrap" in op or "subscribe" in op:
+        return CLASS_BACKGROUND
+    return CLASS_BULK
+
+
+# -- cooperative retry budget -------------------------------------------------
+
+class RetryBudget:
+    """Token bucket capping retries to a fraction of successes.
+
+    Every success deposits ``ratio`` tokens (capped at ``burst``); every
+    retry withdraws one whole token.  A client that only ever fails runs
+    dry after ``burst`` retries and stops amplifying — which is the
+    point: under a real outage retries are pure extra load.
+    """
+
+    def __init__(self, ratio: float = 0.1, burst: float = 10.0,
+                 lane: str = "sdk", metrics=None):
+        self.ratio = float(ratio)
+        self.burst = float(burst)
+        self.lane = lane
+        self.tokens = float(burst)
+        self.exhausted = 0
+        self._metrics = metrics
+        self._lock = threading.Lock()
+
+    def record_success(self) -> None:
+        with self._lock:
+            self.tokens = min(self.burst, self.tokens + self.ratio)
+
+    def allow_retry(self) -> bool:
+        with self._lock:
+            if self.tokens >= 1.0:
+                self.tokens -= 1.0
+                return True
+            self.exhausted += 1
+        if self._metrics is not None:
+            self._metrics.counter(
+                "keto_retry_budget_exhausted_total", 1.0,
+                help="retries refused because the token bucket ran dry",
+                lane=self.lane,
+            )
+        return False
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            return {"lane": self.lane, "tokens": round(self.tokens, 3),
+                    "burst": self.burst, "ratio": self.ratio,
+                    "exhausted": self.exhausted}
+
+
+# -- circuit breaker ----------------------------------------------------------
+
+BREAKER_CLOSED = "closed"
+BREAKER_OPEN = "open"
+BREAKER_HALF_OPEN = "half_open"
+
+_BREAKER_CODES = {BREAKER_CLOSED: 0, BREAKER_OPEN: 1, BREAKER_HALF_OPEN: 2}
+
+
+class CircuitBreaker:
+    """Windowed error-rate breaker with a single half-open probe.
+
+    Closed: everything flows, outcomes accumulate in a sliding window.
+    Once the window holds ``min_volume`` samples and the failure ratio
+    reaches ``failure_ratio``, the breaker trips OPEN: callers fail fast
+    into their degrade path instead of eating a timeout.  After
+    ``cooldown_s`` one probe is let through half-open; success closes
+    the breaker, failure re-opens it for another cooldown.
+    """
+
+    def __init__(self, lane: str, *, window_s: float = 10.0,
+                 min_volume: int = 8, failure_ratio: float = 0.5,
+                 cooldown_s: float = 2.0, metrics=None, logger=None,
+                 clock=time.monotonic):
+        self.lane = lane
+        self.window_s = float(window_s)
+        self.min_volume = int(min_volume)
+        self.failure_ratio = float(failure_ratio)
+        self.cooldown_s = float(cooldown_s)
+        self.state = BREAKER_CLOSED
+        self.trips = 0
+        self._events: deque = deque(maxlen=512)  # (ts, ok)
+        self._opened_at = 0.0
+        self._probe_out = False
+        self._metrics = metrics
+        self._logger = logger
+        self._clock = clock
+        self._lock = threading.Lock()
+
+    def _set_state(self, state: str) -> None:
+        # caller holds the lock
+        if state == self.state:
+            return
+        prev, self.state = self.state, state
+        if self._logger is not None:
+            self._logger.warning(
+                "breaker %s: %s -> %s", self.lane, prev, state,
+            )
+        if self._metrics is not None:
+            self._metrics.gauge(
+                "keto_breaker_state", _BREAKER_CODES[state],
+                help="circuit breaker state (0=closed 1=open 2=half_open)",
+                lane=self.lane,
+            )
+
+    def _prune(self, now: float) -> None:
+        cutoff = now - self.window_s
+        while self._events and self._events[0][0] < cutoff:
+            self._events.popleft()
+
+    def allow(self) -> bool:
+        """True when a call may proceed; False = fail fast, lane is open."""
+        now = self._clock()
+        with self._lock:
+            if self.state == BREAKER_OPEN:
+                if now - self._opened_at < self.cooldown_s:
+                    return False
+                self._set_state(BREAKER_HALF_OPEN)
+                self._probe_out = True
+                return True
+            if self.state == BREAKER_HALF_OPEN:
+                if self._probe_out:
+                    return False
+                self._probe_out = True
+                return True
+            return True
+
+    def record_success(self) -> None:
+        now = self._clock()
+        with self._lock:
+            self._probe_out = False
+            if self.state != BREAKER_CLOSED:
+                self._events.clear()
+                self._set_state(BREAKER_CLOSED)
+            self._events.append((now, True))
+            self._prune(now)
+
+    def record_failure(self) -> None:
+        now = self._clock()
+        with self._lock:
+            self._probe_out = False
+            if self.state == BREAKER_HALF_OPEN:
+                # the probe failed: straight back to open, fresh cooldown
+                self._opened_at = now
+                self._set_state(BREAKER_OPEN)
+                return
+            self._events.append((now, False))
+            self._prune(now)
+            if self.state != BREAKER_CLOSED:
+                return
+            volume = len(self._events)
+            if volume < self.min_volume:
+                return
+            failures = sum(1 for _, ok in self._events if not ok)
+            if failures / volume >= self.failure_ratio:
+                self.trips += 1
+                self._opened_at = now
+                self._set_state(BREAKER_OPEN)
+                if self._metrics is not None:
+                    self._metrics.counter(
+                        "keto_breaker_trips_total", 1.0,
+                        help="circuit breaker trips (closed -> open)",
+                        lane=self.lane,
+                    )
+
+    def state_code(self) -> int:
+        return _BREAKER_CODES[self.state]
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            volume = len(self._events)
+            failures = sum(1 for _, ok in self._events if not ok)
+            return {"lane": self.lane, "state": self.state,
+                    "trips": self.trips, "window_volume": volume,
+                    "window_failures": failures}
+
+
+# -- the overload controller --------------------------------------------------
+
+class OverloadController:
+    """AIMD admission limit + brownout ladder + Retry-After hints.
+
+    Runs a watchdog-style daemon thread ticking every ``interval_s``;
+    :meth:`tick` is also directly callable so tests drive it
+    deterministically.  All actuation lands on the shared
+    :class:`AdmissionController` (``limit`` and ``stage``), which the
+    hot admission path reads without ever touching this object.
+    """
+
+    def __init__(self, registry, ctl: AdmissionController, *,
+                 floor: int = 64, ceiling: int = 8192, increase: int = 64,
+                 decrease: float = 0.8, target_wait_ms: float = 25.0,
+                 interval_s: float = 0.5, burn_enter: float = 2.0,
+                 burn_exit: float = 1.0, hold_s: float = 10.0,
+                 retry_after_max_s: int = 30):
+        self._r = registry
+        self._ctl = ctl
+        self.floor = max(1, int(floor))
+        self.ceiling = max(self.floor, int(ceiling))
+        self.increase = max(1, int(increase))
+        self.decrease = min(0.99, max(0.1, float(decrease)))
+        self.target_wait_ms = float(target_wait_ms)
+        self.interval_s = max(0.05, float(interval_s))
+        self.burn_enter = float(burn_enter)
+        self.burn_exit = float(burn_exit)
+        self.hold_s = float(hold_s)
+        self.retry_after_max_s = max(1, int(retry_after_max_s))
+
+        self.transitions: deque = deque(maxlen=64)
+        self._breakers: List[CircuitBreaker] = []
+        self._budgets: List[RetryBudget] = []
+        self._last_shed = ctl.shed
+        self._last_shed_cap = ctl.shed_capacity
+        self._last_waves: Optional[int] = None
+        self._shed_rate = 0.0  # units/s over the last tick
+        self._last_signals: Dict[str, object] = {}
+        self._calm_since: Optional[float] = None
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+        m = self._metrics()
+        if m is not None:
+            # pre-register the transition vocabulary so scrapes show the
+            # counters at 0 before the first brownout
+            for direction in ("up", "down"):
+                m.counter("keto_overload_transitions_total", 0.0,
+                          help="brownout ladder stage transitions",
+                          direction=direction)
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _metrics(self):
+        try:
+            return self._r.metrics()
+        except Exception:
+            return None
+
+    def _logger(self):
+        try:
+            return self._r.logger()
+        except Exception:
+            return None
+
+    @property
+    def stage(self) -> int:
+        return self._ctl.stage
+
+    @property
+    def stage_name(self) -> str:
+        return STAGE_NAMES[min(self._ctl.stage, len(STAGE_NAMES) - 1)]
+
+    def register_breaker(self, breaker: CircuitBreaker) -> None:
+        with self._lock:
+            if breaker not in self._breakers:
+                self._breakers.append(breaker)
+
+    def register_budget(self, budget: RetryBudget) -> None:
+        with self._lock:
+            if budget not in self._budgets:
+                self._budgets.append(budget)
+
+    def breakers(self) -> List[CircuitBreaker]:
+        """Registered breakers plus any lanes built after this
+        controller (worker wire, DCN peers) — pulled from the registry
+        so late-built lanes still show up in gauges and /debug."""
+        with self._lock:
+            found = list(self._breakers)
+        try:
+            lanes = self._r.breaker_lanes()
+        except Exception:
+            lanes = []
+        for br in lanes:
+            if br not in found:
+                found.append(br)
+        return found
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None or not self._ctl.enabled:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="keto-overload", daemon=True,
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=2.0)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.tick()
+            except Exception:  # pragma: no cover - belt and braces
+                log = self._logger()
+                if log is not None:
+                    log.exception("overload tick failed")
+
+    # -- signals + actuation -------------------------------------------------
+
+    def _signals(self) -> Dict[str, object]:
+        wait_p50 = None
+        waves = None
+        try:
+            ledger = self._r.wave_ledger()
+            stats = ledger.stats() if ledger is not None else {}
+            wait_p50 = stats.get("window_wait_ms_p50")
+            waves = stats.get("waves_recorded")
+        except Exception:
+            pass
+        burn = 0.0
+        try:
+            slo = self._r.slo()
+            if slo is not None:
+                # advance the ring first: the burn engine only folds new
+                # counter deltas on sample(), and the watchdog's 5s
+                # cadence is too coarse for a 500ms control loop
+                slo.sample()
+                burn = float(slo.max_burn("fast"))
+        except Exception:
+            pass
+        return {"wait_p50_ms": wait_p50, "fast_burn": round(burn, 4),
+                "waves_recorded": waves}
+
+    def tick(self, now: Optional[float] = None) -> Dict[str, object]:
+        """One control-loop step: sample signals, AIMD the limit, walk
+        the brownout ladder.  Returns the signal dict (tests assert on
+        it)."""
+        now = time.monotonic() if now is None else now
+        ctl = self._ctl
+        if not ctl.enabled:
+            return {}
+        sig = self._signals()
+        shed_now = ctl.shed
+        shed_delta = max(0, shed_now - self._last_shed)
+        self._last_shed = shed_now
+        cap_now = ctl.shed_capacity
+        cap_delta = max(0, cap_now - self._last_shed_cap)
+        self._last_shed_cap = cap_now
+        self._shed_rate = shed_delta / self.interval_s
+        inflight, limit = ctl.inflight, ctl.limit
+        burn = float(sig["fast_burn"])
+        wait = sig["wait_p50_ms"]
+        # the wave ledger's wait percentile is computed over its RING,
+        # which holds old waves forever: once admission stops (full
+        # shed), the signal freezes at its worst and would wedge both
+        # the AIMD limit and the ladder.  Only trust it while new waves
+        # actually landed since the last tick.
+        waves = sig.get("waves_recorded")
+        wait_fresh = True
+        if waves is not None:
+            wait_fresh = waves != self._last_waves
+            self._last_waves = waves
+        lat_bad = (wait_fresh and wait is not None
+                   and wait > self.target_wait_ms) \
+            or burn >= self.burn_enter
+
+        # AIMD: multiplicative shrink on latency inflation / burn,
+        # additive growth while constrained and healthy
+        if lat_bad:
+            new = max(self.floor, int(limit * self.decrease))
+        elif shed_delta > 0 or inflight >= max(1, int(limit * 0.8)):
+            new = min(self.ceiling, limit + self.increase)
+        else:
+            new = limit
+        if new != limit:
+            ctl.limit = new
+
+        # brownout ladder: escalate while burning AND organically
+        # shedding.  Only CAPACITY sheds (would not fit under the raw
+        # limit) count as pressure — class-cap refusals at an elevated
+        # stage are the ladder's own doing, and counting them would wedge
+        # full-shed forever: every probe it refuses would read as fresh
+        # overload.  Step down one stage per hold_s once capacity
+        # pressure stops and wave wait is back under target.  The SLO
+        # burn ring has minutes of memory, so it gates ENTRY only;
+        # requiring it to cool before stepping down would hold a
+        # brownout long after the storm ends.
+        stage = ctl.stage
+        wait_ok = (wait is None or not wait_fresh
+                   or wait <= self.target_wait_ms)
+        if burn >= self.burn_enter and cap_delta > 0:
+            self._calm_since = None
+            if stage < 3:
+                self._transition(stage, stage + 1, now, sig, cap_delta)
+        elif cap_delta == 0 and wait_ok:
+            if self._calm_since is None:
+                self._calm_since = now
+            elif now - self._calm_since >= self.hold_s and stage > 0:
+                self._transition(stage, stage - 1, now, sig, cap_delta)
+                self._calm_since = now  # re-arm: one step per hold_s
+        else:
+            self._calm_since = None
+
+        sig.update(shed_delta=shed_delta,
+                   shed_capacity_delta=cap_delta,
+                   shed_rate=round(self._shed_rate, 2),
+                   inflight=inflight, limit=ctl.limit, stage=ctl.stage)
+        self._last_signals = sig
+        m = self._metrics()
+        if m is not None:
+            m.gauge("keto_admission_limit", float(ctl.limit),
+                    help="current adaptive in-flight admission limit")
+            m.gauge("keto_overload_stage", float(ctl.stage),
+                    help="brownout ladder stage (0=normal .. 3=full shed)")
+        return sig
+
+    def _transition(self, old: int, new: int, now: float,
+                    sig: Dict[str, object], shed_delta: int) -> None:
+        self._ctl.stage = new
+        entry = {
+            "t": time.time(), "from": old, "to": new,
+            "from_name": STAGE_NAMES[old], "to_name": STAGE_NAMES[new],
+            "fast_burn": sig.get("fast_burn"),
+            "wait_p50_ms": sig.get("wait_p50_ms"),
+            "shed_delta": shed_delta,
+        }
+        self.transitions.append(entry)
+        direction = "up" if new > old else "down"
+        m = self._metrics()
+        if m is not None:
+            m.counter("keto_overload_transitions_total", 1.0,
+                      help="brownout ladder stage transitions",
+                      direction=direction)
+            m.gauge("keto_overload_stage", float(new),
+                    help="brownout ladder stage (0=normal .. 3=full shed)")
+        log = self._logger()
+        if log is not None:
+            log.warning(
+                "overload ladder %s: %s -> %s "
+                "(burn=%s wait_p50_ms=%s shed_delta=%d)",
+                direction, STAGE_NAMES[old], STAGE_NAMES[new],
+                sig.get("fast_burn"), sig.get("wait_p50_ms"), shed_delta,
+            )
+
+    def force_stage(self, stage: int, reason: str = "forced") -> None:
+        """Jump the ladder (operator/test override) with a logged edge."""
+        stage = max(0, min(3, int(stage)))
+        old = self._ctl.stage
+        if stage == old:
+            return
+        self._transition(old, stage, time.monotonic(),
+                         {"fast_burn": reason, "wait_p50_ms": None}, 0)
+
+    # -- cooperative retry hint ----------------------------------------------
+
+    def retry_after(self) -> int:
+        """Load-derived, jittered Retry-After seconds (integer >= 1).
+
+        Grows with ladder stage and recent shed rate; +-25% jitter keeps
+        a shed cohort from stampeding back in the same second.
+        """
+        base = 1.0 + 2.0 * self._ctl.stage + min(4.0, self._shed_rate / 50.0)
+        val = base * random.uniform(0.75, 1.25)
+        return max(1, min(self.retry_after_max_s, int(math.ceil(val))))
+
+    # -- introspection -------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, object]:
+        ctl = self._ctl.snapshot()
+        with self._lock:
+            breakers = [b.snapshot() for b in self._breakers]
+            budgets = [b.snapshot() for b in self._budgets]
+        return {
+            "stage": ctl["stage"],
+            "stage_name": ctl["stage_name"],
+            "admission": ctl,
+            "limits": {"floor": self.floor, "ceiling": self.ceiling,
+                       "increase": self.increase, "decrease": self.decrease,
+                       "target_wait_ms": self.target_wait_ms},
+            "signals": dict(self._last_signals),
+            "retry_after_hint": self.retry_after(),
+            "breakers": breakers,
+            "retry_budgets": budgets,
+            "transitions": list(self.transitions),
+        }

@@ -70,7 +70,7 @@ _ADMISSION_EXEMPT = {
     "/debug/flight-recorder", "/debug/waves", "/debug/compiles",
     "/debug/profile", "/debug/projection", "/debug/mesh",
     "/debug", "/debug/trace", "/debug/divergence", "/debug/handoff",
-    "/debug/slo", "/debug/fleet", "/debug/incidents",
+    "/debug/slo", "/debug/fleet", "/debug/incidents", "/debug/overload",
 }
 
 # REST paths that get the full stage decomposition (flightrec context);
@@ -282,8 +282,12 @@ class Router:
         except KetoAPIError as e:
             code = e.status_code or 500
             # shed responses carry the backoff hint the reference's
-            # rate-limit middlewares send
-            headers = {"Retry-After": "1"} if code == 429 else {}
+            # rate-limit middlewares send — load-derived + jittered so a
+            # shed cohort does not stampede back in lockstep
+            headers = (
+                {"Retry-After": self.r.retry_after_hint()}
+                if code in (429, 503) else {}
+            )
             return code, _error_body(code, str(e)), headers
         except Exception as e:  # noqa: BLE001 - the panic-recovery interceptor
             self.r.logger().exception("handler panic: %s", e)
@@ -993,6 +997,23 @@ def metrics_router(registry) -> Router:
     rt.add("GET", "/debug/incidents", get_incidents,
            describe="watchdog incidents: rule, detail, force-promoted "
                     "trace ids (newest first)")
+
+    def get_overload(req):
+        # overload-control plane (server/overload.py): ladder stage,
+        # adaptive admission limit + per-class caps, AIMD signal sample,
+        # breaker/retry-budget state and the recent transition log
+        ov = registry.overload()
+        if ov is None:
+            ctl = registry.admission()
+            return 200, {
+                "enabled": False,
+                "admission": ctl.snapshot() if ctl is not None else {},
+            }
+        return 200, {"enabled": True, **ov.snapshot()}
+
+    rt.add("GET", "/debug/overload", get_overload,
+           describe="overload plane: brownout stage, adaptive limit, "
+                    "class caps, breakers, transitions")
     return rt
 
 

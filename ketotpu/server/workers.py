@@ -153,6 +153,14 @@ class EngineHostServer:
                             break
                         meta, arrays, nread = got
                         host._wire_count("rx", nread)
+                        if faults.should("worker_error"):
+                            # chaos: the owner wedges mid-exchange — the
+                            # request dies with NO response frame, so the
+                            # worker sees a transport failure (the lane
+                            # fault the worker-wire breaker trips on, as
+                            # opposed to owner_handler's typed error
+                            # frame riding back on a healthy wire)
+                            break
                         try:
                             faults.inject("owner_handler")
                             resp, resp_arrays = host._serve_frame(
@@ -788,8 +796,24 @@ class RemoteCheckEngine:
     backoff_cap = 0.25
 
     def __init__(self, path: str, *, rpc_timeout: float = 30.0,
-                 cache=None, metrics=None, shm_threshold: int = 262144):
+                 cache=None, metrics=None, shm_threshold: int = 262144,
+                 breaker_config: Optional[dict] = None,
+                 retry_budget_ratio: float = 0.1, logger=None):
+        from ketotpu.server.overload import CircuitBreaker, RetryBudget
+
         self.path = path
+        # overload plane, worker-wire lane: the breaker fails calls fast
+        # while the owner is down (callers surface the same typed
+        # ConnectionError the retry loop would have, without the 5-attempt
+        # backoff burn); the retry budget caps reconnect attempts to a
+        # fraction of successes so a dead owner cannot multiply load
+        self.breaker = CircuitBreaker(
+            "worker_wire", metrics=metrics, logger=logger,
+            **(breaker_config or {}),
+        )
+        self.retry_budget = RetryBudget(
+            ratio=retry_budget_ratio, lane="worker_wire", metrics=metrics,
+        )
         # budget for calls with no request deadline: a wedged owner must
         # surface as an error, not hang every worker thread (<=0 disables)
         self.rpc_timeout = rpc_timeout
@@ -847,6 +871,13 @@ class RemoteCheckEngine:
             )
         t0 = time.perf_counter()
         try:
+            if not self.breaker.allow():
+                # lane is open: fail fast into the caller's degrade path
+                # instead of burning the full reconnect schedule — the
+                # half-open probe will test the owner on the cooldown
+                raise ConnectionError(
+                    "owner wire circuit breaker open; failing fast"
+                )
             last: Optional[BaseException] = None
             for attempt in range(self.retry_attempts):
                 try:
@@ -863,13 +894,19 @@ class RemoteCheckEngine:
                         spans = resp.pop("spans", None)
                         if spans:
                             flightrec.merge_spans(spans)
+                    self.breaker.record_success()
+                    self.retry_budget.record_success()
                     return resp, resp_arrays
                 except KetoAPIError:
+                    # a typed error is a COMPLETED exchange — the wire is
+                    # healthy even though the verdict is an error
+                    self.breaker.record_success()
                     raise
                 except TimeoutError:
                     # budget spent waiting on the owner: retrying cannot
                     # beat the deadline, answer DEADLINE_EXCEEDED now
                     self._discard()
+                    self.breaker.record_failure()
                     raise DeadlineExceededError(
                         f"owner RPC exceeded {timeout:.3f}s"
                     ) from None
@@ -878,7 +915,12 @@ class RemoteCheckEngine:
                     # desynced, the connection is already discarded
                     last = e
                     self._discard()
+                    self.breaker.record_failure()
                     if attempt + 1 >= self.retry_attempts:
+                        break
+                    if not self.retry_budget.allow_retry():
+                        # retry budget dry: reconnecting now would just
+                        # amplify the outage — fail fast instead
                         break
                     self.reconnects += 1
                     delay = min(
@@ -894,7 +936,7 @@ class RemoteCheckEngine:
                         delay = min(delay, left)
                     time.sleep(delay)
             raise ConnectionError(
-                f"owner RPC failed after {self.retry_attempts} attempts: {last}"
+                f"owner RPC failed after {attempt + 1} attempts: {last}"
             ) from last
         finally:
             flightrec.note_stage("worker_rpc", time.perf_counter() - t0)

@@ -49,6 +49,7 @@ RULES = (
     "device_ms_drift",
     "shadow_divergence",
     "burn_alarm",
+    "overload",
 )
 
 #: how many recent waves to mine for implicated traceparents when the
@@ -150,6 +151,7 @@ class Watchdog:
             self._rule_device_ms_drift,
             self._rule_shadow_divergence,
             self._rule_burn_alarm,
+            self._rule_overload,
         ):
             try:
                 inc = rule(t)
@@ -279,6 +281,33 @@ class Watchdog:
                 "fast_burn": round(burn, 4),
                 "threshold": self.burn_threshold,
                 "fast": slo.window_report(slo.fast_window_s),
+            },
+            trace_ids=self._recent_wave_traces(),
+        )
+
+    def _rule_overload(self, now: float) -> Optional[Dict]:
+        """Edge-triggered on the overload plane leaving stage 0: one
+        incident per brownout episode, cleared when the ladder returns
+        to normal."""
+        ov = self._r.overload()
+        if ov is None or ov.stage < 1:
+            self._active.discard("overload")
+            return None
+        if "overload" in self._active:
+            return None
+        self._active.add("overload")
+        snap = {}
+        try:
+            snap = ov.snapshot()
+        except Exception:  # noqa: BLE001
+            pass
+        return self._file(
+            "overload", now,
+            detail={
+                "stage": ov.stage,
+                "stage_name": snap.get("stage_name", ""),
+                "admission": snap.get("admission", {}),
+                "signals": snap.get("signals", {}),
             },
             trace_ids=self._recent_wave_traces(),
         )

@@ -257,7 +257,10 @@ class TestSharedDeadlineBudget:
 class TestWeightedAdmission:
     @pytest.fixture(scope="class")
     def tight_server(self):
-        srv = serve_all(_registry({"limit": {"max_inflight": 2}}))
+        # overload plane pinned off: this class drives a deterministic
+        # 2-unit budget, and the AIMD controller would grow it mid-test
+        srv = serve_all(_registry({"limit": {"max_inflight": 2},
+                                   "overload": {"enabled": False}}))
         yield srv
         srv.stop()
 
@@ -282,22 +285,24 @@ class TestWeightedAdmission:
         # occupy one unit (a concurrent request in flight) and retry:
         # the batch's weighted admission no longer fits and it sheds
         ctl = tight_server.registry.admission()
-        assert ctl.try_acquire()
+        token = ctl.try_acquire()
+        assert token
         try:
             status, body, headers = _post_json(
                 f"{read}/relation-tuples/batch/check", payload
             )
             assert status == 429, body
-            assert headers.get("Retry-After") == "1"
+            assert int(headers.get("Retry-After")) >= 1
         finally:
-            ctl.release()
+            ctl.release(token)
         # the limiter was never wedged by the shed: singles still run
         assert _singles(read, CASES[:1]) == [True]
 
     def test_shed_counter_carries_batch_transport(self, tight_server):
         metrics = "http://%s:%d" % tuple(tight_server.addresses["metrics"])
         _, text, _ = _http("GET", f"{metrics}/metrics/prometheus")
-        assert 'keto_requests_shed_total{transport="batch"}' in text
+        assert ('keto_requests_shed_total'
+                '{klass="batch",transport="batch"}') in text
 
 
 class TestKeepAlivePipelining:

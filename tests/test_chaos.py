@@ -304,8 +304,13 @@ class TestCoalescerDeadline:
         from ketotpu.engine.coalesce import CoalescingEngine
 
         inner = _BlockingEngine()
+        # pipeline=False: with double-buffering on, the collector cuts the
+        # filler slots into a staged wave (emptying _pending) before the
+        # shed probe runs, so the probe queues and times out instead of
+        # shedding.  The single-threaded path keeps the backlog observable
+        # while the worker is wedged inside the inner engine.
         eng = CoalescingEngine(inner, window=0.001, max_pending=2,
-                               default_timeout=10.0)
+                               default_timeout=10.0, pipeline=False)
         threads = []
         try:
             # occupy the wave worker inside the blocked inner engine
@@ -653,13 +658,16 @@ class TestAdmissionE2E:
         self, chaos_server, read_addr, metrics_addr
     ):
         ctl = chaos_server.registry.admission()
-        ctl.inflight = ctl.limit  # saturate: next arrival is shed
+        # saturate far past any value the AIMD controller could grow the
+        # limit to mid-test: next arrival is shed
+        ctl.inflight = 10**9
         try:
             status, body, headers = _http(
                 "GET", _check_url(read_addr, CASES[0][0])
             )
             assert status == 429, body
-            assert headers.get("Retry-After") == "1"
+            # load-derived hint: a positive integer, jittered per response
+            assert int(headers.get("Retry-After")) >= 1
             assert json.loads(body)["error"]["code"] == 429
             # health stays exempt so probes see through the shed
             astatus, _, _ = _http("GET", f"{read_addr}/health/alive")
@@ -687,11 +695,14 @@ class TestAdmissionE2E:
                 tuple=tuple_to_proto(RelationTuple.from_string(CASES[0][0]))
             )
             assert stub.Check(req).allowed is True  # channel warm
-            ctl.inflight = ctl.limit
+            ctl.inflight = 10**9
             try:
                 with pytest.raises(grpc.RpcError) as ei:
                     stub.Check(req)
                 assert ei.value.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+                # cooperative retry hint rides the trailing metadata
+                trailing = dict(ei.value.trailing_metadata() or ())
+                assert int(trailing.get("retry-after", "0")) >= 1
                 # health service is exempt: probes still answered
                 health = _stub_class("grpc.health.v1.Health")(ch)
                 resp = health.Check(health_pb2.HealthCheckRequest())
@@ -1354,3 +1365,258 @@ class TestMeshHostKillStorm:
             faults.reset()
             for e in engs:
                 e.close()
+
+
+# -- ISSUE 17: overload control -----------------------------------------------
+
+
+class TestWorkerWireBreaker:
+    """The worker-wire circuit breaker: injected owner wedges (no
+    response frame = transport failure) trip the lane open, open means
+    fail-FAST instead of burning the reconnect schedule, and the
+    half-open probe closes it the moment the owner answers again."""
+
+    def _remote(self, sock):
+        from ketotpu.server.workers import RemoteCheckEngine
+
+        return RemoteCheckEngine(sock, breaker_config={
+            "window_s": 10.0, "min_volume": 4,
+            "failure_ratio": 0.5, "cooldown_s": 0.3,
+        })
+
+    def test_breaker_trips_fails_fast_and_recovers(self, tmp_path):
+        from ketotpu.server.workers import EngineHostServer
+
+        owner = _oracle_host(tmp_path, "breaker")
+        sock = str(tmp_path / "breaker.sock")
+        host = EngineHostServer(owner, sock).start()
+        q = RelationTuple.from_string("Folder:keto#view@bob")
+        try:
+            remote = self._remote(sock)
+            assert remote.check(q) is True  # healthy wire, warm pool
+            assert remote.breaker.state == "closed"
+
+            # owner wedges: every exchange dies with no response frame
+            faults.configure(worker_error_rate=1.0, seed=3)
+            with pytest.raises(ConnectionError):
+                remote.check(q)
+            assert remote.breaker.state == "open"
+            assert remote.breaker.trips == 1
+
+            # open = fail fast: no connect, no backoff burn
+            t0 = time.monotonic()
+            with pytest.raises(ConnectionError) as ei:
+                remote.check(q)
+            assert time.monotonic() - t0 < 0.1
+            assert "circuit breaker open" in str(ei.value)
+
+            # owner heals; past the cooldown one probe closes the lane
+            faults.reset()
+            time.sleep(0.35)
+            assert remote.check(q) is True
+            assert remote.breaker.state == "closed"
+            # and it stays closed for ordinary traffic
+            assert all(remote.check(q) for _ in range(4))
+        finally:
+            faults.reset()
+            host.stop()
+
+    def test_typed_errors_never_trip_the_breaker(self, tmp_path):
+        from ketotpu.server.workers import EngineHostServer
+
+        owner = _oracle_host(tmp_path, "typedbrk")
+        sock = str(tmp_path / "typedbrk.sock")
+        host = EngineHostServer(owner, sock).start()
+        try:
+            remote = self._remote(sock)
+            # typed errors are COMPLETED exchanges on a healthy wire:
+            # a burst of client errors must not open the lane
+            for _ in range(8):
+                with pytest.raises(KetoAPIError):
+                    remote.check(
+                        RelationTuple.from_string("Folder:f#nosuch@a")
+                    )
+            assert remote.breaker.state == "closed"
+            assert remote.breaker.trips == 0
+        finally:
+            host.stop()
+
+
+@pytest.mark.slow
+class TestOverloadStorm:
+    """The ISSUE 17 acceptance storm: a sustained 2x-capacity mixed
+    flood with misbehaving clients (retry-storm fault: the SDK ignores
+    Retry-After and its retry budget).  The plane must shed batch before
+    interactive, keep answering interactive checks throughout, escalate
+    the brownout ladder, give exact verdicts on everything it admits
+    (zero shadow divergence), and converge back to normal service once
+    the flood stops."""
+
+    def test_two_x_flood_sheds_batch_first_and_converges(self):
+        from ketotpu.sdk import KetoClient
+        from ketotpu.server.admission import CLASS_BATCH, CLASS_INTERACTIVE
+
+        cfg = Provider({
+            "serve": {
+                n: {"host": "127.0.0.1", "port": 0}
+                for n in ("read", "write", "metrics", "opl")
+            },
+            "namespaces": {
+                "location": str(FIXTURES / "rewrites_namespaces.keto.ts")
+            },
+            "engine": {"kind": "tpu", "frontier": 512, "arena": 2048,
+                       "max_batch": 128},
+            # a deliberately small serving capacity so a laptop-sized
+            # flood is genuinely 2x+: the AIMD limit lives in [4, 16]
+            "limit": {"max_inflight": 8, "request_timeout_ms": 10000},
+            "observability": {"shadow": {"sample_rate": 1}},
+            "overload": {"floor": 4, "ceiling": 16, "increase": 4,
+                         "interval_ms": 100, "hold_ms": 400},
+            "log": {"request_log": False},
+        })
+        reg = Registry(cfg).init()
+        srv = serve_all(reg)
+        reg.store().write_relation_tuples(
+            *[RelationTuple.from_string(s) for s in SEED_TUPLES]
+        )
+        read = "http://%s:%d" % tuple(srv.addresses["read"])
+        try:
+            # warm: absorb first-shape compiles before offering load.
+            # a cold compile can outlive the 10s request budget (the
+            # waiting caller gets 504 while the wave finishes compiling
+            # on the worker), so retry until the cache is hot
+            status, body = 0, b""
+            for _ in range(6):
+                status, body, _ = _http(
+                    "GET", _check_url(read, CASES[0][0]), timeout=20.0
+                )
+                if status == 200:
+                    break
+            assert status == 200, body
+            _post_batch = lambda: _http(
+                "POST", f"{read}/relation-tuples/batch/check",
+                json.dumps({"tuples": [
+                    RelationTuple.from_string(c).to_json()
+                    for c, _ in CASES[:4] * 2
+                ]}).encode(),
+                {"Content-Type": "application/json"}, timeout=20.0,
+            )
+            for _ in range(6):
+                status, _, _ = _post_batch()
+                if status == 200:
+                    break
+            assert status == 200
+
+            # misbehaving clients: retries ignore the budget + hint
+            faults.configure(retry_storm_rate=1.0, seed=17)
+            stop_at = time.monotonic() + 3.0
+            lock = threading.Lock()
+            inter = {"ok": 0, "shed": 0, "wrong": 0, "hung": 0}
+            batch = {"ok": 0, "shed": 0, "hung": 0}
+
+            def interactive_client(i):
+                cli = KetoClient(read, max_retries=2, timeout=20.0)
+                j = 0
+                while time.monotonic() < stop_at:
+                    case, want = CASES[(i + j) % len(CASES)]
+                    j += 1
+                    t = RelationTuple.from_string(case)
+                    try:
+                        got = cli.check_tuple(t)
+                        with lock:
+                            if got is want:
+                                inter["ok"] += 1
+                            else:
+                                inter["wrong"] += 1
+                    except Exception as e:  # noqa: BLE001
+                        name = type(e).__name__
+                        with lock:
+                            if "429" in str(e) or "503" in str(e):
+                                inter["shed"] += 1
+                            elif name in ("SDKError",):
+                                inter["shed"] += 1
+                            else:
+                                inter["hung"] += 1
+
+            def batch_client():
+                while time.monotonic() < stop_at:
+                    try:
+                        status, _, _ = _post_batch()
+                        with lock:
+                            if status == 200:
+                                batch["ok"] += 1
+                            elif status in (429, 503):
+                                batch["shed"] += 1
+                            else:
+                                batch["hung"] += 1
+                    except Exception:  # noqa: BLE001
+                        with lock:
+                            batch["hung"] += 1
+
+            threads = [
+                threading.Thread(
+                    target=interactive_client, args=(i,), daemon=True)
+                for i in range(12)
+            ] + [
+                threading.Thread(target=batch_client, daemon=True)
+                for _ in range(8)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60.0)
+            assert not any(t.is_alive() for t in threads), "storm wedged"
+            faults.reset()
+
+            ctl = reg.admission()
+            ov = reg.overload()
+            # the flood actually overloaded the plane...
+            assert ctl.shed > 0, "storm never hit capacity"
+            # ...and every admitted verdict was exact
+            assert inter["wrong"] == 0
+            assert inter["hung"] == 0 and batch["hung"] == 0
+            # interactive goodput survived the whole storm
+            assert inter["ok"] > 0, (inter, batch)
+            # shed ordering: batch sheds, interactive keeps landing —
+            # proportionally batch must shed at least as hard
+            shed_by = ctl.shed_by_class
+            assert shed_by[CLASS_BATCH] > 0, (shed_by, batch)
+            inter_tries = inter["ok"] + inter["shed"]
+            batch_tries = batch["ok"] + batch["shed"]
+            if inter_tries and batch_tries:
+                assert (batch["shed"] / batch_tries
+                        >= inter["shed"] / inter_tries - 0.05), (
+                    inter, batch)
+            # the storm was observable: limit + stage published
+            m = reg.metrics()
+            assert m.get_gauge("keto_admission_limit") >= 1.0
+            assert m.counter_total("keto_requests_shed_total") > 0
+
+            # convergence: flood gone, ladder steps down (hold 400ms per
+            # stage), interactive flows again without client retries
+            deadline_at = time.monotonic() + 15.0
+            cli = KetoClient(read, max_retries=0, timeout=10.0)
+            last = None
+            while time.monotonic() < deadline_at:
+                try:
+                    assert cli.check_tuple(
+                        RelationTuple.from_string(CASES[0][0])
+                    ) is CASES[0][1]
+                    last = "ok"
+                    break
+                except Exception as e:  # noqa: BLE001
+                    last = e
+                    time.sleep(0.2)
+            assert last == "ok", f"storm never converged: {last}"
+            assert ov is not None and ov.stage <= 1
+
+            # zero divergence: the shadow plane scored the admitted
+            # checks and found nothing
+            sh = reg.shadow()
+            assert sh is not None
+            assert sh.drain(timeout=120.0), "shadow queue never drained"
+            assert sh.stats()["divergences"] == 0, sh.ledger()
+            assert m.get_counter("keto_shadow_divergence_total") == 0
+        finally:
+            faults.reset()
+            srv.stop()

@@ -1,0 +1,639 @@
+"""Adaptive overload control (ISSUE 17): priority-class admission,
+the AIMD limit controller, the brownout ladder, cooperative retry
+budgets, lane circuit breakers, and the admission-exempt surfaces that
+must keep answering at full shed.
+
+Unit layers drive every state machine deterministically (injected
+clocks, direct tick() calls); the e2e class forces the ladder on a live
+server and proves operators keep their eyes while everything else sheds.
+"""
+
+import json
+import os
+import pathlib
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from ketotpu import faults
+from ketotpu.api.types import RelationTuple
+from ketotpu.driver import Provider, Registry
+from ketotpu.observability import Metrics
+from ketotpu.server import serve_all
+from ketotpu.server.admission import (
+    CLASS_BACKGROUND,
+    CLASS_BATCH,
+    CLASS_BULK,
+    CLASS_INTERACTIVE,
+    AdmissionController,
+)
+from ketotpu.server.overload import (
+    BREAKER_CLOSED,
+    BREAKER_HALF_OPEN,
+    BREAKER_OPEN,
+    CircuitBreaker,
+    OverloadController,
+    RetryBudget,
+    classify_grpc_op,
+    classify_rest_path,
+)
+
+FIXTURES = pathlib.Path(__file__).parent / "fixtures"
+
+
+def _http(method, url, body=None, headers=None, timeout=30.0):
+    req = urllib.request.Request(
+        url, data=body, method=method, headers=headers or {}
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read().decode(), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode(), dict(e.headers)
+
+
+# -- admission tokens + priority classes --------------------------------------
+
+
+class TestAdmissionTokens:
+    def test_release_returns_exact_token_across_limit_shrink(self):
+        """The satellite fix: a weight granted under one limit must come
+        back whole even after the AIMD controller shrank the limit
+        mid-flight — re-clamping on release would leak budget forever."""
+        ctl = AdmissionController(8)
+        token = ctl.try_acquire(8, klass=CLASS_BATCH)
+        assert token == 8 and ctl.inflight == 8
+        ctl.limit = 2  # controller shrank the limit mid-flight
+        ctl.release(token)
+        assert ctl.inflight == 0  # not 6: the full grant came back
+
+    def test_oversized_weight_clamps_to_budget(self):
+        ctl = AdmissionController(4)
+        token = ctl.try_acquire(100, klass=CLASS_BATCH)
+        assert token == 4  # clamped: runs alone against the whole budget
+        ctl.release(token)
+        assert ctl.inflight == 0
+
+    def test_zero_limit_disables_without_lock(self):
+        ctl = AdmissionController(0)
+        assert not ctl.enabled
+        assert ctl.try_acquire(7, klass=CLASS_BATCH) == 7
+        ctl.release(7)
+        assert ctl.inflight == 0 and ctl.shed == 0
+
+    def test_stage0_class_caps_leave_interactive_headroom(self):
+        ctl = AdmissionController(100)
+        assert ctl.class_cap(CLASS_INTERACTIVE) == 100
+        assert ctl.class_cap(CLASS_BULK) == 95
+        assert ctl.class_cap(CLASS_BATCH) == 90
+        assert ctl.class_cap(CLASS_BACKGROUND) == 85
+
+    def test_tiny_limits_keep_full_budget_at_stage0(self):
+        # ceil keeps a 2-unit test budget honest: fractions only bite
+        # once the headroom is a whole unit
+        ctl = AdmissionController(2)
+        for klass in (CLASS_INTERACTIVE, CLASS_BULK,
+                      CLASS_BATCH, CLASS_BACKGROUND):
+            assert ctl.class_cap(klass) == 2
+
+    def test_capacity_vs_policy_shed_classification(self):
+        ctl = AdmissionController(10)
+        ctl.stage = 1  # batch cap is 0 here
+        # batch refused with the limit wide open: the STAGE refused it,
+        # a policy shed — the ladder must not read it as fresh pressure
+        assert ctl.try_acquire(klass=CLASS_BATCH) == 0
+        assert ctl.shed == 1 and ctl.shed_capacity == 0
+        # interactive refused because the limit itself is full: organic
+        ctl.inflight = 10
+        assert ctl.try_acquire(klass=CLASS_INTERACTIVE) == 0
+        assert ctl.shed == 2 and ctl.shed_capacity == 1
+
+    def test_oversize_batch_admitted_alone(self):
+        # a batch wider than the whole budget clamps to the batch class
+        # cap and runs alone on an idle server — it must never be
+        # unservable by construction (seed behaviour, kept under caps)
+        ctl = AdmissionController(64)
+        cap = ctl.class_cap(CLASS_BATCH)
+        assert 0 < cap < 64 + 1
+        token = ctl.try_acquire(1024, klass=CLASS_BATCH)
+        assert token == cap
+        assert ctl.inflight == cap
+        # lane saturated: a second oversize batch is refused...
+        assert ctl.try_acquire(1024, klass=CLASS_BATCH) == 0
+        ctl.release(token)
+        # ...and admissible again once the first one drains
+        assert ctl.try_acquire(1024, klass=CLASS_BATCH) == cap
+
+    def test_batch_sheds_first_interactive_last(self):
+        ctl = AdmissionController(100)
+        ctl.stage = 1  # brownout-1: batch/background out, bulk halved
+        assert ctl.class_cap(CLASS_BATCH) == 0
+        assert ctl.class_cap(CLASS_BACKGROUND) == 0
+        assert ctl.class_cap(CLASS_BULK) == 50
+        assert ctl.class_cap(CLASS_INTERACTIVE) == 100
+        assert ctl.try_acquire(1, klass=CLASS_BATCH) == 0
+        assert ctl.try_acquire(1, klass=CLASS_INTERACTIVE) == 1
+        ctl.stage = 2  # interactive-only
+        assert ctl.class_cap(CLASS_BULK) == 0
+        assert ctl.try_acquire(1, klass=CLASS_BULK) == 0
+        assert ctl.try_acquire(1, klass=CLASS_INTERACTIVE) == 1
+        ctl.stage = 3  # full shed
+        assert ctl.try_acquire(1, klass=CLASS_INTERACTIVE) == 0
+        assert ctl.shed_by_class[CLASS_BATCH] == 1
+        assert ctl.shed_by_class[CLASS_BULK] == 1
+        assert ctl.shed_by_class[CLASS_INTERACTIVE] == 1
+
+    def test_snapshot_carries_stage_vocabulary(self):
+        ctl = AdmissionController(10)
+        ctl.stage = 1
+        snap = ctl.snapshot()
+        assert snap["stage_name"] == "brownout-1"
+        assert snap["class_caps"][CLASS_BATCH] == 0
+        assert set(snap["shed_by_class"]) == {
+            CLASS_INTERACTIVE, CLASS_BULK, CLASS_BATCH, CLASS_BACKGROUND,
+        }
+
+
+class TestClassification:
+    @pytest.mark.parametrize("path,klass", [
+        ("/relation-tuples/check", CLASS_INTERACTIVE),
+        ("/relation-tuples/check/openapi", CLASS_INTERACTIVE),
+        ("/relation-tuples/batch/check", CLASS_BATCH),
+        ("/relation-tuples/check/batch", CLASS_BATCH),
+        ("/relation-tuples/batch/expand", CLASS_BATCH),
+        ("/relation-tuples/expand", CLASS_BULK),
+        ("/relation-tuples/list-objects", CLASS_BULK),
+        ("/relation-tuples/list-subjects", CLASS_BULK),
+        ("/relation-tuples/watch", CLASS_BACKGROUND),
+        ("/admin/relation-tuples", CLASS_BULK),  # unlisted -> bulk
+    ])
+    def test_rest_paths(self, path, klass):
+        assert classify_rest_path(path) == klass
+
+    @pytest.mark.parametrize("op,klass", [
+        ("check", CLASS_INTERACTIVE),
+        ("batchcheck", CLASS_BATCH),
+        ("batchexpand", CLASS_BATCH),
+        ("expand", CLASS_BULK),
+        ("listrelationtuples", CLASS_BULK),
+        ("watch", CLASS_BACKGROUND),
+        ("bootstrap", CLASS_BACKGROUND),
+    ])
+    def test_grpc_ops(self, op, klass):
+        assert classify_grpc_op(op) == klass
+
+
+# -- retry budget -------------------------------------------------------------
+
+
+class TestRetryBudget:
+    def test_runs_dry_after_burst_and_counts_exhaustion(self):
+        m = Metrics()
+        budget = RetryBudget(ratio=0.1, burst=3.0, lane="sdk", metrics=m)
+        assert [budget.allow_retry() for _ in range(3)] == [True] * 3
+        assert budget.allow_retry() is False  # dry: retries stop
+        assert budget.exhausted == 1
+        assert m.get_counter(
+            "keto_retry_budget_exhausted_total", lane="sdk") == 1.0
+
+    def test_successes_slowly_refill(self):
+        budget = RetryBudget(ratio=0.5, burst=2.0)
+        for _ in range(4):
+            budget.allow_retry()
+        assert budget.allow_retry() is False
+        budget.record_success()
+        budget.record_success()  # two successes = one whole token
+        assert budget.allow_retry() is True
+        assert budget.allow_retry() is False
+
+    def test_refill_caps_at_burst(self):
+        budget = RetryBudget(ratio=1.0, burst=2.0)
+        for _ in range(50):
+            budget.record_success()
+        assert budget.snapshot()["tokens"] == 2.0
+
+
+# -- circuit breaker ----------------------------------------------------------
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+class TestCircuitBreaker:
+    def _breaker(self, **kw):
+        clock = _Clock()
+        m = Metrics()
+        kw.setdefault("window_s", 10.0)
+        kw.setdefault("min_volume", 4)
+        kw.setdefault("failure_ratio", 0.5)
+        kw.setdefault("cooldown_s", 2.0)
+        return CircuitBreaker("testlane", metrics=m, clock=clock, **kw), \
+            clock, m
+
+    def test_stays_closed_below_min_volume(self):
+        br, _, _ = self._breaker()
+        for _ in range(3):
+            assert br.allow()
+            br.record_failure()
+        assert br.state == BREAKER_CLOSED
+
+    def test_trips_open_and_fails_fast(self):
+        br, _, m = self._breaker()
+        for _ in range(4):
+            br.record_failure()
+        assert br.state == BREAKER_OPEN
+        assert br.trips == 1
+        assert not br.allow()  # fail fast inside the cooldown
+        assert m.get_counter(
+            "keto_breaker_trips_total", lane="testlane") == 1.0
+        assert m.get_gauge("keto_breaker_state", lane="testlane") == 1.0
+
+    def test_successes_dilute_below_ratio(self):
+        br, _, _ = self._breaker()
+        for _ in range(5):
+            br.record_success()
+        for _ in range(4):
+            br.record_failure()
+        assert br.state == BREAKER_CLOSED  # 4/9 < 0.5
+
+    def test_half_open_probe_success_closes(self):
+        br, clock, m = self._breaker()
+        for _ in range(4):
+            br.record_failure()
+        clock.t += 2.5  # past the cooldown
+        assert br.allow()  # the single half-open probe
+        assert br.state == BREAKER_HALF_OPEN
+        assert not br.allow()  # second caller still fails fast
+        br.record_success()
+        assert br.state == BREAKER_CLOSED
+        assert br.allow()
+        assert m.get_gauge("keto_breaker_state", lane="testlane") == 0.0
+        # recovery cleared the window: one stale failure cannot re-trip
+        br.record_failure()
+        assert br.state == BREAKER_CLOSED
+
+    def test_half_open_probe_failure_reopens(self):
+        br, clock, _ = self._breaker()
+        for _ in range(4):
+            br.record_failure()
+        clock.t += 2.5
+        assert br.allow()
+        br.record_failure()  # probe failed
+        assert br.state == BREAKER_OPEN
+        assert not br.allow()  # fresh cooldown from the failed probe
+        clock.t += 2.5
+        assert br.allow()  # next probe window opens again
+
+    def test_window_prunes_old_failures(self):
+        br, clock, _ = self._breaker()
+        for _ in range(3):
+            br.record_failure()
+        clock.t += 60.0  # failures age out of the 10s window
+        br.record_failure()
+        assert br.state == BREAKER_CLOSED  # volume 1 < min_volume
+
+
+# -- the overload controller --------------------------------------------------
+
+
+class _FakeLedger:
+    def __init__(self):
+        self.wait_p50 = 1.0
+
+    def stats(self):
+        return {"window_wait_ms_p50": self.wait_p50}
+
+
+class _FakeSLO:
+    def __init__(self):
+        self.burn = 0.0
+        self.samples = 0
+
+    def sample(self):
+        self.samples += 1
+
+    def max_burn(self, window):
+        return self.burn
+
+
+class _FakeRegistry:
+    def __init__(self):
+        self._metrics = Metrics()
+        self.ledger = _FakeLedger()
+        self.slo_ = _FakeSLO()
+
+    def metrics(self):
+        return self._metrics
+
+    def wave_ledger(self):
+        return self.ledger
+
+    def slo(self):
+        return self.slo_
+
+    def breaker_lanes(self):
+        return []
+
+    def logger(self):
+        return None
+
+
+class TestOverloadController:
+    def _controller(self, limit=100, **kw):
+        reg = _FakeRegistry()
+        ctl = AdmissionController(limit)
+        kw.setdefault("floor", 10)
+        kw.setdefault("ceiling", 200)
+        kw.setdefault("increase", 20)
+        kw.setdefault("decrease", 0.5)
+        kw.setdefault("target_wait_ms", 25.0)
+        kw.setdefault("interval_s", 0.5)
+        kw.setdefault("hold_s", 10.0)
+        ov = OverloadController(reg, ctl, **kw)
+        return ov, ctl, reg
+
+    def test_additive_growth_under_admission_pressure(self):
+        ov, ctl, _ = self._controller()
+        ctl.inflight = 90  # >= 0.8 * limit: constrained but healthy
+        ov.tick(now=0.0)
+        assert ctl.limit == 120
+        ctl.inflight = 0  # idle and healthy: the limit holds steady
+        ov.tick(now=0.5)
+        assert ctl.limit == 120
+
+    def test_growth_clamps_at_ceiling(self):
+        ov, ctl, _ = self._controller(limit=195)
+        ctl.inflight = 195
+        ov.tick(now=0.0)
+        assert ctl.limit == 200
+
+    def test_multiplicative_shrink_on_latency_inflation(self):
+        ov, ctl, reg = self._controller()
+        reg.ledger.wait_p50 = 80.0  # > target 25ms
+        ov.tick(now=0.0)
+        assert ctl.limit == 50
+        ov.tick(now=0.5)
+        assert ctl.limit == 25
+        for i in range(10):  # shrink floors out, never reaches 0
+            ov.tick(now=1.0 + i)
+        assert ctl.limit == 10
+
+    def test_burn_alone_shrinks_without_wait_signal(self):
+        ov, ctl, reg = self._controller()
+        reg.ledger.wait_p50 = None  # no waves yet (cold engine)
+        reg.slo_.burn = 5.0
+        ov.tick(now=0.0)
+        assert ctl.limit == 50
+
+    def test_shed_pressure_grows_the_limit(self):
+        ov, ctl, _ = self._controller()
+        ctl.shed = 40  # sheds since the last tick
+        ov.tick(now=0.0)
+        assert ctl.limit == 120
+
+    def test_ladder_escalates_one_stage_per_tick_and_steps_down(self):
+        ov, ctl, reg = self._controller()
+        reg.slo_.burn = 5.0
+        ctl.shed = ctl.shed_capacity = 10
+        ov.tick(now=0.0)
+        assert ctl.stage == 1
+        ctl.shed = ctl.shed_capacity = 20
+        ov.tick(now=0.5)
+        assert ctl.stage == 2
+        # capacity sheds stop (brownout worked): calm starts even though
+        # burn is still hot — the ring has minutes of memory and gates
+        # escalation only
+        reg.slo_.burn = 5.0
+        ov.tick(now=1.0)
+        assert ctl.stage == 2
+        reg.slo_.burn = 0.5
+        ov.tick(now=2.0)  # still inside the hold window
+        assert ctl.stage == 2
+        ov.tick(now=13.0)  # > hold_s of calm
+        assert ctl.stage == 1
+        ov.tick(now=14.0)  # calm re-armed: not another instant drop
+        assert ctl.stage == 1
+        ov.tick(now=24.0)
+        assert ctl.stage == 0
+
+    def test_policy_sheds_do_not_wedge_the_ladder(self):
+        ov, ctl, reg = self._controller()
+        reg.slo_.burn = 5.0
+        ctl.shed = ctl.shed_capacity = 10
+        ov.tick(now=0.0)
+        assert ctl.stage == 1
+        # probes refused by the stage's class caps are POLICY sheds:
+        # total grows, capacity does not — calm accrues despite hot burn
+        # (the ring remembers the storm for minutes) and the ladder
+        # steps down instead of wedging on its own refusals
+        ctl.shed = 30
+        ov.tick(now=1.0)
+        assert ctl.stage == 1
+        ctl.shed = 50
+        ov.tick(now=12.0)
+        assert ctl.stage == 0
+
+    def test_transitions_are_counted_and_logged(self):
+        ov, ctl, reg = self._controller()
+        m = reg.metrics()
+        assert m.get_counter(
+            "keto_overload_transitions_total", direction="up") == 0.0
+        reg.slo_.burn = 5.0
+        ctl.shed = ctl.shed_capacity = 10
+        ov.tick(now=0.0)
+        assert m.get_counter(
+            "keto_overload_transitions_total", direction="up") == 1.0
+        assert m.get_gauge("keto_overload_stage") == 1.0
+        entry = list(ov.transitions)[-1]
+        assert (entry["from"], entry["to"]) == (0, 1)
+        assert entry["to_name"] == "brownout-1"
+
+    def test_tick_publishes_limit_gauge(self):
+        ov, ctl, reg = self._controller()
+        ov.tick(now=0.0)
+        assert reg.metrics().get_gauge("keto_admission_limit") == 100.0
+
+    def test_force_stage_jumps_with_edges(self):
+        ov, ctl, _ = self._controller()
+        ov.force_stage(3, reason="drill")
+        assert ctl.stage == 3 and ov.stage_name == "full-shed"
+        ov.force_stage(3)  # idempotent: no duplicate edge
+        assert len(ov.transitions) == 1
+        ov.force_stage(0)
+        assert ctl.stage == 0
+        assert len(ov.transitions) == 2
+
+    def test_retry_after_grows_with_stage_and_stays_bounded(self):
+        ov, ctl, _ = self._controller(retry_after_max_s=30)
+        hints0 = {ov.retry_after() for _ in range(64)}
+        assert all(1 <= h <= 2 for h in hints0)  # stage 0, no sheds
+        ov.force_stage(3)
+        hints3 = {ov.retry_after() for _ in range(64)}
+        assert all(6 <= h <= 9 for h in hints3)  # base 7 +- 25% jitter
+        assert min(hints3) > max(hints0)  # deeper brownout = back off more
+
+    def test_disabled_admission_means_no_actuation(self):
+        ov, ctl, _ = self._controller(limit=0)
+        assert ov.tick(now=0.0) == {}
+        assert ctl.limit == 0
+
+
+# -- fault knobs --------------------------------------------------------------
+
+
+class TestOverloadFaultKnobs:
+    @pytest.fixture(autouse=True)
+    def _clean(self, monkeypatch):
+        for k in list(os.environ):
+            if k.startswith("KETO_FAULT_"):
+                monkeypatch.delenv(k)
+        faults.reset()
+        yield
+        faults.reset()
+
+    def test_env_knobs_parse(self, monkeypatch):
+        monkeypatch.setenv("KETO_FAULT_RETRY_STORM", "1.0")
+        monkeypatch.setenv("KETO_FAULT_WORKER_ERROR_RATE", "0.25")
+        faults.reset()
+        p = faults.plan()
+        assert p.retry_storm_rate == 1.0
+        assert p.worker_error_rate == 0.25
+        assert p.active
+        assert faults.should("retry_storm")
+
+    def test_config_knobs_parse(self):
+        cfg = Provider({"faults": {"retry_storm_rate": 0.5,
+                                   "worker_error_rate": 0.5}})
+        faults.configure_from_config(cfg)
+        assert faults.plan().retry_storm_rate == 0.5
+        assert faults.plan().worker_error_rate == 0.5
+
+    def test_inert_by_default(self):
+        assert not faults.should("retry_storm")
+        assert not faults.should("worker_error")
+
+
+# -- e2e: exempt surfaces at full shed ----------------------------------------
+
+
+@pytest.fixture(scope="module")
+def overload_server():
+    cfg = Provider({
+        "serve": {
+            n: {"host": "127.0.0.1", "port": 0}
+            for n in ("read", "write", "metrics", "opl")
+        },
+        "namespaces": {
+            "location": str(FIXTURES / "rewrites_namespaces.keto.ts")
+        },
+        "engine": {"kind": "tpu", "frontier": 512, "arena": 2048,
+                   "max_batch": 128},
+        # hold_ms pinned huge so the background controller cannot
+        # de-escalate a forced stage mid-test
+        "overload": {"hold_ms": 3_600_000},
+    })
+    reg = Registry(cfg).init()
+    srv = serve_all(reg)
+    reg.store().write_relation_tuples(
+        RelationTuple.from_string("Group:dev#members@bob"),
+    )
+    yield srv
+    srv.stop()
+
+
+class TestExemptSurfacesAtFullShed:
+    def test_debug_and_probe_surfaces_answer_through_full_shed(
+        self, overload_server
+    ):
+        reg = overload_server.registry
+        ov = reg.overload()
+        assert ov is not None
+        metrics = "http://%s:%d" % tuple(overload_server.addresses["metrics"])
+        read = "http://%s:%d" % tuple(overload_server.addresses["read"])
+        # the /debug index enumerates every routed surface: the sweep is
+        # generated, so a new debug route cannot dodge this test
+        _, body, _ = _http("GET", f"{metrics}/debug")
+        surfaces = json.loads(body)["surfaces"]
+        assert "/debug/overload" in surfaces
+        ov.force_stage(3, reason="test: full shed drill")
+        try:
+            # non-exempt traffic sheds: full shed refuses even interactive
+            q = urllib.parse.urlencode(
+                RelationTuple.from_string(
+                    "Group:dev#members@bob").to_url_query())
+            status, _, headers = _http(
+                "GET", f"{read}/relation-tuples/check/openapi?{q}")
+            assert status == 429
+            assert int(headers.get("Retry-After")) >= 1
+            # ...while every probe and debug surface still answers
+            for path in ("/health/alive", "/health/ready", "/version",
+                         "/metrics/prometheus"):
+                status, _, _ = _http("GET", f"{metrics}{path}")
+                assert status == 200, f"{path} must bypass admission"
+                status, _, _ = _http("GET", f"{read}{path}")
+                assert status == 200, f"{path} must bypass on read too"
+            for path in surfaces:
+                status, _, _ = _http("GET", f"{metrics}{path}")
+                assert status not in (429, 503), \
+                    f"{path} was shed at full shed: operators are blind"
+        finally:
+            ov.force_stage(0, reason="test: drill over")
+        # the ladder back at normal: interactive flows again
+        status, body, _ = _http(
+            "GET", f"{read}/relation-tuples/check/openapi?{q}")
+        assert status == 200 and json.loads(body)["allowed"] is True
+
+    def test_debug_overload_surface_shape(self, overload_server):
+        metrics = "http://%s:%d" % tuple(overload_server.addresses["metrics"])
+        _, body, _ = _http("GET", f"{metrics}/debug/overload")
+        payload = json.loads(body)
+        assert payload["enabled"] is True
+        assert payload["stage_name"] == "normal"
+        assert payload["admission"]["limit"] >= 1
+        assert "breakers" in payload and "transitions" in payload
+        assert payload["limits"]["ceiling"] >= payload["limits"]["floor"]
+
+    def test_watchdog_files_one_incident_per_brownout_episode(
+        self, overload_server
+    ):
+        from ketotpu.watchdog import Watchdog
+
+        reg = overload_server.registry
+        ov = reg.overload()
+        wd = Watchdog(reg)
+        wd.tick(now=0.0)  # priming tick adopts counter floors
+        assert wd.tick(now=1.0) == []  # stage 0: quiet
+        ov.force_stage(2, reason="test: watchdog edge")
+        try:
+            filed = wd.tick(now=2.0)
+            rules = [i["rule"] for i in filed]
+            assert "overload" in rules
+            inc = filed[rules.index("overload")]
+            assert inc["detail"]["stage"] == 2
+            assert inc["detail"]["stage_name"] == "brownout-2"
+            # level persists, edge does not: no duplicate incident
+            assert all(
+                i["rule"] != "overload" for i in wd.tick(now=3.0))
+        finally:
+            ov.force_stage(0, reason="test: clear")
+        assert all(i["rule"] != "overload" for i in wd.tick(now=4.0))
+        # a fresh episode fires a fresh edge
+        ov.force_stage(1, reason="test: second episode")
+        try:
+            assert any(
+                i["rule"] == "overload" for i in wd.tick(now=5.0))
+        finally:
+            ov.force_stage(0, reason="test: clear")
+
+    def test_fleet_digest_carries_overload_stage(self, overload_server):
+        reg = overload_server.registry
+        digest = reg.health_digest()
+        assert "overload_stage" in digest
+        assert "admission_limit" in digest
