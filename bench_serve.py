@@ -1016,6 +1016,258 @@ def run_overload_bench(
         srv.stop(grace=2.0)
 
 
+def _hammer_nid(
+    target: str, requests, nid: str, *, concurrency: int, duration: float,
+    shed_sleep: float = 0.05,
+) -> Dict[str, float]:
+    """Closed-loop Check clients pinned to one tenant via the
+    ``x-keto-network`` metadata key.  Quota sheds (RESOURCE_EXHAUSTED)
+    are counted separately and back off ``shed_sleep`` — the Retry-After
+    behavior a real client exhibits — so a shed flood measures quota
+    isolation, not a python busy-loop."""
+    import grpc
+
+    from ketotpu.proto.services import CheckServiceStub
+
+    md = (("x-keto-network", nid),)
+    lat: List[List[float]] = [[] for _ in range(concurrency)]
+    stop = threading.Event()
+    shed = [0]
+    errors = [0]
+
+    def client(idx: int) -> None:
+        rng = np.random.default_rng(idx)
+        with grpc.insecure_channel(target) as ch:
+            stub = CheckServiceStub(ch)
+            my = lat[idx]
+            n_req = len(requests)
+            while not stop.is_set():
+                r = requests[int(rng.integers(n_req))]
+                t0 = time.perf_counter()
+                try:
+                    stub.Check(r, metadata=md)
+                except grpc.RpcError as e:
+                    if e.code() == grpc.StatusCode.RESOURCE_EXHAUSTED:
+                        shed[0] += 1
+                        time.sleep(shed_sleep)
+                    else:
+                        errors[0] += 1
+                    continue
+                my.append(time.perf_counter() - t0)
+
+    threads = [
+        threading.Thread(target=client, args=(i,), daemon=True)
+        for i in range(concurrency)
+    ]
+    t_start = time.perf_counter()
+    for t in threads:
+        t.start()
+    time.sleep(duration)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10.0)
+    elapsed = time.perf_counter() - t_start
+    all_lat = np.array([x for sub in lat for x in sub])
+    done = len(all_lat)
+    return {
+        "rps": round(done / elapsed, 1),
+        "p50_ms": round(float(np.percentile(all_lat, 50)) * 1000, 2)
+        if done else -1.0,
+        "p99_ms": round(float(np.percentile(all_lat, 99)) * 1000, 2)
+        if done else -1.0,
+        "errors": errors[0],
+        "shed": shed[0],
+    }
+
+
+def run_tenants_bench(
+    *,
+    concurrency: int = 24,
+    duration: float = 5.0,
+    tenants: int = 8,
+    frontier: int = 8192,
+    arena: int = 32768,
+) -> Dict[str, float]:
+    """Tenant-plane serving bench (ketotpu/tenancy/): one device engine,
+    ``tenants`` isolated stores, and the noisy-neighbor scenario the
+    quota plane exists for.
+
+    Legs, all against ONE booted daemon (no recompiles across the whole
+    run — tenant lifecycle is a generation swap, gated by ``_steady``):
+
+    * quiet     — the victim tenant alone: baseline p99;
+    * noisy_off — an aggressor tenant floods with quotas disabled while
+      the victim keeps its closed-loop load: the contended p99;
+    * noisy_on  — the aggressor's inflight quota drops to a sliver (a
+      HOT runtime change, no reboot) and floods again: with per-tenant
+      admission the flood sheds out of the aggressor's own bucket and
+      the victim's p99 must return to ~baseline (the __main__ gate
+      enforces <= 1.25x quiet);
+    * mid-flood tenant lifecycle — create / OPL-reload / delete of a
+      bystander tenant inside the steady-state compile gate, proving
+      lifecycle costs a generation swap and never an XLA compile.
+    """
+    import grpc
+
+    from ketotpu.driver import Provider, Registry
+    from ketotpu.proto.services import CheckServiceStub
+    from ketotpu.server import serve_all
+    from ketotpu.utils.synth import build_synth
+
+    graph = build_synth(
+        n_users=400, n_groups=40, n_folders=200, n_docs=2000, seed=0
+    )
+    tuples = graph.store.all_tuples()
+
+    cfg = Provider(
+        {
+            "serve": {
+                n: {"host": "127.0.0.1", "port": 0}
+                for n in ("read", "write", "metrics", "opl")
+            },
+            "engine": {
+                "kind": "tpu",
+                "frontier": frontier,
+                "arena": arena,
+                "max_batch": frontier,
+                "coalesce_ms": 1.0,
+            },
+            "tenancy": {"enabled": True},
+            "log": {"request_log": False},
+        }
+    )
+    reg = Registry(cfg, namespace_manager=graph.manager).init()
+    plane = reg.tenant_plane()
+    nids = [f"t{i}" for i in range(max(2, tenants))]
+    victim, noisy = nids[0], nids[1]
+    for nid in nids:
+        plane.view_for(nid).write_relation_tuples(*tuples)
+    srv = serve_all(reg)
+    try:
+        host, port = srv.addresses["read"]
+        target = f"{host}:{port}"
+        requests = _build_requests(graph, n=1024)
+
+        # warm every tenant's routing path + the shared wave shapes at
+        # both load levels (victim alone, victim + aggressor)
+        with grpc.insecure_channel(target) as ch:
+            stub = CheckServiceStub(ch)
+            for nid in nids:
+                for r in requests[:2]:
+                    for attempt in range(10):
+                        try:
+                            stub.Check(
+                                r, metadata=(("x-keto-network", nid),)
+                            )
+                            break
+                        except grpc.RpcError as e:
+                            if (
+                                e.code()
+                                != grpc.StatusCode.DEADLINE_EXCEEDED
+                                or attempt == 9
+                            ):
+                                raise
+        warm = max(2.0, duration * 0.4)
+        _hammer_nid(target, requests, victim,
+                    concurrency=concurrency // 2, duration=warm)
+        ag = threading.Thread(
+            target=_hammer_nid, args=(target, requests, noisy),
+            kwargs=dict(concurrency=concurrency, duration=warm),
+            daemon=True,
+        )
+        ag.start()
+        _hammer_nid(target, requests, victim,
+                    concurrency=concurrency // 2, duration=warm)
+        ag.join(timeout=30.0)
+
+        from bench import _steady
+
+        out: Dict[str, float] = {}
+        gate: Dict = {}
+
+        def flood_leg(name: str) -> None:
+            box: Dict = {}
+
+            def aggressor() -> None:
+                box["agg"] = _hammer_nid(
+                    target, requests, noisy,
+                    concurrency=concurrency, duration=duration,
+                )
+
+            th = threading.Thread(target=aggressor, daemon=True)
+            th.start()
+            with _steady(gate, f"serve_tenants_{name}"):
+                h = _hammer_nid(
+                    target, requests, victim,
+                    concurrency=concurrency // 2, duration=duration,
+                )
+            th.join(timeout=30.0)
+            agg = box.get("agg", {})
+            out[f"tenants_victim_p99_ms_{name}"] = h["p99_ms"]
+            out[f"tenants_victim_rps_{name}"] = h["rps"]
+            out[f"tenants_victim_errors_{name}"] = h["errors"]
+            out[f"tenants_aggressor_rps_{name}"] = agg.get("rps", 0)
+            out[f"tenants_aggressor_shed_{name}"] = agg.get("shed", 0)
+
+        # quiet baseline, then the mid-flood lifecycle probe: tenant
+        # create + per-tenant OPL reload + delete are generation swaps
+        # on warmed programs — zero compiles, inside the same gate
+        with _steady(gate, "serve_tenants_quiet"):
+            h = _hammer_nid(
+                target, requests, victim,
+                concurrency=concurrency // 2, duration=duration,
+            )
+        out["tenants_victim_p99_ms_quiet"] = h["p99_ms"]
+        out["tenants_victim_rps_quiet"] = h["rps"]
+
+        with _steady(gate, "serve_tenants_lifecycle"):
+            plane.create("bystander")
+            plane.set_opl(
+                "bystander",
+                "class User implements Namespace {}\n"
+                "class doc implements Namespace {\n"
+                "  related: { owner: User[]; }\n"
+                "}\n",
+            )
+            with grpc.insecure_channel(target) as ch:
+                stub = CheckServiceStub(ch)
+                try:
+                    stub.Check(
+                        requests[0],
+                        metadata=(("x-keto-network", "bystander"),),
+                    )
+                except grpc.RpcError as e:
+                    # the override REPLACED bystander's namespace set, so
+                    # the synth namespace rightly resolves NOT_FOUND —
+                    # the routed check still ran the swapped generation
+                    if e.code() != grpc.StatusCode.NOT_FOUND:
+                        raise
+            plane.delete("bystander")
+
+        flood_leg("noisy_off")
+
+        # quota flip is HOT: shrink the aggressor's inflight bucket to a
+        # single unit — with the coalescer batching whole waves, even a
+        # handful of admitted units sustains full flood throughput, so
+        # the guard must squeeze to a sliver to actually yield the box
+        plane.quotas_for(noisy).inflight.cap = 1
+        flood_leg("noisy_on")
+
+        steady = gate.get("steady_state_compiles", {})
+        out["tenants_steady_state_compiles"] = int(sum(steady.values()))
+        if steady:
+            out["steady_state_compiles"] = steady
+        out["tenants_count"] = len(plane.tenant_ids())
+        out["tenants_concurrency"] = concurrency
+        shed_rows = {
+            row["id"]: row["shed"] for row in plane.catalog() if row["shed"]
+        }
+        out["tenants_shed_by_tenant"] = shed_rows
+        return out
+    finally:
+        srv.stop(grace=2.0)
+
+
 def run_sharded_child(
     shards: int,
     *,
@@ -1884,6 +2136,23 @@ if __name__ == "__main__":
         bad = (
             g1 <= 0 or g2 < 0.8 * g1
             or (p1 > 0 and p2 > 2.0 * p1)
+        )
+        sys.exit(3 if bad else 0)
+    elif len(sys.argv) > 3 and sys.argv[3] == "tenants":
+        res = run_tenants_bench(concurrency=conc, duration=secs)
+        print(json.dumps(res))
+        # acceptance gates: (a) per-tenant admission must actually engage
+        # (the aggressor sheds out of its own bucket), (b) the victim's
+        # p99 under a quota-capped flood stays within 1.25x its quiet
+        # baseline, (c) tenant lifecycle mid-flood compiles nothing
+        quiet = res.get("tenants_victim_p99_ms_quiet", -1.0)
+        guarded = res.get("tenants_victim_p99_ms_noisy_on", -1.0)
+        bad = (
+            quiet <= 0
+            or guarded <= 0
+            or guarded > 1.25 * quiet
+            or not res.get("tenants_aggressor_shed_noisy_on")
+            or res.get("tenants_steady_state_compiles")
         )
         sys.exit(3 if bad else 0)
     elif len(sys.argv) > 3 and sys.argv[3] == "trace":

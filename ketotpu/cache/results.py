@@ -85,7 +85,7 @@ class ResultCache:
 
     def __init__(self, *, max_entries: int = 65536, shards: int = 8,
                  max_staleness_ms: int = 100, hot_threshold: int = 0,
-                 top_k: int = 16, metrics=None):
+                 top_k: int = 16, metrics=None, scope_fn=None):
         shards = max(1, int(shards))
         self._shards = [_Shard() for _ in range(shards)]
         self._per_shard_cap = max(1, int(max_entries) // shards)
@@ -101,6 +101,14 @@ class ResultCache:
         self._fence = 0
         self._ns_fence: dict = {}
         self._ns_default = 0
+        # tenant-plane fence scoping: scope_fn maps a key namespace to a
+        # fence scope (the tenant prefix).  With it set, default-mode
+        # validity compares against the SCOPE's fence instead of the
+        # global one, so one tenant's write never invalidates another
+        # tenant's entries.  Cardinality is bounded by the tenant count.
+        self._scope_fn = scope_fn
+        self._scope_fence: dict = {}
+        self._scope_default = 0
         self._drain_cursor = 0
         self._synced_at = 0.0
         self._dirty = False
@@ -164,11 +172,23 @@ class ResultCache:
                 # touched at the new head
                 self._ns_fence.clear()
                 self._ns_default = head
+                self._scope_fence.clear()
+                self._scope_default = head
             else:
                 pos = self._drain_cursor
                 for _op, t in changes:
                     pos += 1
-                    self._ns_fence[t.namespace] = pos
+                    if self._scope_fn is None:
+                        self._ns_fence[t.namespace] = pos
+                    else:
+                        # scoped stores (tenant views, nid-filtered SQL)
+                        # return a SPARSE slice of the global changelog:
+                        # incremental positions under-count, so fence the
+                        # touched namespace at the head instead — a
+                        # conservative bound that can only over-invalidate
+                        # within this one drain batch
+                        self._ns_fence[t.namespace] = head
+                        self._scope_fence[self._scope_fn(t.namespace)] = head
             self._drain_cursor = head
             if head > self._fence:
                 self._fence = head
@@ -204,6 +224,10 @@ class ResultCache:
                 ok = satisfies_cursor(ctx.token, e.cursor)
             elif ctx is not None and ctx.floor is not None:
                 ok = e.cursor >= ctx.floor
+            elif self._scope_fn is not None:
+                ok = e.cursor >= self._scope_fence.get(
+                    self._scope_fn(key[1]), self._scope_default
+                )
             else:
                 ok = e.cursor >= self._fence
             if not ok:

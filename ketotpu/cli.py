@@ -1016,6 +1016,74 @@ def _dump_divergence(metrics_remote: str) -> int:
     return 0
 
 
+def cmd_tenant(args) -> int:
+    """Tenant lifecycle over the write port's REST admin surface
+    (server/rest.py /admin/tenants; requires tenancy.enabled)."""
+    import urllib.error
+    import urllib.parse
+    import urllib.request
+
+    base = f"http://{args.write_remote}"
+
+    def call(method: str, path: str, body=None):
+        data = json.dumps(body).encode("utf-8") if body is not None else None
+        req = urllib.request.Request(
+            base + path, data=data, method=method,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=10.0) as resp:
+                return json.loads(resp.read().decode("utf-8") or "null")
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode("utf-8", "replace")
+            try:
+                detail = json.loads(detail)["error"]["message"]
+            except (ValueError, KeyError, TypeError):
+                pass
+            print(f"{method} {path}: {e.code}: {detail}", file=sys.stderr)
+            return None
+        except (OSError, ValueError) as e:
+            print(f"{method} {path}: unreachable ({e})", file=sys.stderr)
+            return None
+
+    if args.tenant_command == "create":
+        body = {"id": args.id}
+        if args.opl:
+            with open(args.opl, encoding="utf-8") as f:
+                body["opl"] = f.read()
+        out = call("POST", "/admin/tenants", body)
+        if out is None:
+            return 1
+        print(json.dumps(out, indent=2))
+        return 0
+    if args.tenant_command == "list":
+        out = call("GET", "/admin/tenants")
+        if out is None:
+            return 1
+        rows = out.get("tenants", [])
+        print(f"{len(rows)} tenant(s)")
+        for r in rows:
+            flags = [f for f, on in (("default", r.get("default")),
+                                     ("opl", r.get("opl_override"))) if on]
+            print(
+                f"  {r.get('id', '?'):24s}"
+                f" tuples={r.get('tuples', 0):<8d}"
+                f" checks={r.get('checks', 0):<10d}"
+                f" writes={r.get('writes', 0):<8d}"
+                f" shed={r.get('shed', 0):<6d}"
+                + (f" [{','.join(flags)}]" if flags else "")
+            )
+        return 0
+    # delete
+    out = call(
+        "DELETE", "/admin/tenants?id=" + urllib.parse.quote(args.id)
+    )
+    if out is None:
+        return 1
+    print(json.dumps(out, indent=2))
+    return 0
+
+
 def cmd_status(args) -> int:
     import grpc
 
@@ -1332,6 +1400,30 @@ def build_parser() -> argparse.ArgumentParser:
     mig_down.add_argument("--steps", type=int, default=1)
     migsub.add_parser("status", help="list migration status")
     migrate.set_defaults(fn=cmd_migrate)
+
+    tenant = sub.add_parser(
+        "tenant", help="tenant lifecycle (requires tenancy.enabled)"
+    )
+    tenant.add_argument(
+        "--write-remote",
+        default=os.environ.get("KETO_WRITE_REMOTE", "127.0.0.1:4467"),
+        help="write-port HTTP remote hosting the /admin/tenants surface"
+        " (host:port; env KETO_WRITE_REMOTE)",
+    )
+    tsub = tenant.add_subparsers(dest="tenant_command", required=True)
+    t_create = tsub.add_parser(
+        "create", help="create a tenant (idempotent)"
+    )
+    t_create.add_argument("id")
+    t_create.add_argument(
+        "--opl", help="OPL file to install as this tenant's namespace config"
+    )
+    tsub.add_parser("list", help="list tenants with usage counters")
+    t_delete = tsub.add_parser(
+        "delete", help="delete a tenant and purge its tuples"
+    )
+    t_delete.add_argument("id")
+    tenant.set_defaults(fn=cmd_tenant)
 
     status = sub.add_parser("status", help="server health status")
     status.add_argument("--block", action="store_true", help="wait until SERVING")

@@ -252,6 +252,25 @@ def _defaults() -> Dict[str, Any]:
             "hot_threshold": 0,
             "top_k": 16,
         },
+        # tenant plane (ketotpu/tenancy/): thousands of isolated stores on
+        # one device engine.  Tenants share ONE store, ONE projection, and
+        # ONE set of compiled programs — the tenant id rides every
+        # namespace as a routing column, so tenant create/reload/delete is
+        # a generation swap, never a recompile.  quota.* are per-tenant
+        # defaults (0 disables): inflight check units, write ops/second,
+        # and resident tuple count.  metrics_top_k bounds per-tenant label
+        # cardinality (top-K by check volume + an "other" bucket).
+        "tenancy": {
+            "enabled": False,
+            "default_network": "default",
+            "max_tenants": 1024,
+            "quota": {
+                "inflight": 0,
+                "write_rate": 0.0,
+                "max_tuples": 0,
+            },
+            "metrics_top_k": 8,
+        },
         # request_log: per-request access lines (REST middleware + gRPC
         # interceptor) at INFO; benches disable it to keep stderr quiet
         "log": {"level": "info", "format": "text", "request_log": True},
@@ -469,7 +488,8 @@ class Provider:
                           "barrier_timeout_ms", "barrier_poll_ms",
                           "queue_cap", "max_subscribers", "heartbeat_ms",
                           "max_entries", "max_staleness_ms",
-                          "hot_threshold", "top_k", "wave_ledger_size",
+                          "hot_threshold", "metrics_top_k", "top_k",
+                          "wave_ledger_size",
                           "flight_recorder_size",
                           "flight_recorder_max_age_s", "compile_log_size",
                           "warm_compile_warning", "max_seconds",
@@ -485,7 +505,8 @@ class Provider:
                           "latency_objective", "interval_s",
                           "baseline_waves", "drift_pct", "incident_cap",
                           "burn_threshold", "auto_profile",
-                          "profile_cooldown_s"):
+                          "profile_cooldown_s", "default_network",
+                          "max_tenants", "write_rate", "max_tuples"):
                 suffix = known.split("_")
                 if len(joined) > len(suffix) and joined[-len(suffix):] == suffix:
                     joined = joined[: -len(suffix)] + [known]
@@ -945,4 +966,34 @@ class Provider:
             raise ConfigError(
                 "observability.watchdog.drift_pct",
                 f"must be a positive number, got {val!r}",
+            )
+        if not isinstance(self.get("tenancy.enabled", False), bool):
+            raise ConfigError(
+                "tenancy.enabled",
+                f"must be a boolean, got {self.get('tenancy.enabled')!r}",
+            )
+        val = self.get("tenancy.default_network")
+        if not isinstance(val, str) or not val or "\x1f" in val:
+            raise ConfigError(
+                "tenancy.default_network",
+                f"must be a non-empty string without control separators, "
+                f"got {val!r}",
+            )
+        for key in ("tenancy.max_tenants", "tenancy.metrics_top_k"):
+            val = self.get(key)
+            if not isinstance(val, int) or val < 1:
+                raise ConfigError(
+                    key, f"must be a positive integer, got {val!r}"
+                )
+        for key in ("tenancy.quota.inflight", "tenancy.quota.max_tuples"):
+            val = self.get(key)
+            if not isinstance(val, int) or val < 0:
+                raise ConfigError(
+                    key, f"must be a non-negative integer, got {val!r}"
+                )
+        val = self.get("tenancy.quota.write_rate")
+        if not isinstance(val, (int, float)) or val < 0:
+            raise ConfigError(
+                "tenancy.quota.write_rate",
+                f"must be a non-negative number, got {val!r}",
             )

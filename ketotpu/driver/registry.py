@@ -101,6 +101,8 @@ class Registry:
         self._ro_mapper = None
         self._uuid_mapper = None
         self._durability_gate = None
+        self._tenant_plane = None
+        self._tenant_plane_built = False
         # warm-standby seams (ketotpu/standby.py): the follower installs
         # its state snapshot here so /debug/projection and status --debug
         # show standby rows; the REST /debug/handoff route triggers a
@@ -488,11 +490,84 @@ class Registry:
 
     # -- multi-tenancy (ketoctx Contextualizer seam) ------------------------
 
+    def tenant_plane(self):
+        """The shared-engine tenant plane (ketotpu/tenancy/) — built when
+        ``tenancy.enabled`` is on and the store is the in-memory fused
+        store.  SQL dsns keep the legacy per-network store handles (their
+        ``nid`` rows already scope natively); the plane path is the
+        device-engine one: ONE compiled program, per-tenant qualified
+        namespaces, generation-swap lifecycle.  None when inactive."""
+        with self._lock:
+            if self._tenant_plane_built:
+                return self._tenant_plane
+            self._tenant_plane_built = True
+            if not bool(self.config.get("tenancy.enabled", False)):
+                return None
+            from ketotpu.ctx import HeaderContextualizer, StaticContextualizer
+
+            # make the edge resolution live: unless the embedder supplied
+            # its own Contextualizer, X-Keto-Network now routes tenants —
+            # on the plane path AND on the SQL per-network fallback below
+            if isinstance(self.options.contextualizer, StaticContextualizer):
+                self.options.contextualizer = HeaderContextualizer()
+            if self.config.dsn() != "memory":
+                self.logger().warning(
+                    "tenancy.enabled with dsn=%r: SQL stores scope rows by"
+                    " nid natively; falling back to per-network store"
+                    " handles instead of the fused device plane",
+                    self.config.dsn(),
+                )
+                return None
+            from ketotpu.tenancy import TenantPlane
+            # an explicitly-injected manager (embedder / bench / synth
+            # graph) becomes the base every tenant inherits; the plane's
+            # qualified union then supersedes it as the ROOT manager so
+            # the shared device engine sees every tenant's namespaces
+            base_manager = (
+                self._namespace_manager
+                if self._namespace_manager is not None
+                else self._config_namespace_manager()
+            )
+            self._tenant_plane = TenantPlane(
+                self.store(),
+                base_manager,
+                default_network=str(
+                    self.config.get("tenancy.default_network", "default")
+                    or "default"
+                ),
+                max_tenants=int(
+                    self.config.get("tenancy.max_tenants", 1024) or 1024
+                ),
+                quota_inflight=int(
+                    self.config.get("tenancy.quota.inflight", 0) or 0
+                ),
+                quota_write_rate=float(
+                    self.config.get("tenancy.quota.write_rate", 0) or 0
+                ),
+                quota_max_tuples=int(
+                    self.config.get("tenancy.quota.max_tuples", 0) or 0
+                ),
+                metrics_top_k=int(
+                    self.config.get("tenancy.metrics_top_k", 8) or 8
+                ),
+                logger=self.logger(),
+            )
+            self._namespace_manager = self._tenant_plane.manager
+            return self._tenant_plane
+
     def resolve(self, metadata: Optional[Dict[str, str]] = None) -> "Registry":
         """Per-request registry: the options' Contextualizer maps request
         metadata (HTTP headers / gRPC metadata, lower-cased keys) to a
         network id; non-default ids get a derived registry whose store and
-        engines live on that network (`registry_default.go:121-126`)."""
+        engines live on that network (`registry_default.go:121-126`).
+        With the tenant plane active, EVERY request routes through a
+        tenant registry — the default network is just another tenant."""
+        plane = self.tenant_plane()
+        if plane is not None:
+            nid = self.options.contextualizer.network(
+                metadata or {}, plane.default_network
+            )
+            return self.for_network(nid)
         nid = self.options.contextualizer.network(
             metadata or {}, str(self.network_id)
         )
@@ -510,20 +585,24 @@ class Registry:
         LRU: beyond MAX_TENANTS the least-recently-used tenant is evicted
         (its store closed); its durable rows are untouched and it rebuilds
         on next use."""
+        plane = self.tenant_plane()
         with self._lock:
             reg = self._tenants.pop(nid, None)
             if reg is None:
-                reg = Registry(
-                    self.config,
-                    logger=self.logger(),
-                    tracer=self.tracer(),
-                    metrics=self.metrics(),
-                    namespace_manager=self.namespace_manager(),
-                    store=self._build_store(nid),
-                    readiness_checks=self.readiness_checks,
-                    network_id=uuid.uuid5(self.network_id, nid),
-                    options=self.options,
-                )
+                if plane is not None:
+                    reg = self._build_tenant_registry(plane, nid)
+                else:
+                    reg = Registry(
+                        self.config,
+                        logger=self.logger(),
+                        tracer=self.tracer(),
+                        metrics=self.metrics(),
+                        namespace_manager=self.namespace_manager(),
+                        store=self._build_store(nid),
+                        readiness_checks=self.readiness_checks,
+                        network_id=uuid.uuid5(self.network_id, nid),
+                        options=self.options,
+                    )
             self._tenants[nid] = reg  # reinsert = most recently used
             while len(self._tenants) > self.MAX_TENANTS:
                 _, evicted = self._tenants.popitem(last=False)
@@ -543,6 +622,62 @@ class Registry:
 
                     weakref.finalize(evicted, close)
             return reg
+
+    def _build_tenant_registry(self, plane, nid: str) -> "Registry":
+        """Assemble a tenant registry over the shared plane: every engine
+        is PRESET as a qualifying facade (or a host engine over the
+        tenant's store view) so no lazy builder can ever wrap the shared
+        device engine unqualified."""
+        view = plane.view_for(nid)
+        reg = Registry(
+            self.config,
+            logger=self.logger(),
+            tracer=self.tracer(),
+            metrics=self.metrics(),
+            namespace_manager=plane.manager_for(nid),
+            store=view,
+            readiness_checks=self.readiness_checks,
+            network_id=uuid.uuid5(self.network_id, nid),
+            options=self.options,
+        )
+        # the plane is the root's; a derived registry must never build
+        # a second one from the same config
+        reg._tenant_plane_built = True
+        reg._check_engine = plane.engine_for(nid, self.check_engine())
+        reg._expand_engine = ExpandEngine(
+            view, max_depth=self.config.max_read_depth()
+        )
+        dev = self._device_engine()
+        if dev is not None:
+            reg._list_engine = plane.list_engine_for(nid, dev)
+        else:
+            from ketotpu.leopard import HostListEngine
+
+            reg._list_engine = HostListEngine(view)
+        if bool(self.config.get("cache.enabled", True)):
+            from ketotpu.cache import ResultCache
+
+            # private per-tenant cache over the view: unqualified keys,
+            # and a constant fence scope so only THIS tenant's writes
+            # (the only entries its view's changelog delivers) invalidate
+            rc = ResultCache(
+                max_entries=int(
+                    self.config.get("cache.max_entries", 65536) or 65536
+                ),
+                shards=int(self.config.get("cache.shards", 8) or 8),
+                max_staleness_ms=int(
+                    self.config.get("cache.max_staleness_ms", 100)
+                ),
+                hot_threshold=int(
+                    self.config.get("cache.hot_threshold", 0) or 0
+                ),
+                top_k=int(self.config.get("cache.top_k", 16) or 16),
+                metrics=self.metrics(),
+                scope_fn=lambda _ns: "",
+            )
+            rc.attach_store(view)
+            reg._result_cache = rc
+        return reg
 
     # -- storage + namespaces ----------------------------------------------
 
@@ -614,6 +749,16 @@ class Registry:
                     return None
                 from ketotpu.cache import ResultCache
 
+                scope_fn = None
+                if self.tenant_plane() is not None:
+                    # keys are tenant-qualified on the shared path: fence
+                    # per tenant prefix, so one tenant's write never
+                    # invalidates another tenant's entries
+                    from ketotpu.tenancy import SEP
+
+                    def scope_fn(ns, _sep=SEP):
+                        return ns.split(_sep, 1)[0]
+
                 rc = ResultCache(
                     max_entries=int(
                         self.config.get("cache.max_entries", 65536) or 65536
@@ -627,6 +772,7 @@ class Registry:
                     ),
                     top_k=int(self.config.get("cache.top_k", 16) or 16),
                     metrics=self.metrics(),
+                    scope_fn=scope_fn,
                 )
                 rc.attach_store(self.store())
                 self._result_cache = rc
@@ -686,29 +832,38 @@ class Registry:
         raise ConfigError("dsn", f"unsupported dsn {dsn!r}")
 
     def namespace_manager(self):
-        """Resolve the polymorphic namespaces config (provider.go:311-342):
-        literal list | {location: opl-file} | URI string."""
+        """Resolve the namespace manager: the tenant plane's qualified
+        union when the plane is active (the shared device engine must see
+        every tenant's namespaces under their qualified names), otherwise
+        the plain config-resolved manager."""
         with self._lock:
+            plane = self.tenant_plane()
+            if plane is not None:
+                # tenant_plane() folded any injected manager into the
+                # plane as the per-tenant base; the qualified union IS
+                # the root manager from here on
+                return plane.manager
             if self._namespace_manager is None:
-                ns_cfg = self.config.namespaces_config()
-                if isinstance(ns_cfg, dict):
-                    loc = _strip_file_uri(ns_cfg.get("location", "") or "")
-                    if not loc:
-                        # {experimental_strict_mode: ...} with no location is
-                        # valid config (config.py); an empty manager beats a
-                        # raw FileNotFoundError("") at boot
-                        self._namespace_manager = StaticNamespaceManager([])
-                    else:
-                        self._namespace_manager = _uri_manager(loc)
-                elif isinstance(ns_cfg, str):
-                    self._namespace_manager = _uri_manager(
-                        _strip_file_uri(ns_cfg)
-                    )
-                else:
-                    self._namespace_manager = StaticNamespaceManager(
-                        [_namespace_from_config(d) for d in (ns_cfg or [])]
-                    )
+                self._namespace_manager = self._config_namespace_manager()
             return self._namespace_manager
+
+    def _config_namespace_manager(self):
+        """The polymorphic namespaces config (provider.go:311-342):
+        literal list | {location: opl-file} | URI string."""
+        ns_cfg = self.config.namespaces_config()
+        if isinstance(ns_cfg, dict):
+            loc = _strip_file_uri(ns_cfg.get("location", "") or "")
+            if not loc:
+                # {experimental_strict_mode: ...} with no location is
+                # valid config (config.py); an empty manager beats a
+                # raw FileNotFoundError("") at boot
+                return StaticNamespaceManager([])
+            return _uri_manager(loc)
+        if isinstance(ns_cfg, str):
+            return _uri_manager(_strip_file_uri(ns_cfg))
+        return StaticNamespaceManager(
+            [_namespace_from_config(d) for d in (ns_cfg or [])]
+        )
 
     # -- engines (the EngineProvider seam) ----------------------------------
 
@@ -1214,6 +1369,12 @@ class Registry:
         with self._lock:
             outer = self._check_engine
             rc = self._result_cache
+            plane = self._tenant_plane
+        if plane is not None:
+            try:
+                plane.publish(self.metrics())
+            except Exception:  # noqa: BLE001 - scrape must not fail
+                pass
         if rc is not None:
             cs = rc.stats()
             m = self.metrics()

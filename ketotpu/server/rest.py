@@ -71,6 +71,7 @@ _ADMISSION_EXEMPT = {
     "/debug/profile", "/debug/projection", "/debug/mesh",
     "/debug", "/debug/trace", "/debug/divergence", "/debug/handoff",
     "/debug/slo", "/debug/fleet", "/debug/incidents", "/debug/overload",
+    "/debug/tenants",
 }
 
 # REST paths that get the full stage decomposition (flightrec context);
@@ -734,6 +735,53 @@ def write_router(registry) -> Router:
     rt.add("PUT", "/admin/relation-tuples", put_tuple)
     rt.add("DELETE", "/admin/relation-tuples", delete_tuples)
     rt.add("PATCH", "/admin/relation-tuples", patch_tuples)
+
+    # -- tenant lifecycle (ketotpu/tenancy/): admin-port surface ----------
+
+    def _plane():
+        plane = registry.tenant_plane()
+        if plane is None:
+            raise NotFoundError(
+                "tenancy is not enabled (set tenancy.enabled with the "
+                "in-memory dsn)"
+            )
+        return plane
+
+    def post_tenant(req):
+        body = req.json() or {}
+        nid = body.get("id")
+        if not isinstance(nid, str) or not nid:
+            raise BadRequestError("'id' is required")
+        plane = _plane()
+        out = plane.create(nid)
+        opl = body.get("opl")
+        if isinstance(opl, str) and opl.strip():
+            out["opl"] = plane.set_opl(nid, opl)
+        return (201 if out.get("created") else 200), out
+
+    def get_tenants(req):
+        return 200, {"tenants": _plane().catalog()}
+
+    def delete_tenant(req):
+        nid = req.query.get("id", "")
+        if not nid:
+            raise BadRequestError("required query parameter 'id' is missing")
+        return 200, _plane().delete(nid)
+
+    def post_tenant_opl(req):
+        body = req.json() or {}
+        nid = body.get("id")
+        if not isinstance(nid, str) or not nid:
+            raise BadRequestError("'id' is required")
+        source = body.get("opl", "")
+        if not isinstance(source, str):
+            raise BadRequestError("'opl' must be a string (empty clears)")
+        return 200, _plane().set_opl(nid, source)
+
+    rt.add("POST", "/admin/tenants", post_tenant)
+    rt.add("GET", "/admin/tenants", get_tenants)
+    rt.add("DELETE", "/admin/tenants", delete_tenant)
+    rt.add("POST", "/admin/tenants/opl", post_tenant_opl)
     return rt
 
 
@@ -1014,6 +1062,20 @@ def metrics_router(registry) -> Router:
     rt.add("GET", "/debug/overload", get_overload,
            describe="overload plane: brownout stage, adaptive limit, "
                     "class caps, breakers, transitions")
+
+    def get_tenants_debug(req):
+        plane = registry.tenant_plane()
+        if plane is None:
+            return 200, {"enabled": False}
+        return 200, {
+            "enabled": True,
+            **plane.stats(),
+            "tenants": plane.catalog(),
+        }
+
+    rt.add("GET", "/debug/tenants", get_tenants_debug,
+           describe="tenant plane: per-tenant tuples/traffic/quota "
+                    "occupancy, OPL overrides, capacity")
     return rt
 
 

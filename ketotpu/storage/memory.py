@@ -59,6 +59,19 @@ class InMemoryTupleStore:
         self.overflow_evictions = 0
         self._overflow_episode = False
 
+    def with_network(self, nid: str):
+        """A network-scoped handle over THIS store — the in-memory analog
+        of opening a second :class:`SQLiteTupleStore` with a different
+        ``network_id`` over the same database file.  Rows are scoped by a
+        tenant prefix on the namespace column, the changelog stays global
+        (nid-filtered slices, global head), and the view keeps its own
+        per-nid version counter — the same contract the SQL stores'
+        ``nid`` column provides (tests/test_tenancy.py gates the parity).
+        """
+        from ketotpu.tenancy.store import TenantStoreView
+
+        return TenantStoreView(self, nid)
+
     # -- change notification -------------------------------------------------
 
     def on_change(self, fn: Callable[[int], None]) -> None:

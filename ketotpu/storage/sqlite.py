@@ -251,6 +251,19 @@ class SQLiteTupleStore:
     def _default_auto_migrate(path: str) -> bool:
         return path == ":memory:"
 
+    def with_network(self, nid: str):
+        """A sibling handle over the SAME database scoped to ``nid``:
+        rows and the version counter are per-nid (the ``nid`` column /
+        per-nid keto_meta row), while the change-log id space stays
+        global.  Shares the connection and lock, so it works for
+        ``:memory:`` stores too; listeners are per-handle, exactly as
+        with two independently opened handles over one file."""
+        sib = object.__new__(type(self))
+        sib.__dict__.update(self.__dict__)
+        sib.nid = nid
+        sib._listeners = []
+        return sib
+
     @contextmanager
     def _tx(self, mode: str = "DEFERRED"):
         """Explicit transaction: IMMEDIATE for writes (takes the write lock

@@ -1,0 +1,42 @@
+"""Tenant plane: thousands of isolated stores on one device engine.
+
+Ory Network runs Keto multi-tenant with a per-request ``Contextualizer``
+resolving ``X-Keto-Network`` into a network id and ``nid``-scoped rows
+(SURVEY §5.6); Zanzibar itself is one shared service for every client
+namespace.  This package makes that model first-class on the packed
+device path: ONE compiled program serves every tenant.
+
+The core trick is namespace qualification.  A tenant's tuples live in a
+single shared ("fused") store under the namespace ``f"{nid}\\x1f{ns}"``
+— the unit separator can never appear in a client namespace, so the
+qualified name space is collision-free.  Because node identity in the
+device projection is (namespace, object, relation), qualifying the
+namespace qualifies every vocab id, CSR row, leopard closure pair,
+cache key, singleflight key, and mesh routing hash at once: cross-tenant
+leakage is impossible by construction rather than filtered after the
+fact.  Tenant create/reload/delete only changes the namespace-config
+fingerprint, so it rides the existing PR-8 generation swap — padded
+array shapes are unchanged and warmed programs stay warm.
+
+Per-tenant surfaces are facades over the shared machinery:
+
+* :class:`~ketotpu.tenancy.store.TenantStoreView` — the storage contract
+  (rows/changelog/log_head in GLOBAL changelog coordinates, filtered per
+  tenant — the same contract the SQL stores' ``nid`` column implements);
+* ``TenantCheckEngine`` — qualifies checks/blocks before the shared
+  coalescer, so waves mix tenants while identical keys from different
+  tenants never singleflight-collapse;
+* :class:`~ketotpu.tenancy.quota.TenantQuotas` — token buckets for
+  inflight check units, write rate, and tuple count; a tenant's batch
+  flood sheds inside its own budget (429) under the PR 16 ladder.
+"""
+
+from ketotpu.tenancy.plane import (  # noqa: F401
+    SEP,
+    TenantCheckEngine,
+    TenantPlane,
+    qualify_ns,
+    split_ns,
+)
+from ketotpu.tenancy.quota import TenantQuotas, TokenBucket  # noqa: F401
+from ketotpu.tenancy.store import TenantStoreView  # noqa: F401

@@ -1,0 +1,1005 @@
+"""Tenant-plane tests (ketotpu/tenancy/): thousands of isolated stores
+on one device engine.
+
+The isolation contract under test is *by construction*: a tenant's id is
+prepended to every namespace as a routing column, so vocab ids, CSR
+rows, leopard pairs, cache keys, and singleflight keys can never collide
+across tenants — there is no filter to forget.  The suites here attack
+that claim from every angle the serving stack exposes:
+
+* storage parity — the in-memory ``with_network`` view must mirror the
+  SQL stores' ``nid`` semantics exactly (per-nid rows + version, GLOBAL
+  change-log coordinates), randomized against sqlite;
+* randomized cross-tenant fuzz through check / expand / list / watch at
+  every consistency mode, against per-tenant host oracles;
+* the coalescer must NOT singleflight-collapse identical keys from two
+  tenants;
+* the shared result cache must fence per tenant: one tenant's write
+  never invalidates another's entries;
+* per-tenant quotas shed 429 out of the offender's own bucket;
+* tenant lifecycle (create / OPL hot-reload / delete) is a generation
+  swap on warmed programs — the compile watch must stay flat;
+* the qualified namespace (with its ``\\x1f`` separator) survives the
+  worker wire's columnar framing byte-exactly.
+"""
+
+import json
+import random
+import threading
+
+import pytest
+
+from ketotpu.api.types import (
+    BadRequestError,
+    NotFoundError,
+    RelationQuery,
+    RelationTuple,
+    SubjectID,
+    SubjectSet,
+    TooManyRequestsError,
+)
+from ketotpu.cache import ResultCache, check_key
+from ketotpu.cache import context as cache_context
+from ketotpu.driver import Provider, Registry
+from ketotpu.driver.config import ConfigError
+from ketotpu.storage.memory import InMemoryTupleStore
+from ketotpu.storage.sqlite import SQLiteTupleStore
+from ketotpu.tenancy import (
+    SEP,
+    TenantPlane,
+    TenantQuotas,
+    TenantStoreView,
+    qualify_ns,
+    split_ns,
+)
+from ketotpu.tenancy.quota import InflightGauge, TokenBucket
+from ketotpu.tenancy.store import qualify_tuple, unqualify_tuple
+
+T = RelationTuple.from_string
+
+
+def _nm(*names):
+    from ketotpu.opl.ast import Namespace
+    from ketotpu.storage.namespaces import StaticNamespaceManager
+
+    return StaticNamespaceManager([Namespace(name=n, relations=[]) for n in names])
+
+
+# -- qualification ------------------------------------------------------------
+
+
+class TestQualification:
+    def test_roundtrip(self):
+        assert split_ns(qualify_ns("acme", "doc")) == ("acme", "doc")
+        assert split_ns("doc") == (None, "doc")
+
+    def test_separator_in_client_namespace_cannot_spoof(self):
+        # a malicious client namespace containing the separator still
+        # lands under ITS tenant: the split takes the FIRST separator,
+        # which the server prepended
+        qns = qualify_ns("victim-not", "evil" + SEP + "doc")
+        assert split_ns(qns) == ("victim-not", "evil" + SEP + "doc")
+
+    def test_tuple_roundtrip_qualifies_subject_sets_not_ids(self):
+        t = T("doc:readme#viewer@group:eng#member")
+        q = qualify_tuple("acme", t)
+        assert q.namespace == "acme" + SEP + "doc"
+        assert q.subject.namespace == "acme" + SEP + "group"
+        assert unqualify_tuple(q) == t
+        t2 = T("doc:readme#viewer@alice")
+        q2 = qualify_tuple("acme", t2)
+        assert isinstance(q2.subject, SubjectID)
+        assert q2.subject == t2.subject
+
+    def test_plane_rejects_bad_nids(self):
+        plane = TenantPlane(InMemoryTupleStore(), _nm("doc"))
+        with pytest.raises(BadRequestError):
+            plane.create("")
+        with pytest.raises(BadRequestError):
+            plane.create("a" + SEP + "b")
+
+
+# -- storage parity: memory with_network vs sqlite nid ------------------------
+
+
+class TestNidStorageParity:
+    """The in-memory fused store + TenantStoreView must implement the
+    SAME nid semantics the sqlite store does natively: per-nid rows and
+    version, one global change-log id space, nid-filtered slices that
+    advance to the global head."""
+
+    NIDS = ("a", "b", "c")
+
+    def _pair(self):
+        mem = InMemoryTupleStore()
+        sq = SQLiteTupleStore(":memory:")
+        return (
+            {n: mem.with_network(n) for n in self.NIDS},
+            {n: sq.with_network(n) for n in self.NIDS},
+        )
+
+    @staticmethod
+    def _tuples(store):
+        return sorted(str(t) for t in store.all_tuples())
+
+    def test_randomized_op_parity(self):
+        mem, sq = self._pair()
+        rng = random.Random(7)
+        pool = [
+            T(f"doc:d{i}#viewer@u{j}") for i in range(6) for j in range(3)
+        ]
+        for step in range(120):
+            nid = rng.choice(self.NIDS)
+            t = rng.choice(pool)
+            if rng.random() < 0.7:
+                mem[nid].write_relation_tuples(t)
+                sq[nid].write_relation_tuples(t)
+            else:
+                mem[nid].delete_relation_tuples(t)
+                sq[nid].delete_relation_tuples(t)
+            for n in self.NIDS:
+                assert self._tuples(mem[n]) == self._tuples(sq[n]), (
+                    f"row divergence for nid {n!r} at step {step}"
+                )
+                assert len(mem[n]) == len(sq[n])
+
+    def test_changelog_global_head_filtered_entries(self):
+        mem, sq = self._pair()
+        for views in (mem, sq):
+            views["a"].write_relation_tuples(T("doc:1#v@u1"))
+            views["b"].write_relation_tuples(T("doc:2#v@u2"))
+            views["a"].write_relation_tuples(T("doc:3#v@u3"))
+        for views in (mem, sq):
+            ea, head_a = views["a"].changes_since(0)
+            eb, head_b = views["b"].changes_since(0)
+            # the head is GLOBAL: both tenants see the same high-water
+            # mark even though they see disjoint entries
+            assert head_a == head_b
+            assert [str(t) for _op, t in ea] == ["doc:1#v@u1", "doc:3#v@u3"]
+            assert [str(t) for _op, t in eb] == ["doc:2#v@u2"]
+            # repeated drains from the returned head re-deliver nothing
+            again, _ = views["a"].changes_since(head_a)
+            assert again == []
+
+    def test_per_nid_version_isolation(self):
+        mem, sq = self._pair()
+        for views in (mem, sq):
+            va0, vb0 = views["a"].version, views["b"].version
+            views["a"].write_relation_tuples(T("doc:1#v@u1"))
+            assert views["a"].version > va0
+            assert views["b"].version == vb0
+
+    def test_exists_and_pagination_scoped(self):
+        mem, sq = self._pair()
+        for views in (mem, sq):
+            for i in range(5):
+                views["a"].write_relation_tuples(T(f"doc:d{i}#v@u"))
+            views["b"].write_relation_tuples(T("doc:other#v@u"))
+            q = RelationQuery(namespace="doc")
+            assert views["a"].exists_relation_tuples(q)
+            page1, tok = views["a"].get_relation_tuples(q, page_size=3)
+            assert len(page1) == 3 and tok
+            page2, tok2 = views["a"].get_relation_tuples(
+                q, page_size=3, page_token=tok
+            )
+            assert [str(t) for t in page1 + page2] == [
+                f"doc:d{i}#v@u" for i in range(5)
+            ]
+            assert tok2 == ""
+            # b's page never shows a's rows
+            rows, _ = views["b"].get_relation_tuples(q)
+            assert [str(t) for t in rows] == ["doc:other#v@u"]
+
+    def test_delete_all_scoped(self):
+        mem, sq = self._pair()
+        for views in (mem, sq):
+            views["a"].write_relation_tuples(T("doc:1#v@u"), T("doc:2#v@u"))
+            views["b"].write_relation_tuples(T("doc:1#v@u"))
+            n = views["a"].delete_all_relation_tuples(
+                RelationQuery(namespace="doc")
+            )
+            assert n == 2
+            assert len(views["a"]) == 0
+            assert [str(t) for t in views["b"].all_tuples()] == ["doc:1#v@u"]
+
+
+# -- view change notification -------------------------------------------------
+
+
+class TestViewListeners:
+    def test_listener_fires_only_for_own_tenant(self):
+        fused = InMemoryTupleStore()
+        a, b = fused.with_network("a"), fused.with_network("b")
+        got_a, got_b = [], []
+        a.on_change(got_a.append)
+        b.on_change(got_b.append)
+        a.write_relation_tuples(T("doc:1#v@u"))
+        assert len(got_a) == 1 and got_b == []
+        b.write_relation_tuples(T("doc:2#v@u"))
+        assert len(got_a) == 1 and len(got_b) == 1
+
+    def test_second_handle_same_nid_sees_writes(self):
+        fused = InMemoryTupleStore()
+        a1, a2 = fused.with_network("a"), fused.with_network("a")
+        got = []
+        a2.on_change(got.append)
+        a1.write_relation_tuples(T("doc:1#v@u"))
+        assert len(got) == 1
+        assert self_tuples(a2) == ["doc:1#v@u"]
+
+
+def self_tuples(view):
+    return [str(t) for t in view.all_tuples()]
+
+
+# -- quotas -------------------------------------------------------------------
+
+
+class TestQuotas:
+    def test_token_bucket_rate_zero_disables(self):
+        b = TokenBucket(0.0)
+        assert all(b.try_take() for _ in range(10_000))
+
+    def test_token_bucket_burst_exhausts_and_refills(self):
+        b = TokenBucket(1000.0, burst=5)
+        assert sum(b.try_take() for _ in range(50)) <= 6
+        import time
+
+        time.sleep(0.01)
+        assert b.try_take()
+
+    def test_inflight_gauge(self):
+        g = InflightGauge(2)
+        assert g.try_acquire() and g.try_acquire()
+        assert not g.try_acquire()
+        g.release()
+        assert g.try_acquire()
+
+    def test_write_rate_shed(self):
+        fused = InMemoryTupleStore()
+        q = TenantQuotas(write_rate=2.0)
+        v = TenantStoreView(fused, "a", quotas=q)
+        shed = 0
+        for i in range(40):
+            try:
+                v.write_relation_tuples(T(f"doc:d{i}#v@u"))
+            except TooManyRequestsError:
+                shed += 1
+        assert shed > 0
+        assert len(v) < 40
+
+    def test_max_tuples_shed(self):
+        fused = InMemoryTupleStore()
+        q = TenantQuotas(max_tuples=3)
+        v = TenantStoreView(fused, "a", quotas=q)
+        for i in range(3):
+            v.write_relation_tuples(T(f"doc:d{i}#v@u"))
+        with pytest.raises(TooManyRequestsError):
+            v.write_relation_tuples(T("doc:d9#v@u"))
+        # deletes free capacity
+        v.delete_relation_tuples(T("doc:d0#v@u"))
+        v.write_relation_tuples(T("doc:d9#v@u"))
+
+    def test_neighbor_quota_does_not_touch_other_tenant(self):
+        fused = InMemoryTupleStore()
+        noisy = TenantStoreView(fused, "noisy", quotas=TenantQuotas(max_tuples=1))
+        victim = TenantStoreView(fused, "victim")
+        noisy.write_relation_tuples(T("doc:1#v@u"))
+        with pytest.raises(TooManyRequestsError):
+            noisy.write_relation_tuples(T("doc:2#v@u"))
+        for i in range(20):
+            victim.write_relation_tuples(T(f"doc:d{i}#v@u"))
+        assert len(victim) == 20
+
+
+# -- cache scope fences -------------------------------------------------------
+
+
+class TestCacheScopeFences:
+    def _cache_over(self, fused):
+        return ResultCache(
+            max_staleness_ms=0,
+            scope_fn=lambda ns: ns.split(SEP, 1)[0],
+        )
+
+    def test_other_tenants_write_does_not_invalidate(self):
+        fused = InMemoryTupleStore()
+        a = fused.with_network("a")
+        b = fused.with_network("b")
+        cache = self._cache_over(fused)
+        cache.attach_store(fused)
+        qa = qualify_tuple("a", T("doc:readme#viewer@alice"))
+        key = check_key(qa, 0)
+        cache.insert(key, True, fused.log_head)
+        assert cache.lookup(key).value is True
+        # ANOTHER tenant's write advances the global log; a's entry must
+        # still serve in default mode (its scope fence did not move)
+        b.write_relation_tuples(T("doc:readme#viewer@bob"))
+        hit = cache.lookup(key)
+        assert hit is not None and hit.value is True
+        # a's OWN write moves a's scope fence: the stale entry stops
+        # serving in default mode
+        a.write_relation_tuples(T("doc:readme#viewer@carol"))
+        assert cache.lookup(key) is None
+
+    def test_snaptoken_mode_still_floors_entries(self):
+        fused = InMemoryTupleStore()
+        b = fused.with_network("b")
+        cache = self._cache_over(fused)
+        cache.attach_store(fused)
+        qa = qualify_tuple("a", T("doc:readme#viewer@alice"))
+        key = check_key(qa, 0)
+        cache.insert(key, True, fused.log_head)
+        b.write_relation_tuples(T("doc:x#v@u"))
+        from ketotpu.consistency.tokens import mint
+
+        tok = mint(fused)
+        # at-least-as-fresh against the GLOBAL head: the old entry is
+        # below the token's floor, so it must NOT serve in this mode
+        with cache_context.scope(token=tok):
+            assert cache.lookup(key) is None
+
+
+# -- plane lifecycle ----------------------------------------------------------
+
+
+class TestPlaneLifecycle:
+    def _plane(self, **kw):
+        return TenantPlane(InMemoryTupleStore(), _nm("doc"), **kw)
+
+    def test_create_idempotent_and_capacity(self):
+        plane = self._plane(max_tenants=3)  # default occupies one slot
+        assert plane.create("a")["created"] is True
+        assert plane.create("a")["created"] is False
+        plane.create("b")
+        with pytest.raises(TooManyRequestsError):
+            plane.create("c")
+
+    def test_delete_default_forbidden_and_unknown_404(self):
+        plane = self._plane()
+        with pytest.raises(BadRequestError):
+            plane.delete(plane.default_network)
+        with pytest.raises(NotFoundError):
+            plane.delete("ghost")
+
+    def test_delete_purges_tuples_through_changelog(self):
+        plane = self._plane()
+        v = plane.view_for("doomed")
+        v.write_relation_tuples(T("doc:1#v@u"), T("doc:2#v@u"))
+        head0 = plane.fused_store.log_head
+        out = plane.delete("doomed")
+        assert out["tuples_removed"] == 2
+        # the deletes ride the ordinary changelog (caches must see them)
+        assert plane.fused_store.log_head == head0 + 2
+        assert not plane.has_tenant("doomed")
+
+    def test_ns_version_bumps_on_lifecycle(self):
+        plane = self._plane()
+        v0 = plane.ns_version
+        plane.create("a")
+        assert plane.ns_version > v0
+        v1 = plane.ns_version
+        plane.set_opl("a", "class doc implements Namespace {}")
+        assert plane.ns_version > v1
+        v2 = plane.ns_version
+        plane.delete("a")
+        assert plane.ns_version > v2
+
+    def test_set_opl_rejects_bad_source_and_clears(self):
+        plane = self._plane()
+        with pytest.raises(BadRequestError):
+            plane.set_opl("a", "class {{{{")
+        plane.set_opl("a", "class proj implements Namespace {}")
+        assert [n.name for n in plane.override_namespaces("a")] == ["proj"]
+        plane.set_opl("a", "")
+        assert plane.override_namespaces("a") is None
+
+    def test_manager_unions_tenants_with_overrides(self):
+        plane = self._plane()
+        plane.create("a")
+        plane.set_opl("a", "class proj implements Namespace {}")
+        names = {n.name for n in plane.manager.namespaces()}
+        # a's override REPLACES its base set; other tenants keep the base
+        assert qualify_ns("a", "proj") in names
+        assert qualify_ns("a", "doc") not in names
+        assert qualify_ns(plane.default_network, "doc") in names
+        got = plane.manager.get_namespace(qualify_ns("a", "proj"))
+        assert got.name == qualify_ns("a", "proj")
+        with pytest.raises(NotFoundError):
+            plane.manager.get_namespace("proj")  # unqualified: never served
+
+    def test_metrics_cardinality_bounded_top_k_plus_other(self):
+        from ketotpu.observability import Metrics
+
+        plane = self._plane(metrics_top_k=2)
+        for i in range(6):
+            nid = f"t{i}"
+            plane.create(nid)
+            for _ in range(i + 1):
+                plane.note_checks(nid, 1)
+        m = Metrics()
+        plane.publish(m)
+        text = m.exposition()
+        tenants = set()
+        for line in text.splitlines():
+            if line.startswith("keto_tenant_checks_total"):
+                tenants.add(line.split('tenant="')[1].split('"')[0])
+        assert "other" in tenants
+        assert len(tenants) <= 3  # top-2 + "other"
+
+
+# -- config surface -----------------------------------------------------------
+
+
+class TestTenancyConfig:
+    def test_defaults(self):
+        cfg = Provider()
+        assert cfg.get("tenancy.enabled") is False
+        assert cfg.get("tenancy.default_network") == "default"
+        assert cfg.get("tenancy.quota.inflight") == 0
+
+    def test_validation(self):
+        with pytest.raises(ConfigError):
+            Provider({"tenancy": {"enabled": "yes"}})
+        with pytest.raises(ConfigError):
+            Provider({"tenancy": {"default_network": ""}})
+        with pytest.raises(ConfigError):
+            Provider({"tenancy": {"max_tenants": 0}})
+        with pytest.raises(ConfigError):
+            Provider({"tenancy": {"quota": {"write_rate": -1}}})
+        with pytest.raises(ConfigError):
+            Provider({"tenancy": {"quota": {"inflight": -2}}})
+
+    def test_env_overrides(self):
+        cfg = Provider(env={
+            "KETO_TENANCY_ENABLED": "true",
+            "KETO_TENANCY_DEFAULT_NETWORK": "acme",
+            "KETO_TENANCY_MAX_TENANTS": "32",
+            "KETO_TENANCY_QUOTA_WRITE_RATE": "2.5",
+            "KETO_TENANCY_QUOTA_MAX_TUPLES": "100",
+            "KETO_TENANCY_METRICS_TOP_K": "4",
+        })
+        assert cfg.get("tenancy.enabled") is True
+        assert cfg.get("tenancy.default_network") == "acme"
+        assert cfg.get("tenancy.max_tenants") == 32
+        assert cfg.get("tenancy.quota.write_rate") == 2.5
+        assert cfg.get("tenancy.quota.max_tuples") == 100
+        assert cfg.get("tenancy.metrics_top_k") == 4
+
+    def test_sql_dsn_disables_plane(self, tmp_path):
+        cfg = Provider({
+            "dsn": f"sqlite://{tmp_path / 'keto.db'}",
+            "tenancy": {"enabled": True},
+        })
+        assert Registry(cfg).tenant_plane() is None
+
+    def test_sql_dsn_fallback_still_routes_headers(self, tmp_path):
+        # no device plane on SQL dsns, but tenancy.enabled must still
+        # make X-Keto-Network live: per-network sqlite handles scope
+        # rows by nid natively
+        cfg = Provider({
+            "dsn": f"sqlite://{tmp_path / 'keto.db'}",
+            "tenancy": {"enabled": True},
+            "namespaces": [{"name": "doc"}],
+            "log": {"request_log": False},
+        })
+        root = Registry(cfg)
+        root.store().migrate_up()
+        ra = root.resolve({"x-keto-network": "acme"})
+        rb = root.resolve({"x-keto-network": "globex"})
+        ra.store().write_relation_tuples(T("doc:r#v@alice"))
+        assert [str(t) for t in ra.store().all_tuples()] == ["doc:r#v@alice"]
+        assert rb.store().all_tuples() == []
+
+
+# -- the worker wire carries qualified namespaces byte-exactly ----------------
+
+
+class TestWireQualifiedColumns:
+    def test_tuplecols_roundtrip_with_separator(self):
+        from ketotpu.server.wire import (
+            pack_arrays,
+            pack_tuplecols,
+            unpack_arrays,
+            unpack_tuplecols,
+        )
+
+        tuples = [
+            qualify_tuple("acme", T("doc:readme#viewer@alice")),
+            qualify_tuple("globex", T("doc:readme#viewer@group:eng#member")),
+        ]
+        arrays = {}
+        pack_tuplecols(arrays, "t", tuples)
+        manifest, payload = pack_arrays(arrays)
+        back = unpack_tuplecols(
+            unpack_arrays(manifest, payload), "t"
+        )
+        assert [str(t) for t in back] == [str(t) for t in tuples]
+        assert back[0].namespace == "acme" + SEP + "doc"
+        assert back[1].subject.namespace == "globex" + SEP + "group"
+
+
+# -- engine-level fuzz: zero cross-tenant leakage -----------------------------
+
+
+NIDS = ("t0", "t1", "t2", "t3")
+
+
+@pytest.fixture(scope="module")
+def plane_reg():
+    """One root registry (device engine + coalescer + cache + leopard)
+    shared by the fuzz suites, with randomized per-tenant writes and a
+    per-tenant host-oracle replica to answer 'what SHOULD this tenant
+    see'."""
+    cfg = Provider({
+        "tenancy": {"enabled": True},
+        "engine": {"kind": "tpu", "coalesce_ms": 2,
+                   "frontier": 2048, "arena": 8192, "max_batch": 2048},
+        "namespaces": [{"name": "doc"}, {"name": "group"}],
+        "log": {"request_log": False},
+    })
+    root = Registry(cfg)
+    rng = random.Random(1234)
+    pool = []
+    for g in range(3):
+        for u in range(4):
+            pool.append(T(f"group:g{g}#member@u{u}"))
+    for d in range(8):
+        for u in range(4):
+            pool.append(T(f"doc:d{d}#viewer@u{u}"))
+        for g in range(3):
+            pool.append(T(f"doc:d{d}#viewer@group:g{g}#member"))
+    replicas = {}
+    for nid in NIDS:
+        reg = root.resolve({"x-keto-network": nid})
+        replica = InMemoryTupleStore()
+        chosen = rng.sample(pool, k=len(pool) // 2)
+        reg.store().write_relation_tuples(*chosen)
+        replica.write_relation_tuples(*chosen)
+        replicas[nid] = replica
+    yield root, replicas
+    root.close_engines()
+
+
+def _oracle(replica):
+    from ketotpu.engine.oracle import CheckEngine
+
+    return CheckEngine(replica, _nm("doc", "group"))
+
+
+class TestCrossTenantFuzz:
+    def test_checks_match_per_tenant_oracle_all_modes(self, plane_reg):
+        root, replicas = plane_reg
+        rng = random.Random(99)
+        queries = [
+            T(f"doc:d{rng.randrange(8)}#viewer@u{rng.randrange(4)}")
+            for _ in range(40)
+        ]
+        from ketotpu.consistency.tokens import mint
+
+        for nid in NIDS:
+            reg = root.resolve({"x-keto-network": nid})
+            eng = reg.check_engine()
+            want = _oracle(replicas[nid])
+            for q in queries:
+                expect = want.check_is_member(q)
+                assert eng.check(q) == expect, (nid, str(q), "default")
+                with cache_context.scope(floor=reg.store().log_head):
+                    assert eng.check(q) == expect, (nid, str(q), "latest")
+                tok = mint(reg.store())
+                with cache_context.scope(token=tok):
+                    assert eng.check(q) == expect, (nid, str(q), "token")
+
+    def test_batch_checks_no_leakage(self, plane_reg):
+        root, replicas = plane_reg
+        rng = random.Random(7)
+        queries = [
+            T(f"doc:d{rng.randrange(8)}#viewer@u{rng.randrange(4)}")
+            for _ in range(64)
+        ]
+        for nid in NIDS:
+            reg = root.resolve({"x-keto-network": nid})
+            got = reg.check_engine().batch_check(queries)
+            want = _oracle(replicas[nid])
+            expect = [want.check_is_member(q) for q in queries]
+            assert got == expect, nid
+
+    def test_expand_trees_match_oracle(self, plane_reg):
+        root, replicas = plane_reg
+        from ketotpu.engine.oracle import ExpandEngine
+
+        subj = SubjectSet(namespace="doc", object="d0", relation="viewer")
+        for nid in NIDS:
+            reg = root.resolve({"x-keto-network": nid})
+            got = reg.expand_engine().build_tree(subj, 4)
+            want = ExpandEngine(replicas[nid]).build_tree(subj, 4)
+            got_j = got.to_json() if got is not None else None
+            want_j = want.to_json() if want is not None else None
+            assert got_j == want_j, nid
+
+    def test_list_objects_match_oracle(self, plane_reg):
+        root, replicas = plane_reg
+        for nid in NIDS:
+            reg = root.resolve({"x-keto-network": nid})
+            want_eng = _oracle(replicas[nid])
+            for u in range(4):
+                subject = SubjectID(f"u{u}")
+                objs, _tok = reg.list_engine().list_objects(
+                    "doc", "viewer", subject, page_size=100, page_token=""
+                )
+                expect = {
+                    f"d{d}" for d in range(8)
+                    if want_eng.check_is_member(
+                        RelationTuple("doc", f"d{d}", "viewer", subject)
+                    )
+                }
+                assert set(objs) == expect, (nid, u)
+
+    def test_watch_events_stay_in_tenant(self, plane_reg):
+        root, _ = plane_reg
+        ra = root.resolve({"x-keto-network": "t0"})
+        rb = root.resolve({"x-keto-network": "t1"})
+        seen = []
+        ra.store().on_change(seen.append)
+        before = len(seen)
+        rb.store().write_relation_tuples(T("doc:w1#viewer@watcher"))
+        assert len(seen) == before
+        ra.store().write_relation_tuples(T("doc:w2#viewer@watcher"))
+        assert len(seen) == before + 1
+        # cleanup so later suites see the fixture's original rows plus
+        # deterministic extras only
+        ra.store().delete_relation_tuples(T("doc:w2#viewer@watcher"))
+        rb.store().delete_relation_tuples(T("doc:w1#viewer@watcher"))
+
+    def test_coalescer_does_not_collapse_identical_keys_across_tenants(
+        self, plane_reg
+    ):
+        root, replicas = plane_reg
+        # find a query whose verdict DIFFERS between two tenants: a
+        # collapsed singleflight would leak one tenant's verdict into
+        # the other's response
+        oracles = {nid: _oracle(replicas[nid]) for nid in NIDS}
+        probe = None
+        for d in range(8):
+            for u in range(4):
+                q = T(f"doc:d{d}#viewer@u{u}")
+                verdicts = {n: oracles[n].check_is_member(q) for n in NIDS}
+                if len(set(verdicts.values())) > 1:
+                    probe = (q, verdicts)
+                    break
+            if probe:
+                break
+        assert probe is not None, "fuzz pool produced no differing verdict"
+        q, verdicts = probe
+        engines = {
+            nid: root.resolve({"x-keto-network": nid}).check_engine()
+            for nid in NIDS
+        }
+        results = {}
+        errs = []
+
+        def fire(nid):
+            try:
+                results[nid] = engines[nid].check(q)
+            except Exception as e:  # pragma: no cover - diagnostic
+                errs.append((nid, e))
+
+        for _round in range(5):
+            results.clear()
+            threads = [
+                threading.Thread(target=fire, args=(nid,)) for nid in NIDS
+            ]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join(timeout=30)
+            assert not errs
+            assert results == verdicts
+
+    def test_debug_inner_engine_is_shared(self, plane_reg):
+        root, _ = plane_reg
+        ea = root.resolve({"x-keto-network": "t0"}).check_engine()
+        eb = root.resolve({"x-keto-network": "t1"}).check_engine()
+        assert ea.inner is eb.inner  # ONE device engine serves them all
+
+
+# -- scale: many tenants on one engine (slow leg) -----------------------------
+
+
+@pytest.mark.slow
+def test_many_tenant_scale_storm():
+    """150 tenants churned onto ONE device engine: randomized writes,
+    sampled oracle-checked verdicts per tenant, zero after-warm compiles
+    across the whole create/write/check/delete storm, and the metrics
+    surface stays bounded at top-K + 'other' regardless of tenant count."""
+    from ketotpu import compilewatch
+    from ketotpu.observability import Metrics
+
+    cfg = Provider({
+        "tenancy": {"enabled": True, "metrics_top_k": 8},
+        "engine": {"kind": "tpu", "coalesce_ms": 0, "frontier": 4096,
+                   "arena": 16384, "max_batch": 4096},
+        "namespaces": [{"name": "doc"}],
+        "log": {"request_log": False},
+    })
+    root = Registry(cfg)
+    rng = random.Random(42)
+    try:
+        # warm the single-check shape once, on the default tenant
+        warm = root.resolve({})
+        warm.store().write_relation_tuples(T("doc:warm#viewer@w"))
+        assert warm.check_engine().check(T("doc:warm#viewer@w")) is True
+        before = compilewatch.get().compiles_total
+
+        nids = [f"tenant{i:03d}" for i in range(150)]
+        membership = {}
+        for nid in nids:
+            reg = root.resolve({"x-keto-network": nid})
+            mine = {
+                (d, u)
+                for d in range(4) for u in range(3)
+                if rng.random() < 0.5
+            }
+            membership[nid] = mine
+            if mine:
+                reg.store().write_relation_tuples(
+                    *[T(f"doc:d{d}#viewer@u{u}") for d, u in mine]
+                )
+        # sampled verdicts: every tenant answers from ITS rows only
+        for nid in rng.sample(nids, 30):
+            reg = root.resolve({"x-keto-network": nid})
+            for _ in range(6):
+                d, u = rng.randrange(4), rng.randrange(3)
+                got = reg.check_engine().check(T(f"doc:d{d}#viewer@u{u}"))
+                assert got == ((d, u) in membership[nid]), (nid, d, u)
+        # churn: delete a third, verify survivors unaffected
+        plane = root.tenant_plane()
+        doomed = rng.sample(nids, 50)
+        for nid in doomed:
+            plane.delete(nid)
+        for nid in rng.sample([n for n in nids if n not in doomed], 10):
+            reg = root.resolve({"x-keto-network": nid})
+            d, u = rng.randrange(4), rng.randrange(3)
+            got = reg.check_engine().check(T(f"doc:d{d}#viewer@u{u}"))
+            assert got == ((d, u) in membership[nid]), nid
+        after = compilewatch.get().compiles_total
+        assert after == before, (
+            f"{after - before} recompiles across a 150-tenant storm"
+        )
+        m = Metrics()
+        plane.publish(m)
+        labelled = {
+            line.split('tenant="')[1].split('"')[0]
+            for line in m.exposition().splitlines()
+            if 'tenant="' in line
+        }
+        assert len(labelled) <= 9, labelled  # top-8 + "other"
+    finally:
+        root.close_engines()
+
+
+# -- end to end through the served edge ---------------------------------------
+
+
+def _http(method, url, body=None, headers=None):
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(
+        url, data=body, method=method, headers=headers or {}
+    )
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+@pytest.fixture(scope="module")
+def tenant_server():
+    from ketotpu.server import serve_all
+
+    cfg = Provider({
+        "serve": {
+            n: {"host": "127.0.0.1", "port": 0}
+            for n in ("read", "write", "metrics", "opl")
+        },
+        "namespaces": [{"name": "doc"}],
+        "tenancy": {"enabled": True},
+        "engine": {"kind": "tpu", "frontier": 1024, "arena": 4096,
+                   "max_batch": 256, "coalesce_ms": 0},
+        "log": {"request_log": False},
+    })
+    reg = Registry(cfg).init()
+    srv = serve_all(reg)
+    plane = reg.tenant_plane()
+    plane.view_for("acme").write_relation_tuples(T("doc:readme#viewer@alice"))
+    plane.view_for("globex").write_relation_tuples(T("doc:readme#viewer@bob"))
+    yield srv, reg
+    srv.stop()
+
+
+class TestServedEdge:
+    CASES = [
+        ("acme", "alice", True),
+        ("acme", "bob", False),
+        ("globex", "alice", False),
+        ("globex", "bob", True),
+    ]
+
+    def test_rest_header_routes_tenant(self, tenant_server):
+        import urllib.parse
+
+        srv, _reg = tenant_server
+        read = "http://%s:%d" % tuple(srv.addresses["read"])
+        for nid, user, want in self.CASES:
+            q = urllib.parse.urlencode(
+                T(f"doc:readme#viewer@{user}").to_url_query()
+            )
+            status, body = _http(
+                "GET",
+                f"{read}/relation-tuples/check/openapi?{q}",
+                headers={"X-Keto-Network": nid},
+            )
+            assert status == 200
+            assert json.loads(body)["allowed"] is want, (nid, user)
+
+    def test_grpc_metadata_routes_tenant(self, tenant_server):
+        import grpc
+
+        from ketotpu.api.proto_codec import tuple_to_proto
+        from ketotpu.proto import check_service_pb2 as cs
+        from ketotpu.proto.services import CheckServiceStub
+
+        srv, _reg = tenant_server
+        ch = grpc.insecure_channel("%s:%d" % tuple(srv.addresses["read"]))
+        try:
+            stub = CheckServiceStub(ch)
+            for nid, user, want in self.CASES:
+                resp = stub.Check(
+                    cs.CheckRequest(
+                        tuple=tuple_to_proto(T(f"doc:readme#viewer@{user}"))
+                    ),
+                    metadata=(("x-keto-network", nid),),
+                )
+                assert resp.allowed is want, (nid, user)
+        finally:
+            ch.close()
+
+    def test_rest_write_lands_in_header_tenant(self, tenant_server):
+        srv, reg = tenant_server
+        write = "http://%s:%d" % tuple(srv.addresses["write"])
+        body = json.dumps(T("doc:secret#viewer@eve").to_json()).encode()
+        status, _ = _http(
+            "PUT", f"{write}/admin/relation-tuples", body,
+            headers={"X-Keto-Network": "acme",
+                     "Content-Type": "application/json"},
+        )
+        assert status in (200, 201)
+        plane = reg.tenant_plane()
+        acme = [str(t) for t in plane.view_for("acme").all_tuples()]
+        globex = [str(t) for t in plane.view_for("globex").all_tuples()]
+        assert "doc:secret#viewer@eve" in acme
+        assert "doc:secret#viewer@eve" not in globex
+
+    def test_admin_tenant_lifecycle_routes(self, tenant_server):
+        srv, _reg = tenant_server
+        write = "http://%s:%d" % tuple(srv.addresses["write"])
+        hdr = {"Content-Type": "application/json"}
+        status, body = _http(
+            "POST", f"{write}/admin/tenants",
+            json.dumps({"id": "wile"}).encode(), headers=hdr,
+        )
+        assert status == 201 and json.loads(body)["created"] is True
+        status, body = _http(
+            "POST", f"{write}/admin/tenants",
+            json.dumps({"id": "wile"}).encode(), headers=hdr,
+        )
+        assert status == 200 and json.loads(body)["created"] is False
+        status, body = _http(
+            "POST", f"{write}/admin/tenants/opl",
+            json.dumps({
+                "id": "wile",
+                "opl": "class gadget implements Namespace {}",
+            }).encode(), headers=hdr,
+        )
+        assert status == 200 and json.loads(body)["namespaces"] == ["gadget"]
+        status, body = _http("GET", f"{write}/admin/tenants")
+        ids = {row["id"] for row in json.loads(body)["tenants"]}
+        assert status == 200 and "wile" in ids
+        status, _ = _http("DELETE", f"{write}/admin/tenants?id=wile")
+        assert status == 200
+        status, _ = _http("DELETE", f"{write}/admin/tenants?id=wile")
+        assert status == 404
+
+    def test_debug_tenants_page(self, tenant_server):
+        srv, _reg = tenant_server
+        metrics = "http://%s:%d" % tuple(srv.addresses["metrics"])
+        status, body = _http("GET", f"{metrics}/debug/tenants")
+        assert status == 200
+        page = json.loads(body)
+        assert page["enabled"] is True
+        ids = {row["id"] for row in page["tenants"]}
+        assert {"acme", "globex"} <= ids
+
+    def test_cli_tenant_commands(self, tenant_server, capsys):
+        from types import SimpleNamespace
+
+        from ketotpu.cli import cmd_tenant
+
+        srv, _reg = tenant_server
+        remote = "%s:%d" % tuple(srv.addresses["write"])
+
+        def run(**kw):
+            args = SimpleNamespace(write_remote=remote, opl=None, **kw)
+            return cmd_tenant(args)
+
+        assert run(tenant_command="create", id="roadrunner") == 0
+        out = capsys.readouterr().out
+        assert json.loads(out)["id"] == "roadrunner"
+        assert run(tenant_command="list") == 0
+        assert "roadrunner" in capsys.readouterr().out
+        assert run(tenant_command="delete", id="roadrunner") == 0
+        capsys.readouterr()
+        assert run(tenant_command="delete", id="roadrunner") == 1
+
+
+# -- zero-compile tenant lifecycle -------------------------------------------
+
+
+class TestZeroCompileLifecycle:
+    def test_lifecycle_is_generation_swap_not_recompile(self):
+        from ketotpu import compilewatch
+
+        cfg = Provider({
+            "tenancy": {"enabled": True},
+            "engine": {"kind": "tpu", "coalesce_ms": 0,
+                       "frontier": 2048, "arena": 8192, "max_batch": 2048},
+            "namespaces": [{"name": "doc"}],
+            "log": {"request_log": False},
+        })
+        root = Registry(cfg)
+        try:
+            plane = root.tenant_plane()
+            ra = root.resolve({"x-keto-network": "a"})
+            rb = root.resolve({"x-keto-network": "b"})
+            t = T("doc:readme#viewer@alice")
+            ra.store().write_relation_tuples(t)
+            rb.store().write_relation_tuples(T("doc:readme#viewer@bob"))
+            # warm: compile the single-check shape once
+            assert ra.check_engine().check(t) is True
+            assert rb.check_engine().check(t) is False
+            gen0 = root._device_engine().generation \
+                if hasattr(root._device_engine(), "generation") else None
+            before = compilewatch.get().compiles_total
+            # lifecycle storm: create + OPL hot-reload + delete, with
+            # live checks between every step — all generation swaps
+            plane.create("c")
+            assert ra.check_engine().check(t) is True
+            plane.set_opl(
+                "c",
+                "class User implements Namespace {}\n"
+                "class doc implements Namespace {\n"
+                "  related: { viewer: User[]; }\n"
+                "}\n",
+            )
+            rc = root.resolve({"x-keto-network": "c"})
+            rc.store().write_relation_tuples(T("doc:readme#viewer@carl"))
+            assert rc.check_engine().check(
+                T("doc:readme#viewer@carl")
+            ) is True
+            assert rc.check_engine().check(t) is False
+            plane.delete("c")
+            assert ra.check_engine().check(t) is True
+            assert rb.check_engine().check(t) is False
+            after = compilewatch.get().compiles_total
+            assert after == before, (
+                f"tenant lifecycle compiled {after - before} program(s); "
+                "it must be a pure generation swap on warmed programs"
+            )
+            if gen0 is not None:
+                # the projection DID swap generations (the lifecycle was
+                # not a no-op that passed the gate vacuously)
+                assert root._device_engine().generation != gen0
+        finally:
+            root.close_engines()

@@ -439,7 +439,7 @@ class TestDebugSurfaces:
             "/debug/waves", "/debug/compiles", "/debug/projection",
             "/debug/mesh", "/debug/profile", "/debug/handoff",
             "/debug/slo", "/debug/fleet", "/debug/incidents",
-            "/debug/overload",
+            "/debug/overload", "/debug/tenants",
         }
         assert all(isinstance(v, str) and v for v in surfaces.values())
 
