@@ -297,6 +297,312 @@ def _hammer_shared(
     }
 
 
+def _hammer_stream_lane(
+    read_url: str, session_addr, requests, *, sessions: int,
+    block_rows: int, duration: float,
+) -> Dict[str, float]:
+    """Closed-loop streaming sessions over the raw framed lane
+    (server/session.py): each session thread pumps ``block_rows``-row
+    columnar blocks through its credit window and harvests verdict
+    blocks out-of-order.  Latency is per BLOCK (submit -> verdicts);
+    ``checks_per_sec`` counts rows."""
+    from ketotpu.sdk import KetoClient
+
+    lat: List[List[float]] = [[] for _ in range(sessions)]
+    rows_done = [0] * sessions
+    stop = threading.Event()
+    errors = [0]
+    blocks = [
+        requests[i: i + block_rows]
+        for i in range(0, len(requests) - block_rows + 1, block_rows)
+    ] or [requests]
+
+    def session_client(idx: int) -> None:
+        rng = np.random.default_rng(idx)
+        client = KetoClient(read_url, timeout=120.0)
+        my = lat[idx]
+        try:
+            with client.check_session(session_addr) as sess:
+                sent: Dict[int, float] = {}
+                while not stop.is_set():
+                    block = blocks[int(rng.integers(len(blocks)))]
+                    seq = sess.submit(block)
+                    sent[seq] = time.perf_counter()
+                    # harvest whatever the credit-window receive loop
+                    # already answered (out-of-order completion)
+                    for sq in list(sess._results):
+                        verdicts, errs = sess._results.pop(sq)
+                        t0 = sent.pop(sq, None)
+                        if verdicts is None or errs:
+                            errors[0] += 1
+                            continue
+                        if t0 is not None:
+                            my.append(time.perf_counter() - t0)
+                        rows_done[idx] += len(verdicts)
+                for sq, verdicts, errs in sess.results():
+                    t0 = sent.pop(sq, None)
+                    if verdicts is None or errs:
+                        errors[0] += 1
+                        continue
+                    if t0 is not None:
+                        my.append(time.perf_counter() - t0)
+                    rows_done[idx] += len(verdicts)
+        except Exception:  # noqa: BLE001 - a dead session is an error count
+            errors[0] += 1
+
+    threads = [
+        threading.Thread(target=session_client, args=(i,), daemon=True)
+        for i in range(sessions)
+    ]
+    t_start = time.perf_counter()
+    for t in threads:
+        t.start()
+    time.sleep(duration)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30.0)
+    elapsed = time.perf_counter() - t_start
+    all_lat = np.array([x for sub in lat for x in sub])
+    done = len(all_lat)
+    return {
+        "rps": round(done / elapsed, 1),
+        "checks_per_sec": round(sum(rows_done) / elapsed, 1),
+        "p50_ms": round(float(np.percentile(all_lat, 50)) * 1000, 2)
+        if done else -1.0,
+        "p99_ms": round(float(np.percentile(all_lat, 99)) * 1000, 2)
+        if done else -1.0,
+        "seconds": round(elapsed, 1),
+        "blocks": done,
+        "sessions": sessions,
+        "errors": errors[0],
+    }
+
+
+def _warm_shared_blocking(
+    target: str, requests, *, concurrency: int, rounds: int = 1,
+    channels: int = 64,
+) -> None:
+    """Blocking warm burst for the single-Check legs: ``concurrency``
+    clients each complete ``rounds`` full round trips with no time box,
+    so a burst that coalesces into a fresh pow2 wave bucket waits out
+    the resulting fused compile instead of leaving it in flight for the
+    timed pass (the time-boxed warm returns after N seconds regardless;
+    a ~90-120s XLA:CPU fused compile then lands inside the gate)."""
+    import grpc
+
+    from ketotpu.proto.services import CheckServiceStub
+
+    pool = [
+        grpc.insecure_channel(target)
+        for _ in range(max(1, min(channels, concurrency)))
+    ]
+    stubs = [CheckServiceStub(ch) for ch in pool]
+
+    def one(idx: int) -> None:
+        rng = np.random.default_rng(3000 + idx)
+        stub = stubs[idx % len(stubs)]
+        n_req = len(requests)
+        for _ in range(rounds):
+            try:
+                stub.Check(requests[int(rng.integers(n_req))])
+            except grpc.RpcError:
+                pass
+
+    threads = [
+        threading.Thread(target=one, args=(i,), daemon=True)
+        for i in range(concurrency)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600.0)
+    for ch in pool:
+        ch.close()
+
+
+def _warm_stream_lane(
+    read_url: str, session_addr, requests, *, sessions: int,
+    block_rows: int, rounds: int = 3, sweep: bool = True,
+) -> None:
+    """Verdict-BLOCKING warm for the streaming legs: every session pumps
+    a full credit window of blocks and waits for EVERY verdict before
+    the next round.  The merged-wave shapes the stream path produces
+    (sessions x credits blocks coalescing into one device wave) are
+    fresh jit buckets the batch legs never compile, and on XLA:CPU a
+    fused-wave compile runs 90s+ — a time-boxed warm pass returns with
+    the compile still in flight and the timed window then completes
+    zero blocks.  Blocking on verdicts makes warm exactly as slow as
+    the compiles it exists to absorb."""
+    from ketotpu.sdk import KetoClient
+
+    blocks = [
+        requests[i: i + block_rows]
+        for i in range(0, len(requests) - block_rows + 1, block_rows)
+    ] or [requests]
+
+    def one(idx: int) -> None:
+        rng = np.random.default_rng(1000 + idx)
+        client = KetoClient(read_url, timeout=600.0)
+        try:
+            with client.check_session(session_addr) as sess:
+                # small windows first so partially-merged wave buckets
+                # (1-2 blocks) compile too, then full credit windows
+                credits = max(1, sess.credits)
+                windows = [1, 2] + [credits] * rounds
+                for win in windows:
+                    seqs = [
+                        sess.submit(
+                            blocks[int(rng.integers(len(blocks)))]
+                        )
+                        for _ in range(win)
+                    ]
+                    for sq in seqs:
+                        sess.wait(sq)
+                if not sweep:
+                    return
+                # cache-priming sweep: every block exactly once (this
+                # session's share), so the timed pass measures the
+                # serving shell over a hot working set — on XLA:CPU a
+                # cold fused wave runs ~1s+, and whether the timed
+                # window catches hot or cold rows is otherwise a
+                # coin flip that whipsaws the stream-vs-batch ratio
+                share = blocks[idx::max(1, sessions)]
+                for i in range(0, len(share), credits):
+                    seqs = [
+                        sess.submit(b) for b in share[i: i + credits]
+                    ]
+                    for sq in seqs:
+                        sess.wait(sq)
+        except Exception:  # noqa: BLE001 - warm is best-effort
+            pass
+
+    threads = [
+        threading.Thread(target=one, args=(i,), daemon=True)
+        for i in range(sessions)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600.0)
+
+
+def _warm_grpc_batch(
+    target: str, requests, *, concurrency: int, block_rows: int,
+    rounds: int = 3,
+) -> None:
+    """Blocking warm for the per-connection BatchCheck baseline: each
+    client completes ``rounds`` full round trips (no time box), so any
+    fresh wave-bucket compile the baseline's own coalescing produces is
+    paid before its timed window — a stalled baseline would flatter the
+    stream-vs-batch ratio."""
+    import grpc
+
+    from ketotpu.api.proto_codec import tuple_to_proto
+    from ketotpu.proto import batch_service_pb2 as bs
+    from ketotpu.proto.services import CheckServiceStub
+
+    protos = [tuple_to_proto(t) for t in requests]
+    reqs = [
+        bs.BatchCheckRequest(tuples=protos[i: i + block_rows])
+        for i in range(0, len(protos) - block_rows + 1, block_rows)
+    ] or [bs.BatchCheckRequest(tuples=protos)]
+    pool = [grpc.insecure_channel(target)
+            for _ in range(max(1, min(8, concurrency)))]
+    stubs = [CheckServiceStub(ch) for ch in pool]
+
+    def one(idx: int) -> None:
+        rng = np.random.default_rng(2000 + idx)
+        stub = stubs[idx % len(stubs)]
+        for _ in range(rounds):
+            try:
+                stub.BatchCheck(reqs[int(rng.integers(len(reqs)))])
+            except grpc.RpcError:
+                pass
+
+    threads = [
+        threading.Thread(target=one, args=(i,), daemon=True)
+        for i in range(concurrency)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600.0)
+    for ch in pool:
+        ch.close()
+
+
+def _hammer_grpc_batch(
+    target: str, requests, *, concurrency: int, block_rows: int,
+    duration: float, channels: int = 32,
+) -> Dict[str, float]:
+    """Closed-loop gRPC BatchCheck clients at the SAME block size as the
+    streaming leg — the per-RPC baseline the session lane must beat
+    (every request re-enters admission, proto decode, and response
+    marshalling; a session pays those once)."""
+    import grpc
+
+    from ketotpu.api.proto_codec import tuple_to_proto
+    from ketotpu.proto import batch_service_pb2 as bs
+    from ketotpu.proto.services import CheckServiceStub
+
+    protos = [tuple_to_proto(t) for t in requests]
+    reqs = [
+        bs.BatchCheckRequest(tuples=protos[i: i + block_rows])
+        for i in range(0, len(protos) - block_rows + 1, block_rows)
+    ] or [bs.BatchCheckRequest(tuples=protos)]
+    pool = [
+        grpc.insecure_channel(target)
+        for _ in range(max(1, min(channels, concurrency)))
+    ]
+    stubs = [CheckServiceStub(ch) for ch in pool]
+    lat: List[List[float]] = [[] for _ in range(concurrency)]
+    rows_done = [0] * concurrency
+    stop = threading.Event()
+    errors = [0]
+
+    def client(idx: int) -> None:
+        rng = np.random.default_rng(idx)
+        stub = stubs[idx % len(stubs)]
+        my = lat[idx]
+        while not stop.is_set():
+            r = reqs[int(rng.integers(len(reqs)))]
+            t0 = time.perf_counter()
+            try:
+                resp = stub.BatchCheck(r)
+            except grpc.RpcError:
+                errors[0] += 1
+                continue
+            my.append(time.perf_counter() - t0)
+            rows_done[idx] += len(resp.results)
+
+    threads = [
+        threading.Thread(target=client, args=(i,), daemon=True)
+        for i in range(concurrency)
+    ]
+    t_start = time.perf_counter()
+    for t in threads:
+        t.start()
+    time.sleep(duration)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30.0)
+    elapsed = time.perf_counter() - t_start
+    for ch in pool:
+        ch.close()
+    all_lat = np.array([x for sub in lat for x in sub])
+    done = len(all_lat)
+    return {
+        "rps": round(done / elapsed, 1),
+        "checks_per_sec": round(sum(rows_done) / elapsed, 1),
+        "p50_ms": round(float(np.percentile(all_lat, 50)) * 1000, 2)
+        if done else -1.0,
+        "p99_ms": round(float(np.percentile(all_lat, 99)) * 1000, 2)
+        if done else -1.0,
+        "seconds": round(elapsed, 1),
+        "errors": errors[0],
+    }
+
+
 def run_northstar_bench(
     graph=None,
     *,
@@ -305,6 +611,7 @@ def run_northstar_bench(
     frontier: int = 16384,
     arena: int = 65536,
     fused_retry_lanes: int = 1,
+    max_wave: int = 0,
 ) -> Dict[str, float]:
     """North-star serving leg for the fused tiered dispatch
     (engine/fused.py): boot the daemon with ``engine.fused_dispatch`` ON,
@@ -346,7 +653,11 @@ def run_northstar_bench(
                 "fused_retry_lanes": int(fused_retry_lanes),
                 "frontier": frontier,
                 "arena": arena,
-                "max_batch": frontier,
+                # max_wave caps coalesced wave rows (CPU legs: fused
+                # wave exec is super-linear in Q on one core — a
+                # Q=512 general wave runs seconds while Q<=256 stays
+                # interactive); real chips take full-frontier waves
+                "max_batch": int(max_wave) or frontier,
                 "coalesce_ms": 2,
             },
             # the 4096-client leg must shed nothing: admission caps would
@@ -354,6 +665,12 @@ def run_northstar_bench(
             # fused compile takes minutes on XLA:CPU, so the per-request
             # deadline must not fail the warm-up checks
             "limit": {"max_inflight": 0, "request_timeout_ms": 0},
+            # streaming leg: enough dispatch workers that every session's
+            # full credit window can sit in the coalescer at once —
+            # blocks from concurrent sessions pack into shared waves
+            # (the default 4-worker pool caps global in-flight blocks
+            # and starves the wave window)
+            "session": {"dispatch_workers": 64, "max_sessions": 1024},
             "log": {"request_log": False},
         }
     )
@@ -403,6 +720,19 @@ def run_northstar_bench(
                 target, requests, concurrency=conc,
                 duration=max(2.0, duration * 0.4),
             )
+            # the time-boxed warm can leave a fused wave-bucket compile
+            # in flight; burst-and-block until a full round is
+            # compile-free before opening the gate
+            from ketotpu import compilewatch
+
+            cwatch = compilewatch.get()
+            for _ in range(5):
+                before_c = cwatch.compiles_total
+                _warm_shared_blocking(
+                    target, requests, concurrency=conc,
+                )
+                if cwatch.compiles_total == before_c:
+                    break
             with _steady(gate, f"serve_northstar_{conc}"):
                 h = _hammer_shared(
                     target, requests, concurrency=conc, duration=duration
@@ -411,6 +741,114 @@ def run_northstar_bench(
             out[f"northstar_{conc}_p50_ms"] = h["p50_ms"]
             out[f"northstar_{conc}_p99_ms"] = h["p99_ms"]
             out[f"northstar_{conc}_errors"] = h["errors"]
+
+        # -- streaming leg (ISSUE 19): persistent check sessions over the
+        # raw framed lane vs per-RPC BatchCheck at the same block size.
+        # A session is admitted ONCE and pays proto/admission once, so
+        # its row throughput must beat the per-request batch path.
+        session_addr = srv.addresses.get("session")
+        if session_addr is not None:
+            read_url = f"http://{host}:{port}"
+            block_rows = 64
+            stream_queries = synth_queries_mixed(graph, 4096, seed=7)
+
+            # zero-divergence oracle probe on the STREAM path: one
+            # session, one block, verdicts vs the host oracle
+            from ketotpu.sdk import KetoClient
+
+            probe_client = KetoClient(read_url, timeout=300.0)
+            with probe_client.check_session(session_addr) as psess:
+                sq = psess.submit(sample)
+                verdicts, errs = psess.wait(sq)
+            stream_div = (
+                len(sample) if verdicts is None or errs
+                else sum(1 for g, w in zip(verdicts, want) if g != w)
+            )
+            out["serve_stream_divergence"] = stream_div
+
+            w_before = ledger.stats() if ledger is not None else {}
+            blocks_total = 0
+            for conc in concurrencies:
+                # concurrency == in-flight ROWS: each session holds
+                # credits x block_rows rows in flight
+                sessions = max(1, conc // (block_rows * 8))
+                # which pow2 wave bucket a credit-window burst merges
+                # into is timing-dependent, and on XLA:CPU each fresh
+                # bucket is a ~90s fused compile — so warm until a full
+                # round adds ZERO compiles rather than a fixed count
+                from ketotpu import compilewatch
+
+                cwatch = compilewatch.get()
+                _warm_stream_lane(
+                    read_url, session_addr, stream_queries,
+                    sessions=sessions, block_rows=block_rows,
+                )
+                for _ in range(5):
+                    before_c = cwatch.compiles_total
+                    _warm_stream_lane(
+                        read_url, session_addr, stream_queries,
+                        sessions=sessions, block_rows=block_rows,
+                        rounds=1, sweep=False,
+                    )
+                    if cwatch.compiles_total == before_c:
+                        break
+                with _steady(gate, f"serve_stream_{conc}"):
+                    hs = _hammer_stream_lane(
+                        read_url, session_addr, stream_queries,
+                        sessions=sessions, block_rows=block_rows,
+                        duration=max(duration, 15.0),
+                    )
+                blocks_total += hs["blocks"]
+                out[f"serve_stream_{conc}_rps"] = hs["rps"]
+                out[f"serve_stream_{conc}_checks_per_sec"] = (
+                    hs["checks_per_sec"]
+                )
+                out[f"serve_stream_{conc}_p50_ms"] = hs["p50_ms"]
+                out[f"serve_stream_{conc}_p99_ms"] = hs["p99_ms"]
+                out[f"serve_stream_{conc}_sessions"] = sessions
+                out[f"serve_stream_{conc}_errors"] = hs["errors"]
+            if ledger is not None:
+                waves = (
+                    ledger.stats().get("waves_recorded", 0)
+                    - w_before.get("waves_recorded", 0)
+                )
+                out["serve_stream_blocks_per_wave"] = (
+                    round(blocks_total / waves, 2) if waves else 0.0
+                )
+
+            # per-CONNECTION baseline: the same number of clients, each
+            # a request-response BatchCheck loop at the same block size.
+            # A unary client holds ONE block in flight; a session holds
+            # a credit window's worth — that pipelining (plus paying
+            # admission/decode once) is the row-throughput the gate
+            # demands
+            top = max(concurrencies)
+            baseline_conc = max(1, top // (block_rows * 8))
+            _warm_grpc_batch(
+                target, stream_queries,
+                concurrency=baseline_conc, block_rows=block_rows,
+            )
+            for _ in range(5):
+                before_c = cwatch.compiles_total
+                _warm_grpc_batch(
+                    target, stream_queries,
+                    concurrency=baseline_conc, block_rows=block_rows,
+                    rounds=1,
+                )
+                if cwatch.compiles_total == before_c:
+                    break
+            hb = _hammer_grpc_batch(
+                target, stream_queries,
+                concurrency=baseline_conc,
+                block_rows=block_rows, duration=max(duration, 15.0),
+            )
+            out["serve_stream_batch_checks_per_sec"] = hb["checks_per_sec"]
+            out["serve_stream_batch_rps"] = hb["rps"]
+            stream_cps = out[f"serve_stream_{top}_checks_per_sec"]
+            out["serve_stream_vs_batch"] = (
+                round(stream_cps / hb["checks_per_sec"], 3)
+                if hb["checks_per_sec"] > 0 else 0.0
+            )
         steady = gate.get("steady_state_compiles", {})
         out["northstar_steady_state_compiles"] = int(sum(steady.values()))
         if steady:
@@ -2115,16 +2553,27 @@ if __name__ == "__main__":
             # XLA:CPU compiles chip-shaped fused programs minutes-slow;
             # the CI smoke leg shrinks the program (no retry lanes => no
             # boosted bodies) and still drives the whole fused path
-            kw = dict(frontier=4096, arena=16384, fused_retry_lanes=0)
+            kw = dict(frontier=4096, arena=16384, fused_retry_lanes=0,
+                      max_wave=256)
         res = run_northstar_bench(
             concurrencies=(conc,) if len(sys.argv) > 4 else (1024, 4096),
             duration=secs, **kw,
         )
         print(json.dumps(res))
-        sys.exit(
-            3 if res.get("northstar_steady_state_compiles")
-            or res.get("northstar_divergence") else 0
+        # streaming gates ride the northstar run: the session lane must
+        # answer exactly like the oracle AND beat per-RPC BatchCheck row
+        # throughput by >= 1.3x at the same block size (the whole point
+        # of paying admission/decode once per session)
+        bad = (
+            res.get("northstar_steady_state_compiles")
+            or res.get("northstar_divergence")
+            or res.get("serve_stream_divergence")
+            or (
+                "serve_stream_vs_batch" in res
+                and res["serve_stream_vs_batch"] < 1.3
+            )
         )
+        sys.exit(3 if bad else 0)
     elif len(sys.argv) > 3 and sys.argv[3] == "overload":
         res = run_overload_bench(duration=secs)
         print(json.dumps(res))

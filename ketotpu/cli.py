@@ -138,8 +138,9 @@ def cmd_serve(args) -> int:
     if getattr(args, "standby", False):
         return cmd_serve_standby(args)
     workers = int(getattr(args, "workers", 0) or 0)
-    if workers > 0:
-        return _serve_multiprocess(args, workers)
+    front_doors = int(getattr(args, "front_doors", 0) or 0)
+    if workers > 0 or front_doors > 0:
+        return _serve_multiprocess(args, workers, front_doors)
     cfg = Provider(config_file=args.config) if args.config else Provider()
     from ketotpu import faults
 
@@ -156,14 +157,21 @@ def cmd_serve(args) -> int:
     return 0
 
 
-def _serve_multiprocess(args, workers: int) -> int:
+def _serve_multiprocess(args, workers: int, front_doors: int = 0) -> int:
     """--workers N: one device-owner process (this one) + N SO_REUSEPORT
     worker daemons sharing the public ports (server/workers.py).
 
     The owner holds the JAX device and the real engine and serves
     batched check/expand over a unix socket; workers run the wire stack
     with engine.kind=remote.  All processes share the durable store DSN
-    — a ``memory`` DSN cannot span processes and is refused."""
+    — a ``memory`` DSN cannot span processes and is refused.
+
+    --front-doors N labels the first N children as streaming front
+    doors: each binds the SAME session-lane port via SO_REUSEPORT (the
+    kernel spreads incoming sessions across them) and exports
+    keto_front_door_* metrics under its door label.  A child beyond the
+    front-door count runs with its session lane disabled — it still
+    serves the 4 public ports, it just doesn't accept streams."""
     import subprocess
     import sys as _sys
     import tempfile
@@ -199,12 +207,37 @@ def _serve_multiprocess(args, workers: int) -> int:
         sock = os.path.join(sockdir, "engine.sock")
     host = EngineHostServer(reg, sock, health_fn=reg.health).start()
 
+    nchildren = max(workers, front_doors)
+    # front doors share ONE session-lane port via SO_REUSEPORT; a
+    # config of session.port=0 means each child would bind its own
+    # ephemeral lane, so the parent picks one concrete free port here
+    # and pins it into every front-door child via the env override
+    session_port = 0
+    if front_doors > 0:
+        session_port = int(cfg.get("session.port", 0) or 0)
+        if not session_port:
+            import socket as _socket
+
+            probe = _socket.socket()
+            probe.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+            probe.bind((cfg.listen_on("read")[0] or "", 0))
+            session_port = probe.getsockname()[1]
+            probe.close()
+
     def spawn(i: int) -> "subprocess.Popen":
+        env = dict(os.environ)
+        env.pop("KETO_FRONT_DOOR", None)
+        if front_doors > 0:
+            if i < front_doors:
+                env["KETO_FRONT_DOOR"] = str(i)
+                env["KETO_SESSION_PORT"] = str(session_port)
+            else:
+                env["KETO_SESSION_ENABLED"] = "false"
         return subprocess.Popen([
             _sys.executable, "-m", "ketotpu.cli", "serve",
             *(["-c", args.config] if args.config else []),
             "--worker-of", sock,
-        ])
+        ], env=env)
 
     # SIGTERM (systemd, k8s, supervisors) must tear the fleet down the
     # same way ^C does: the default handler would kill only the owner
@@ -216,12 +249,19 @@ def _serve_multiprocess(args, workers: int) -> int:
 
     signal.signal(signal.SIGTERM, _sigterm)
 
-    sup = WorkerSupervisor(spawn, workers, log=log.warning)
+    sup = WorkerSupervisor(spawn, nchildren, log=log.warning)
     # the owner's health (served to workers over the socket's "health"
     # op) reports `degraded` while any worker is down/respawning, so
     # `status --block` can tell a degraded topology from a dead one
     reg.readiness_checks["workers"] = sup.state
-    log.info("engine host on %s; forking %d workers", sock, workers)
+    if front_doors > 0:
+        log.info(
+            "engine host on %s; forking %d workers (%d front doors, "
+            "session lane :%d)", sock, nchildren, front_doors,
+            session_port,
+        )
+    else:
+        log.info("engine host on %s; forking %d workers", sock, nchildren)
     sup.start()
     rc = 0
     try:
@@ -404,12 +444,88 @@ def _batch_check_lines(path: str):
     return tuples
 
 
+def _check_stream(args) -> int:
+    """check --stream FILE.jsonl: the whole file rides ONE StreamCheck
+    session — admitted once at the handshake, blocks pipelined through
+    the credit window, verdict blocks collected out-of-order and
+    printed back in request order."""
+    from ketotpu.api.proto_codec import tuple_to_proto
+    from ketotpu.proto import stream_service_pb2 as ss
+    from ketotpu.proto.services import CheckServiceStub
+
+    try:
+        tuples = _batch_check_lines(args.stream)
+    except (OSError, KetoAPIError) as e:
+        print(f"Could not read stream file: {e}", file=sys.stderr)
+        return 1
+    if not tuples:
+        print("stream file holds no tuples", file=sys.stderr)
+        return 1
+    rows = 256  # well under the default session.max_block_rows
+    blocks = [tuples[i:i + rows] for i in range(0, len(tuples), rows)]
+
+    def requests():
+        yield ss.StreamCheckRequest(
+            open=True,
+            snaptoken=args.snaptoken or "",
+            latest=bool(args.latest),
+            max_depth=args.max_depth,
+        )
+        for seq, block in enumerate(blocks):
+            yield ss.StreamCheckRequest(
+                seq=seq, tuples=[tuple_to_proto(t) for t in block]
+            )
+        yield ss.StreamCheckRequest(close=True)
+
+    answered = {}
+    with _channel(args.read_remote, args) as ch:
+        for resp in CheckServiceStub(ch).StreamCheck(requests()):
+            if resp.session:
+                continue  # handshake grant
+            if resp.error and not resp.results:
+                if not answered and resp.status in (429, 503, 507):
+                    # session refused at the handshake — nothing ran
+                    hint = (f" (retry after {resp.retry_after_s}s)"
+                            if resp.retry_after_s else "")
+                    print(
+                        f"Refused({resp.status})\t{resp.error}{hint}",
+                        file=sys.stderr,
+                    )
+                    return 1
+                answered[int(resp.seq)] = resp
+                continue
+            answered[int(resp.seq)] = resp
+    all_ok = True
+    for seq, block in enumerate(blocks):
+        resp = answered.get(seq)
+        if resp is None:
+            all_ok = False
+            for t in block:
+                print(f"Error(503)\t{t}\tno verdict (stream cut)")
+            continue
+        if resp.error and not resp.results:
+            all_ok = False
+            for t in block:
+                print(f"Error({resp.status or 500})\t{t}\t{resp.error}")
+            continue
+        for t, item in zip(block, resp.results):
+            if item.error:
+                all_ok = False
+                print(f"Error({item.status or 500})\t{t}\t{item.error}")
+            else:
+                all_ok = all_ok and item.allowed
+                print(("Allowed" if item.allowed else "Denied") + f"\t{t}")
+    return 0 if all_ok else 1
+
+
 def cmd_check(args) -> int:
     from ketotpu.api.proto_codec import subject_to_proto, tuple_to_proto
     from ketotpu.proto import check_service_pb2 as cs
     from ketotpu.proto import relation_tuples_pb2 as rts
     from ketotpu.proto.services import CheckServiceStub
 
+    if getattr(args, "stream", ""):
+        return _check_stream(args)
     if args.batch:
         # one BatchCheck RPC for the whole file: per-item verdicts come
         # back in request order, a bad line only fails its own item
@@ -1257,6 +1373,13 @@ def build_parser() -> argparse.ArgumentParser:
              "(needs a shared durable dsn)",
     )
     serve.add_argument(
+        "--front-doors", type=int, default=0, metavar="N",
+        help="label the first N worker children as streaming front "
+             "doors sharing one SO_REUSEPORT session-lane port "
+             "(implies the --workers topology; needs a shared durable "
+             "dsn)",
+    )
+    serve.add_argument(
         "--worker-of", metavar="SOCKET", default="",
         help="internal: run as a worker forwarding to the device owner "
              "at SOCKET",
@@ -1280,6 +1403,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="check every relation tuple in FILE.jsonl (JSON object or "
              "'Ns:obj#rel@subject' string per line; '-' = stdin) in ONE "
              "BatchCheck RPC; prints one verdict line per tuple",
+    )
+    check.add_argument(
+        "--stream", default="",
+        help="check every relation tuple in FILE.jsonl over ONE "
+             "streaming session (gRPC StreamCheck): admitted once, "
+             "blocks pipelined, verdicts printed in request order",
     )
     check.add_argument(
         "--snaptoken", default="",

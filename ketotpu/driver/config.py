@@ -412,6 +412,25 @@ def _defaults() -> Dict[str, Any]:
                 "cooldown_ms": 2000,
             },
         },
+        # streaming check sessions (server/session.py): the raw TCP lane
+        # + gRPC StreamCheck share one broker.  A session is admitted
+        # ONCE at the handshake for `units` interactive weight; blocks
+        # never re-enter admission.  port 0 = ephemeral (discover via
+        # Server.addresses["session"]); host "" = follow serve.read.
+        # credits bounds blocks in flight per session (the backpressure
+        # window), max_block_rows bounds one block, dispatch_workers
+        # sizes the shared decode/dispatch pool.
+        "session": {
+            "enabled": True,
+            "host": "",
+            "port": 0,
+            "max_sessions": 256,
+            "credits": 8,
+            "max_block_rows": 4096,
+            "units": 256,
+            "idle_timeout_ms": 30000,
+            "dispatch_workers": 4,
+        },
     }
 
 
@@ -506,7 +525,9 @@ class Provider:
                           "baseline_waves", "drift_pct", "incident_cap",
                           "burn_threshold", "auto_profile",
                           "profile_cooldown_s", "default_network",
-                          "max_tenants", "write_rate", "max_tuples"):
+                          "max_tenants", "write_rate", "max_tuples",
+                          "max_sessions", "max_block_rows",
+                          "idle_timeout_ms", "dispatch_workers"):
                 suffix = known.split("_")
                 if len(joined) > len(suffix) and joined[-len(suffix):] == suffix:
                     joined = joined[: -len(suffix)] + [known]
@@ -751,6 +772,21 @@ class Provider:
             if not isinstance(val, (int, float)) or val < 0:
                 raise ConfigError(
                     key, f"must be a non-negative number, got {val!r}"
+                )
+        if not isinstance(self.get("session.enabled", True), bool):
+            raise ConfigError("session.enabled", "must be a boolean")
+        if not isinstance(self.get("session.host", ""), str):
+            raise ConfigError("session.host", "must be a string")
+        val = self.get("session.port", 0)
+        if not isinstance(val, int) or not (0 <= val < 65536):
+            raise ConfigError("session.port", f"invalid port {val!r}")
+        for key in ("session.max_sessions", "session.credits",
+                    "session.max_block_rows", "session.units",
+                    "session.idle_timeout_ms", "session.dispatch_workers"):
+            val = self.get(key, 1)
+            if not isinstance(val, int) or val < 1:
+                raise ConfigError(
+                    key, f"must be a positive integer, got {val!r}"
                 )
         ns = v.get("namespaces")
         if isinstance(ns, dict):

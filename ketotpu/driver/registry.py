@@ -97,6 +97,7 @@ class Registry:
         self._admission = None
         self._overload = None
         self._overload_built = False
+        self._session_broker = None
         self._mapper = None
         self._ro_mapper = None
         self._uuid_mapper = None
@@ -1124,6 +1125,20 @@ class Registry:
                     )
             return self._overload
 
+    def session_broker(self):
+        """Shared streaming-session broker (server/session.py): one per
+        ROOT registry — the raw TCP lane and the gRPC StreamCheck
+        servicer admit/dispatch through the same object, so session caps
+        and credits hold across transports.  None when disabled."""
+        if not bool(self.config.get("session.enabled", True)):
+            return None
+        with self._lock:
+            if self._session_broker is None:
+                from ketotpu.server.session import SessionBroker
+
+                self._session_broker = SessionBroker(self)
+            return self._session_broker
+
     def retry_after_hint(self) -> str:
         """Load-derived, jittered Retry-After seconds for 429/503
         responses (str, for direct header use); "1" when the overload
@@ -1714,6 +1729,13 @@ class Registry:
                 t._shadow for t in self._tenants.values()
             ]
             watchdogs = [self._watchdog, self._overload]
+            broker = self._session_broker
+            self._session_broker = None
+        if broker is not None:
+            try:
+                broker.shutdown()
+            except Exception:  # noqa: BLE001 - shutdown must not raise
+                pass
         for eng in engines + hubs + shadows + watchdogs:
             close = getattr(eng, "close", None)
             if close is not None:

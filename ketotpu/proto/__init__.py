@@ -24,6 +24,7 @@ from ory.keto.relation_tuples.v1alpha2 import (  # noqa: E402,F401
     namespaces_service_pb2,
     read_service_pb2,
     relation_tuples_pb2,
+    stream_service_pb2,
     version_pb2,
     watch_service_pb2,
     write_service_pb2,

@@ -1,0 +1,97 @@
+# -*- coding: utf-8 -*-
+# source: ory/keto/relation_tuples/v1alpha2/stream_service.proto
+"""Protobuf bindings for the StreamCheck session wire messages.
+
+The streaming check session is an EXTENSION over the vendored reference
+contract (Keto at this version has no streaming RPCs), so there is no
+upstream generated module to vendor.  Like batch_service_pb2, the module
+assembles the FileDescriptorProto programmatically and feeds it through
+the exact AddSerializedFile + builder path protoc output uses.  The
+human-readable source lives at
+proto/ory/keto/relation_tuples/v1alpha2/stream_service.proto.
+
+Only messages are declared here: the RPC itself rides on the EXISTING
+CheckService (as a StreamCheck bidi method) — the method registration
+authority is ketotpu.proto.services.SERVICES, which gRPC consults
+instead of the descriptor pool.
+"""
+from google.protobuf import descriptor_pb2 as _dpb
+from google.protobuf import descriptor_pool as _descriptor_pool
+from google.protobuf import symbol_database as _symbol_database
+from google.protobuf.internal import builder as _builder
+
+_sym_db = _symbol_database.Default()
+
+# dependencies must be registered in the pool before this file is added
+from ory.keto.relation_tuples.v1alpha2 import relation_tuples_pb2 as ory_dot_keto_dot_relation__tuples_dot_v1alpha2_dot_relation__tuples__pb2  # noqa: E501,F401
+from ory.keto.relation_tuples.v1alpha2 import batch_service_pb2 as ory_dot_keto_dot_relation__tuples_dot_v1alpha2_dot_batch__service__pb2  # noqa: E501,F401
+
+_PKG = "ory.keto.relation_tuples.v1alpha2"
+_F = _dpb.FieldDescriptorProto
+
+
+def _file_descriptor() -> bytes:
+    fd = _dpb.FileDescriptorProto()
+    fd.name = "ory/keto/relation_tuples/v1alpha2/stream_service.proto"
+    fd.package = _PKG
+    fd.syntax = "proto3"
+    fd.dependency.append(
+        "ory/keto/relation_tuples/v1alpha2/relation_tuples.proto"
+    )
+    fd.dependency.append(
+        "ory/keto/relation_tuples/v1alpha2/batch_service.proto"
+    )
+
+    def field(msg, name, number, ftype, type_name="", repeated=False):
+        f = msg.field.add()
+        f.name = name
+        f.number = number
+        f.label = _F.LABEL_REPEATED if repeated else _F.LABEL_OPTIONAL
+        f.type = ftype
+        if type_name:
+            f.type_name = type_name
+        f.json_name = name
+        return f
+
+    req = fd.message_type.add()
+    req.name = "StreamCheckRequest"
+    # handshake (first message only): session-wide consistency mode +
+    # requested admission weight
+    field(req, "open", 1, _F.TYPE_BOOL)
+    field(req, "units", 2, _F.TYPE_UINT32)
+    field(req, "snaptoken", 3, _F.TYPE_STRING)
+    field(req, "latest", 4, _F.TYPE_BOOL)
+    field(req, "max_depth", 5, _F.TYPE_INT32)
+    # block: per-session sequence number + the columnar tuple payload
+    field(req, "seq", 6, _F.TYPE_UINT64)
+    field(req, "tuples", 7, _F.TYPE_MESSAGE, f".{_PKG}.RelationTuple",
+          repeated=True)
+    field(req, "close", 8, _F.TYPE_BOOL)
+
+    resp = fd.message_type.add()
+    resp.name = "StreamCheckResponse"
+    # handshake reply: session id + granted block credits; error/status
+    # carry a REFUSAL (brownout 429, session cap 507) with the
+    # retry_after_s backoff hint
+    field(resp, "session", 1, _F.TYPE_STRING)
+    field(resp, "credits", 2, _F.TYPE_UINT32)
+    field(resp, "max_block_rows", 3, _F.TYPE_UINT32)
+    # verdict block: seq echoes the request block; results are
+    # row-aligned with its tuples (per-item error isolation)
+    field(resp, "seq", 4, _F.TYPE_UINT64)
+    field(resp, "results", 5, _F.TYPE_MESSAGE,
+          f".{_PKG}.BatchCheckResponseItem", repeated=True)
+    field(resp, "snaptoken", 6, _F.TYPE_STRING)
+    field(resp, "error", 7, _F.TYPE_STRING)
+    field(resp, "status", 8, _F.TYPE_INT32)
+    field(resp, "retry_after_s", 9, _F.TYPE_UINT32)
+    return fd.SerializeToString()
+
+
+DESCRIPTOR = _descriptor_pool.Default().AddSerializedFile(_file_descriptor())
+
+_builder.BuildMessageAndEnumDescriptors(DESCRIPTOR, globals())
+_builder.BuildTopDescriptorsAndMessages(
+    DESCRIPTOR, "ory.keto.relation_tuples.v1alpha2.stream_service_pb2",
+    globals(),
+)

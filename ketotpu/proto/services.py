@@ -30,6 +30,7 @@ from ketotpu.proto import (
     health_pb2,
     namespaces_service_pb2,
     read_service_pb2,
+    stream_service_pb2,
     syntax_service_pb2,
     version_pb2,
     watch_service_pb2,
@@ -52,6 +53,17 @@ SERVICES: Dict[str, Dict[str, Tuple[Type, Type]]] = {
         "BatchCheck": (
             batch_service_pb2.BatchCheckRequest,
             batch_service_pb2.BatchCheckResponse,
+        ),
+        # EXTENSION: streaming check session — one bidi stream per
+        # session, admitted ONCE at the handshake; the client pumps
+        # columnar blocks with per-block sequence numbers and verdict
+        # blocks come back out-of-order as engine waves complete
+        # (proto/ory/keto/relation_tuples/v1alpha2/stream_service.proto,
+        # server/session.py)
+        "StreamCheck": (
+            stream_service_pb2.StreamCheckRequest,
+            stream_service_pb2.StreamCheckResponse,
+            "bidi_stream",
         ),
     },
     f"{_RTS}.ExpandService": {
@@ -136,11 +148,12 @@ def add_servicer_to_server(service_name: str, servicer, server) -> None:
     handlers = {}
     for method, spec in methods.items():
         req_t, resp_t = spec[0], spec[1]
-        make = (
-            grpc.unary_stream_rpc_method_handler
-            if "server_stream" in spec[2:]
-            else grpc.unary_unary_rpc_method_handler
-        )
+        if "bidi_stream" in spec[2:]:
+            make = grpc.stream_stream_rpc_method_handler
+        elif "server_stream" in spec[2:]:
+            make = grpc.unary_stream_rpc_method_handler
+        else:
+            make = grpc.unary_unary_rpc_method_handler
         handlers[method] = make(
             getattr(servicer, method),
             request_deserializer=req_t.FromString,
@@ -152,16 +165,18 @@ def add_servicer_to_server(service_name: str, servicer, server) -> None:
 
 
 class _Stub:
-    """Client stub: one callable per RPC method (unary or server-stream)."""
+    """Client stub: one callable per RPC method (unary, server-stream,
+    or bidi-stream)."""
 
     def __init__(self, channel: grpc.Channel, service_name: str):
         for method, spec in SERVICES[service_name].items():
             req_t, resp_t = spec[0], spec[1]
-            make = (
-                channel.unary_stream
-                if "server_stream" in spec[2:]
-                else channel.unary_unary
-            )
+            if "bidi_stream" in spec[2:]:
+                make = channel.stream_stream
+            elif "server_stream" in spec[2:]:
+                make = channel.unary_stream
+            else:
+                make = channel.unary_unary
             setattr(
                 self,
                 method,

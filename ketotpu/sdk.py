@@ -577,6 +577,40 @@ class KetoClient:
         if status != 204:
             self._raise_for(status, out)
 
+    # -- streaming sessions --------------------------------------------------
+
+    def check_session(
+        self,
+        addr: Tuple[str, int],
+        *,
+        units: int = 0,
+        consistency: Optional[str] = None,
+        max_depth: int = 0,
+        metadata: Optional[dict] = None,
+    ) -> "CheckSession":
+        """Open a streaming check session on the server's raw TCP session
+        lane (server/session.py; address = ``Server.addresses["session"]``
+        or the pinned ``session.port``).  Use as a context manager::
+
+            with client.check_session((host, port)) as sess:
+                for verdicts in sess.stream(blocks):   # in-order
+                    ...
+                # or out-of-order: seq = sess.submit(tuples);
+                # sess.results() yields (seq, verdicts, errors)
+
+        The session is admitted ONCE at the handshake (``units`` of
+        interactive weight; 0 = server default) and shares one
+        consistency mode (``consistency`` is ``"latest"`` or a
+        snaptoken).  Handshake refusals (brownout/cap) are retried
+        within this client's retry budget, honoring the server's
+        Retry-After hint; a connection lost mid-stream reconnects the
+        same way and REPLAYS every unacknowledged block — verdicts are
+        acks, so no submitted block is ever silently lost."""
+        return CheckSession(
+            self, addr, units=units, consistency=consistency,
+            max_depth=max_depth, metadata=metadata,
+        )
+
     # -- watch --------------------------------------------------------------
 
     def watch(
@@ -671,6 +705,296 @@ class KetoClient:
         if status != 200:
             self._raise_for(status, body)
         return json.loads(body)["version"]
+
+
+class CheckSession:
+    """Client half of the streaming session lane (see
+    :meth:`KetoClient.check_session`).
+
+    Synchronous, single-threaded: ``submit`` sends a block (blocking only
+    when the server's credit window is full — it then reads one verdict
+    to free a slot), ``results`` drains verdicts out of order,
+    ``stream`` is the in-order convenience.  Every submitted block stays
+    in ``_unacked`` until its verdict frame arrives; a dropped
+    connection reconnects (retry-budget aware, Retry-After honored) and
+    replays the unacked blocks on the fresh session."""
+
+    def __init__(self, client: KetoClient, addr: Tuple[str, int], *,
+                 units: int = 0, consistency: Optional[str] = None,
+                 max_depth: int = 0, metadata: Optional[dict] = None):
+        self._client = client
+        self._addr = (str(addr[0]), int(addr[1]))
+        self._units = int(units)
+        self._latest = consistency == "latest"
+        self._snaptoken = "" if self._latest else str(consistency or "")
+        self._max_depth = int(max_depth)
+        self._metadata = dict(metadata or {})
+        self._sock: Optional[object] = None
+        self._rfile = None
+        self._seq = 0
+        self._unacked: dict = {}     # seq -> (meta, arrays) to replay
+        self._results: dict = {}     # seq -> (verdicts, errors) done
+        self.session_id = ""
+        self.credits = 1
+        self.max_block_rows = 1 << 30
+        self.reconnects = 0          # observability
+        self._connect(replay=False)
+
+    # -- context manager ----------------------------------------------
+
+    def __enter__(self) -> "CheckSession":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- transport ----------------------------------------------------
+
+    def _connect(self, *, replay: bool) -> None:
+        import socket as _socket
+
+        from ketotpu.server import wire
+
+        attempt = 0
+        while True:
+            try:
+                sock = _socket.create_connection(
+                    self._addr, timeout=self._client.timeout)
+                sock.setsockopt(
+                    _socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+                hello: dict = {
+                    "op": "hello", "v": 1, "units": self._units,
+                    "snaptoken": self._snaptoken, "latest": self._latest,
+                    "max_depth": self._max_depth,
+                }
+                if self._metadata:
+                    hello["metadata"] = self._metadata
+                wire.send_frame(sock, hello)
+                rfile = sock.makefile("rb")
+                got = wire.recv_frame(rfile)
+                if got is None:
+                    raise SDKError(503, "session lane closed at handshake")
+                meta, _, _ = got
+                if meta.get("ok"):
+                    self._sock, self._rfile = sock, rfile
+                    self.session_id = str(meta.get("session", ""))
+                    self.credits = int(meta.get("credits", 1)) or 1
+                    self.max_block_rows = int(
+                        meta.get("max_block_rows", 0)) or (1 << 30)
+                    break
+                sock.close()
+                status = int(meta.get("status", 503))
+                headers = {"retry-after": meta.get("retry_after", 0)}
+                err = str(meta.get("error", "session refused"))
+            except OSError as e:
+                status, headers, err = 503, {}, str(e)
+            # refusal/conn-failure: cooperative retry, same protocol as
+            # the HTTP front door (budget + jittered Retry-After)
+            if (attempt >= self._client.max_retries
+                    or status not in (429, 503, 507)
+                    or not self._client.retry_budget.allow_retry()):
+                raise SDKError(status, err)
+            time.sleep(min(
+                self._client._retry_delay(headers, attempt),
+                max(0.0, self._client.timeout),
+            ))
+            self._client.retries += 1
+            attempt += 1
+        if replay:
+            self.reconnects += 1
+            for seq in sorted(self._unacked):
+                meta, arrays = self._unacked[seq]
+                self._send(meta, arrays, may_reconnect=False)
+
+    def _send(self, meta: dict, arrays, *, may_reconnect: bool = True):
+        from ketotpu.server import wire
+
+        try:
+            wire.send_frame(self._sock, meta, arrays)
+        except OSError:
+            if not may_reconnect:
+                raise
+            self._reconnect()
+
+    def _reconnect(self) -> None:
+        try:
+            if self._sock is not None:
+                self._sock.close()
+        except OSError:
+            pass
+        self._sock = self._rfile = None
+        self._connect(replay=True)
+
+    def _recv_one(self) -> bool:
+        """Read ONE frame; file verdicts/errors; False when the current
+        connection died (after reconnect+replay)."""
+        from ketotpu.server import wire
+
+        try:
+            got = wire.recv_frame(self._rfile)
+        except (OSError, wire.WireError):
+            got = None
+        if got is None:
+            if not self._unacked:
+                raise SDKError(503, "session lane closed")
+            self._reconnect()
+            return False
+        meta, arrays, _ = got
+        op = meta.get("op")
+        if op == "verdicts":
+            seq = int(meta["seq"])
+            errors = {
+                int(row): (str(msg), int(code))
+                for row, msg, code in meta.get("errs") or ()
+            }
+            ok = arrays.get("ok")
+            verdicts = [bool(v) for v in ok.tolist()] if ok is not None \
+                else []
+            if meta.get("snaptoken"):
+                self._client.last_snaptoken = meta["snaptoken"]
+            self._unacked.pop(seq, None)
+            self._results[seq] = (verdicts, errors)
+            return True
+        if op == "error":
+            seq = int(meta.get("seq", -1))
+            self._unacked.pop(seq, None)
+            self._results[seq] = (
+                None,
+                {-1: (str(meta.get("error", "block failed")),
+                      int(meta.get("status", 500)))},
+            )
+            return True
+        return True                  # pong/bye/unknown: ignore
+
+    # -- encoding ------------------------------------------------------
+
+    @staticmethod
+    def _encode(tuples: Sequence) -> Tuple[int, dict]:
+        import numpy as np
+
+        from ketotpu.server import wire
+
+        parsed = [
+            RelationTuple.from_string(t) if isinstance(t, str) else t
+            for t in tuples
+        ]
+        n = len(parsed)
+        skind = np.zeros(n, dtype=np.uint8)
+        ns, obj, rel = [], [], []
+        sa, sb, sc = [], [], []
+        for i, t in enumerate(parsed):
+            ns.append(t.namespace)
+            obj.append(t.object)
+            rel.append(t.relation)
+            s = t.subject
+            if isinstance(s, SubjectSet):
+                skind[i] = 1
+                sa.append(s.namespace)
+                sb.append(s.object)
+                sc.append(s.relation or "")
+            else:
+                sa.append(s.id)
+                sb.append("")
+                sc.append("")
+        arrays = {"skind": skind}
+        for name, col in (("ns", ns), ("obj", obj), ("rel", rel),
+                          ("sa", sa), ("sb", sb), ("sc", sc)):
+            wire.pack_strcol(arrays, name, col)
+        return n, arrays
+
+    # -- public API ----------------------------------------------------
+
+    def submit(self, tuples: Sequence, *, max_depth: int = 0,
+               deadline_ms: int = 0) -> int:
+        """Send one block (``RelationTuple`` objects or canonical
+        strings); returns its seq.  Blocks only while the credit window
+        is full — then drains one verdict first."""
+        if not tuples:
+            raise BadRequestError("empty block")
+        if len(tuples) > self.max_block_rows:
+            raise BadRequestError(
+                f"block of {len(tuples)} rows exceeds server cap "
+                f"{self.max_block_rows}")
+        while len(self._unacked) >= self.credits:
+            self._recv_one()
+        n, arrays = self._encode(tuples)
+        seq = self._seq
+        self._seq += 1
+        meta = {"op": "block", "seq": seq, "n": n}
+        if max_depth:
+            meta["max_depth"] = int(max_depth)
+        if deadline_ms:
+            meta["deadline_ms"] = int(deadline_ms)
+        self._unacked[seq] = (meta, arrays)
+        self._send(meta, arrays)
+        return seq
+
+    def results(self):
+        """Yield ``(seq, verdicts, errors)`` OUT OF ORDER as verdict
+        frames arrive, until every submitted block is answered.
+        ``verdicts`` is None for a block-level failure (its error rides
+        in ``errors[-1]``)."""
+        while self._results or self._unacked:
+            while not self._results:
+                self._recv_one()
+            seq = next(iter(self._results))
+            verdicts, errors = self._results.pop(seq)
+            yield seq, verdicts, errors
+
+    def wait(self, seq: int):
+        """Block until ``seq``'s verdicts arrive; returns
+        ``(verdicts, errors)``."""
+        while seq not in self._results:
+            if seq not in self._unacked:
+                raise BadRequestError(f"unknown seq {seq}")
+            self._recv_one()
+        return self._results.pop(seq)
+
+    def stream(self, blocks, *, max_depth: int = 0):
+        """Iterator in, verdicts out: submit each block from the
+        iterable, yield each block's verdict list IN submission order
+        (pipelined up to the credit window).  A block-level failure
+        raises :class:`SDKError`."""
+        pending: List[int] = []
+
+        def pop_front():
+            verdicts, errors = self.wait(pending.pop(0))
+            if verdicts is None:
+                msg, code = errors.get(-1, ("block failed", 500))
+                raise SDKError(code, msg)
+            return verdicts
+
+        for block in blocks:
+            pending.append(self.submit(block, max_depth=max_depth))
+            # keep at most a window's worth pending so verdicts flow
+            # out while blocks flow in
+            while len(pending) > max(1, self.credits - 1):
+                yield pop_front()
+        while pending:
+            yield pop_front()
+
+    def close(self) -> None:
+        """Graceful end: drain, say goodbye, drop the socket."""
+        from ketotpu.server import wire
+
+        if self._sock is None:
+            return
+        try:
+            for _ in self.results():
+                pass
+            wire.send_frame(self._sock, {"op": "end"})
+            while True:
+                got = wire.recv_frame(self._rfile)
+                if got is None or got[0].get("op") == "bye":
+                    break
+        except (OSError, wire.WireError, SDKError):
+            pass
+        finally:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = self._rfile = None
 
 
 def _error_message(body: str) -> str:

@@ -23,6 +23,7 @@ period (daemon.go:109-135).
 
 from __future__ import annotations
 
+import os
 import socket
 import ssl
 import threading
@@ -234,6 +235,7 @@ class Server:
         self._threads: List[threading.Thread] = []
         self.addresses: Dict[str, Tuple[str, int]] = {}
         self._engine_host = None
+        self._session_lane = None
         self._stopped = threading.Event()
         # anonymized usage telemetry (daemon.go:64-98 seam): inert unless
         # sqa.server_url is configured AND the operator did not opt out.
@@ -380,6 +382,30 @@ class Server:
         self.addresses["metrics"] = httpd.server_address[:2]
         self.logger.info("serving metrics on %s:%d", *self.addresses["metrics"])
 
+        # streaming session lane (server/session.py): raw TCP, wire.py
+        # frames, one admission acquire per session.  Ephemeral by
+        # default (session.port 0) — discover via addresses["session"].
+        # SO_REUSEPORT rides self.reuse_port so front-door workers can
+        # share one pinned lane port.
+        broker = r.session_broker()
+        if broker is not None and broker.enabled:
+            from ketotpu.server.session import SessionLane
+
+            lane_host = str(r.config.get("session.host") or "") \
+                or r.config.listen_on("read")[0]
+            lane_port = int(r.config.get("session.port", 0) or 0)
+            self._session_lane = SessionLane(
+                broker, lane_host, lane_port,
+                reuse_port=self.reuse_port,
+                front_door=str(os.environ.get("KETO_FRONT_DOOR", "")),
+            )
+            self._session_lane.start()
+            self.addresses["session"] = self._session_lane.address
+            self.logger.info(
+                "serving session lane on %s:%d",
+                *self.addresses["session"],
+            )
+
         # replication channel: a single-process daemon that owns the device
         # engine publishes the engine-host socket when durability.socket is
         # configured, so a warm standby can bootstrap + tail it (the same
@@ -414,6 +440,12 @@ class Server:
     def stop(self, grace: float = 5.0) -> None:
         if self.sqa is not None:
             self.sqa.close()
+        if self._session_lane is not None:
+            try:
+                self._session_lane.stop()
+            except Exception:  # noqa: BLE001 - shutdown must not raise
+                pass
+            self._session_lane = None
         if self._engine_host is not None:
             try:
                 self._engine_host.stop()

@@ -23,6 +23,8 @@ Behavioral parity notes (each encoded below, with the reference site):
 
 from __future__ import annotations
 
+import queue
+import threading
 import time
 from contextlib import contextmanager
 
@@ -62,6 +64,7 @@ from ketotpu.proto import (
     expand_service_pb2,
     namespaces_service_pb2,
     read_service_pb2,
+    stream_service_pb2,
     syntax_service_pb2,
     version_pb2,
     watch_service_pb2,
@@ -660,6 +663,125 @@ class CheckHandler:
                 return resp
         except Exception as e:  # noqa: BLE001
             _abort(context, e)
+
+    # gRPC CheckService.StreamCheck (EXTENSION — stream_service.proto):
+    # one bidi stream per session.  The handshake admits the WHOLE
+    # session (server/session.py broker, interactive class, tenant
+    # resolved once); blocks then bypass the admission interceptor
+    # (streaming handlers pass through it untouched) and verdict blocks
+    # come back out of order as engine waves complete — `seq` is the
+    # correlation key.
+    def StreamCheck(self, request_iterator, context):
+        from ketotpu.server.session import SessionRefused
+
+        resp_t = stream_service_pb2.StreamCheckResponse
+        md = _md(context)
+        broker = self.r.session_broker()
+        if broker is None or not broker.enabled:
+            yield resp_t(error="streaming sessions disabled", status=503)
+            return
+        first = next(request_iterator, None)
+        if first is None or not first.open:
+            yield resp_t(
+                error="first stream message must set open=true",
+                status=400,
+            )
+            return
+        try:
+            s = broker.open(
+                md,
+                units=int(first.units),
+                snaptoken=str(first.snaptoken or ""),
+                latest=bool(first.latest),
+                max_depth=int(first.max_depth),
+                transport="grpc",
+            )
+        except SessionRefused as e:
+            try:
+                context.set_trailing_metadata(
+                    (("retry-after", str(int(e.retry_after))),)
+                )
+            except Exception:  # noqa: BLE001 - hint is advisory
+                pass
+            yield resp_t(
+                error=str(e), status=e.status,
+                retry_after_s=int(e.retry_after),
+            )
+            return
+
+        outq: "queue.Queue" = queue.Queue()
+
+        def done(seq, allowed, n, errs, exc):
+            outq.put((seq, allowed, n, errs, exc))
+
+        def pump():
+            # reads the client half of the stream; submit_items blocks
+            # at the credit window, so an over-eager client parks HERE
+            # and gRPC flow control pushes back
+            try:
+                for req in request_iterator:
+                    if req.close:
+                        break
+                    seq = int(req.seq)
+                    if seq in s.seqs:
+                        done(seq, None, 0, {}, BadRequestError(
+                            f"duplicate seq {seq}"))
+                        continue
+                    s.seqs.add(seq)
+                    items = []
+                    for p in req.tuples:
+                        try:
+                            items.append(tuple_from_proto(p))
+                        except KetoAPIError as e:
+                            items.append(e)
+                    if not items or len(items) > s.max_block_rows:
+                        done(seq, None, 0, {}, BadRequestError(
+                            f"block of {len(items)} rows outside "
+                            f"(0, {s.max_block_rows}]"))
+                        continue
+                    broker.submit_items(
+                        s, seq, items, done,
+                        max_depth=int(req.max_depth),
+                    )
+            except Exception:  # noqa: BLE001 - client went away
+                pass
+            finally:
+                s.drain()
+                outq.put(None)
+
+        try:
+            yield resp_t(
+                session=s.sid, credits=s.credits,
+                max_block_rows=s.max_block_rows,
+            )
+            t = threading.Thread(
+                target=pump, name="keto-streamcheck-pump", daemon=True)
+            t.start()
+            while True:
+                item = outq.get()
+                if item is None:
+                    return
+                seq, allowed, n, errs, exc = item
+                if exc is not None:
+                    yield resp_t(
+                        seq=seq, error=str(exc),
+                        status=int(
+                            getattr(exc, "status_code", None) or 500),
+                    )
+                    continue
+                resp = resp_t(seq=seq, snaptoken=self.snaptoken(s.r))
+                for i in range(n):
+                    out = resp.results.add()
+                    err = errs.get(i)
+                    if err is None:
+                        out.allowed = bool(allowed[i])
+                    else:
+                        out.error, out.status = err[0], int(err[1])
+                yield resp
+        finally:
+            # abrupt cancel included: release the session's admission
+            # grant exactly once, even with blocks still in flight
+            broker.close(s)
 
 
 class ExpandHandler:

@@ -87,6 +87,12 @@ def classify_rest_path(path: str) -> str:
 def classify_grpc_op(op: str) -> str:
     """Priority class for a gRPC method suffix (already lowercased by the
     admission interceptor)."""
+    if "stream" in op:
+        # streaming check sessions (server/session.py): admitted ONCE at
+        # the handshake under the interactive ceiling, which is what
+        # lets ESTABLISHED sessions keep draining through brownout-2
+        # (new sessions are refused at the handshake itself)
+        return CLASS_INTERACTIVE
     if "batch" in op:
         return CLASS_BATCH
     if op == "check":

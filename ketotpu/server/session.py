@@ -1,0 +1,678 @@
+"""Streaming check sessions: admit once, pump columnar blocks, verdicts
+come back out of order as engine waves complete.
+
+The north-star serving plane (ROADMAP top open item; SURVEY §3.2 names
+the hot path to shortcut, §7 the design stance): every batch RPC still
+pays per-request HTTP/gRPC framing plus admission re-entry.  A SESSION
+amortizes the serving shell across a persistent connection,
+Zanzibar-style — the client is admitted ONCE at the handshake
+(session-scoped units under the PR 16 interactive class, tenant-resolved
+per PR 17), then pumps check blocks with per-block sequence numbers.
+Per-block traffic never re-enters the admission controller; backpressure
+is a CREDIT window (max blocks in flight per session) enforced by the
+reader simply not reading past it, so TCP flow control pushes back on
+the client.
+
+Two transports share this module:
+
+* the raw TCP **session lane** (:class:`SessionLane`): `server/wire.py`
+  frames carrying the exact `check_cols` columnar encoding the worker
+  wire already uses (``skind`` uint8 + `pack_strcol` ns/obj/rel/sa/sb/sc)
+  — no per-item tuple materialization, no HTTP parse;
+* the gRPC ``CheckService.StreamCheck`` bidi RPC (handlers.py), which
+  parses proto tuples per block but shares the same session broker, so
+  admission/brownout/credit semantics are identical.
+
+Brownout (PR 16): NEW sessions are refused at brownout stage >= 2 with a
+Retry-After hint; established sessions keep draining because the
+interactive class keeps a non-zero ceiling through stage 2 and blocks
+never re-enter admission.
+
+Lane protocol (all frames are wire.send_frame meta+arrays):
+
+  client -> {"op": "hello", "v": 1, "units": U, "snaptoken": S,
+             "latest": bool, "max_depth": D}
+  server -> {"op": "hello", "ok": true, "session": sid, "credits": C,
+             "max_block_rows": R}
+          | {"op": "hello", "ok": false, "error": msg, "status": code,
+             "retry_after": secs}            # then the server closes
+  client -> {"op": "block", "seq": n, "n": rows [, "max_depth": D]
+             [, "deadline_ms": T]}
+             + arrays {"skind": uint8} and strcols ns/obj/rel/sa/sb/sc
+  server -> {"op": "verdicts", "seq": n, "n": rows,
+             "errs": [[row, msg, status], ...], "snaptoken": S}
+             + arrays {"ok": uint8}          # OUT OF ORDER across seqs
+          | {"op": "error", "seq": n, "error": msg, "status": code}
+  client -> {"op": "ping"}   server -> {"op": "pong"}
+  client -> {"op": "end"}    server drains in-flight blocks, then
+  server -> {"op": "bye", "blocks": B, "rows": N}
+"""
+
+from __future__ import annotations
+
+import select
+import socket
+import threading
+import time
+import uuid
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ketotpu import consistency, deadline, flightrec
+from ketotpu.api.types import KetoAPIError
+from ketotpu.cache import context as cache_context
+from ketotpu.engine import columns
+from ketotpu.server import wire
+from ketotpu.server.admission import CLASS_INTERACTIVE
+
+# lane frame caps: meta is a small dict (64 MB default is absurd for an
+# untrusted client lane); the binary part carries the packed string
+# columns of ONE block, so 256 MB bounds even pathological ids
+_LANE_MAX_META = 8 << 20
+_LANE_MAX_BIN = 256 << 20
+
+_STRCOLS = ("ns", "obj", "rel", "sa", "sb", "sc")
+
+
+class SessionRefused(Exception):
+    """Handshake refusal: maps to 429/503/507 + Retry-After on both
+    transports (the lane hello-nack and the gRPC handshake response)."""
+
+    def __init__(self, msg: str, status: int = 429,
+                 retry_after: float = 1.0):
+        super().__init__(msg)
+        self.status = int(status)
+        self.retry_after = float(retry_after)
+
+
+class Session:
+    """One admitted streaming session (transport-agnostic state)."""
+
+    def __init__(self, sid: str, r, *, token: int, units: int,
+                 credits: int, max_block_rows: int, snaptoken: str = "",
+                 latest: bool = False, max_depth: int = 0,
+                 ctoken=None, transport: str = "lane"):
+        self.sid = sid
+        self.r = r                      # tenant-resolved registry
+        self.token = token              # admission grant (released once)
+        self.units = units
+        self.credits = credits
+        self.max_block_rows = max_block_rows
+        self.snaptoken = snaptoken
+        self.latest = latest
+        self.max_depth = max_depth
+        self.ctoken = ctoken            # consistency token from the
+        self.transport = transport      # handshake barrier (snaptoken mode)
+        self.created = time.monotonic()
+        self.blocks = 0
+        self.rows = 0
+        self.closed = False
+        self.inflight = 0
+        self.seqs: set = set()
+        # the credit window: the reader thread blocks here instead of
+        # reading ahead, so TCP backpressure IS the flow control
+        self._window = threading.Semaphore(credits)
+        self._lock = threading.Lock()
+
+    def drain(self, timeout: float = 60.0) -> bool:
+        """Block until every in-flight block completed (acquire the whole
+        credit window, then hand it back)."""
+        got = 0
+        deadline_t = time.monotonic() + timeout
+        try:
+            for _ in range(self.credits):
+                left = deadline_t - time.monotonic()
+                if left <= 0 or not self._window.acquire(timeout=left):
+                    return False
+                got += 1
+            return True
+        finally:
+            for _ in range(got):
+                self._window.release()
+
+
+class SessionBroker:
+    """Owns every live session for one server: handshake admission,
+    block dispatch, and the `keto_session_*` vocabulary.
+
+    Dispatch runs on a small shared pool — blocks from MANY sessions
+    interleave into the coalescer's waves as first-class column groups
+    (engine.check_block), which is where out-of-order completion comes
+    from: a small block's wave can land while a big one is still packing.
+    """
+
+    def __init__(self, registry):
+        self.r = registry
+        cfg = registry.config
+        self.enabled = bool(cfg.get("session.enabled", True))
+        self.max_sessions = int(cfg.get("session.max_sessions", 256))
+        self.credits = int(cfg.get("session.credits", 8))
+        self.max_block_rows = int(cfg.get("session.max_block_rows", 4096))
+        self.units = int(cfg.get("session.units", 256))
+        self.idle_timeout_ms = int(cfg.get("session.idle_timeout_ms", 30000))
+        workers = int(cfg.get("session.dispatch_workers", 4))
+        self._sessions: Dict[str, Session] = {}
+        self._lock = threading.Lock()
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(1, workers),
+            thread_name_prefix="keto-session",
+        )
+        # lazy: handlers imports this module for the StreamCheck servicer
+        from ketotpu.server.handlers import CheckHandler
+        self._check = CheckHandler(registry)
+
+    # -- lifecycle ----------------------------------------------------
+
+    def open(self, md: Optional[dict] = None, *, units: int = 0,
+             snaptoken: str = "", latest: bool = False,
+             max_depth: int = 0, transport: str = "lane") -> Session:
+        """Handshake: tenant-resolve, brownout gate, session cap, ONE
+        admission acquire for the whole session.  Raises
+        :class:`SessionRefused` with the Retry-After hint on refusal."""
+        met = self.r.metrics()
+
+        def refuse(reason: str, msg: str, status: int) -> SessionRefused:
+            met.counter(
+                "keto_session_refused_total", 1.0,
+                help="streaming session handshakes refused",
+                reason=reason, transport=transport,
+            )
+            return SessionRefused(
+                msg, status=status, retry_after=self._retry_after())
+
+        try:
+            r = self.r.resolve(md or {})
+        except Exception as e:  # noqa: BLE001 - unknown tenant etc.
+            code = getattr(e, "status_code", None) or 403
+            raise refuse("tenant", str(e), int(code)) from e
+        with self._lock:
+            live = len(self._sessions)
+        if live >= self.max_sessions:
+            raise refuse(
+                "cap",
+                f"session cap reached ({self.max_sessions}); retry later",
+                507,
+            )
+        ctl = self.r.admission()
+        if ctl is not None and ctl.enabled:
+            # PR 16 brownout ladder: stage >= 2 sheds everything but
+            # ESTABLISHED interactive traffic — a new session is new
+            # load, so the handshake is the shed point
+            if int(getattr(ctl, "stage", 0)) >= 2:
+                raise refuse(
+                    "brownout",
+                    "brownout: new sessions refused; retry later", 503,
+                )
+            weight = int(units) or self.units
+            token = ctl.try_acquire(weight, klass=CLASS_INTERACTIVE)
+            if not token:
+                raise refuse(
+                    "admission",
+                    f"in-flight limit reached ({ctl.limit}); "
+                    f"session of {weight} units refused", 429,
+                )
+        else:
+            weight, token = int(units) or self.units, 0
+        ctoken = None
+        try:
+            if snaptoken:
+                # at-least-as-fresh is monotonic: one barrier at the
+                # handshake covers every block in the session
+                ctoken = consistency.ensure_fresh(
+                    r, snaptoken, False, op="stream")
+        except Exception:
+            if token and ctl is not None:
+                ctl.release(token)
+            raise
+        sid = uuid.uuid4().hex[:16]
+        s = Session(
+            sid, r, token=token, units=weight, credits=self.credits,
+            max_block_rows=self.max_block_rows, snaptoken=snaptoken,
+            latest=latest, max_depth=max_depth, ctoken=ctoken,
+            transport=transport,
+        )
+        with self._lock:
+            self._sessions[sid] = s
+            live = len(self._sessions)
+        met.counter(
+            "keto_session_open_total", 1.0,
+            help="streaming sessions opened", transport=transport,
+        )
+        met.gauge(
+            "keto_session_active", float(live),
+            help="streaming sessions currently open",
+        )
+        return s
+
+    def close(self, s: Session) -> None:
+        """Release the session's admission grant exactly once — including
+        on abrupt disconnect with blocks still in flight (the dispatch
+        jobs finish against the engine; their completion callbacks just
+        have nowhere to write)."""
+        with self._lock:
+            if self._sessions.pop(s.sid, None) is None:
+                return
+            live = len(self._sessions)
+        s.closed = True
+        if s.token:
+            ctl = self.r.admission()
+            if ctl is not None:
+                ctl.release(s.token)
+            s.token = 0
+        met = self.r.metrics()
+        met.gauge(
+            "keto_session_active", float(live),
+            help="streaming sessions currently open",
+        )
+        met.observe(
+            "keto_session_blocks", float(s.blocks),
+            help="blocks served per streaming session",
+        )
+
+    def active(self) -> int:
+        with self._lock:
+            return len(self._sessions)
+
+    def shutdown(self) -> None:
+        with self._lock:
+            live = list(self._sessions.values())
+        for s in live:
+            self.close(s)
+        self._pool.shutdown(wait=False)
+
+    def _retry_after(self) -> float:
+        try:
+            hint = self.r.retry_after_hint()
+            return max(1.0, float(hint))
+        except Exception:  # noqa: BLE001 - hint is advisory
+            return 1.0
+
+    # -- block dispatch -----------------------------------------------
+
+    def submit_cols(self, s: Session, seq: int, meta: dict, arrays: dict,
+                    done: Callable) -> None:
+        """Lane path: dispatch one columnar block.  Decode happens on the
+        dispatch thread (off the reader), the verdict callback runs there
+        too — out of order across seqs by construction."""
+
+        def build():
+            cols = {k: wire.unpack_strcol(arrays, k) for k in _STRCOLS}
+            skind_arr = arrays.get("skind")
+            if skind_arr is None:
+                raise ValueError("block frame missing skind")
+            skind = [int(v) for v in np.asarray(skind_arr).reshape(-1)]
+            n = len(skind)
+            for k, col in cols.items():
+                if len(col) != n:
+                    raise ValueError(
+                        f"block column {k!r} has {len(col)} rows, "
+                        f"skind has {n}")
+            block = columns.ColumnBlock(
+                cols["ns"], cols["obj"], cols["rel"], skind,
+                cols["sa"], cols["sb"], cols["sc"],
+            )
+            return block, list(range(n)), n, {}
+
+        self._submit(s, seq, build, int(meta.get("max_depth", 0)),
+                     float(meta.get("deadline_ms", 0)) / 1000.0, done)
+
+    def submit_items(self, s: Session, seq: int, items: List,
+                     done: Callable, *, max_depth: int = 0) -> None:
+        """gRPC path: items are RelationTuples or per-slot exceptions
+        (the BatchCheck slot contract)."""
+
+        def build():
+            errors: dict = {}
+            good, keep = [], []
+            for i, t in enumerate(items):
+                if isinstance(t, Exception):
+                    code = getattr(t, "status_code", None) or 400
+                    errors[i] = (str(t), int(code))
+                else:
+                    good.append(t)
+                    keep.append(i)
+            block = columns.ColumnBlock.from_tuples(good)
+            return block, keep, len(items), errors
+
+        self._submit(s, seq, build, max_depth, 0.0, done)
+
+    def _submit(self, s: Session, seq: int, build: Callable,
+                max_depth: float, deadline_s: float,
+                done: Callable) -> None:
+        """Acquire one credit (BLOCKS the caller — that is the
+        backpressure), then run the block on the dispatch pool.  `done`
+        is called exactly once with (seq, allowed, n, errors, exc)."""
+        s._window.acquire()
+        with s._lock:
+            s.inflight += 1
+
+        def run():
+            t_start = time.perf_counter()
+            try:
+                with flightrec.rpc_recording(
+                    s.r, "stream",
+                    detail=f"session {s.sid} block seq={seq}",
+                ):
+                    t0 = time.perf_counter()
+                    block, keep, n, errors = build()
+                    flightrec.note_stage(
+                        "decode", time.perf_counter() - t0)
+                    flightrec.note(batch=n, seq=seq)
+                    token = s.ctoken
+                    if s.latest:
+                        # latest mode re-arms per block: "fully fresh"
+                        # must cover writes that landed mid-session
+                        tb = time.perf_counter()
+                        token = consistency.ensure_fresh(
+                            s.r, None, True, op="stream")
+                        flightrec.note_stage(
+                            "barrier", time.perf_counter() - tb)
+                    t1 = time.perf_counter()
+                    depth = int(max_depth) or s.max_depth
+                    with deadline.scope(
+                        deadline_s if deadline_s > 0 else None
+                    ), cache_context.request_scope(
+                        s.r, {}, token=token, latest=s.latest
+                    ):
+                        allowed, errs = self._check._check_block_core(
+                            block, keep, n, errors, depth, s.r)
+                    flightrec.note_stage(
+                        "compute", time.perf_counter() - t1)
+                with s._lock:
+                    s.blocks += 1
+                    s.rows += n
+                met = s.r.metrics()
+                met.counter(
+                    "keto_session_blocks_total", 1.0,
+                    help="streaming check blocks served",
+                    transport=s.transport,
+                )
+                met.observe(
+                    "keto_session_block_rows", float(n),
+                    help="rows per streaming check block",
+                )
+                met.observe(
+                    "keto_session_block_seconds",
+                    time.perf_counter() - t_start,
+                    help="streaming block latency (decode to verdict)",
+                )
+                done(seq, allowed, n, errs, None)
+            except Exception as e:  # noqa: BLE001 - block-level isolation
+                done(seq, None, 0, {}, e)
+            finally:
+                with s._lock:
+                    s.inflight -= 1
+                s._window.release()
+
+        try:
+            self._pool.submit(run)
+        except RuntimeError as e:
+            # broker torn down while a connection thread still pumped
+            # blocks: answer the block instead of killing the thread
+            with s._lock:
+                s.inflight -= 1
+            s._window.release()
+            done(seq, None, 0, {},
+                 KetoAPIError(f"session broker shut down: {e}",
+                              status_code=503))
+
+    def snaptoken(self, s: Session) -> str:
+        return self._check.snaptoken(s.r)
+
+
+class _LaneReader:
+    """Exact-read adapter over a raw socket for `wire.recv_frame`.
+
+    A plain socket timeout poisons Python's BufferedReader ("cannot read
+    from timed out object"), so idle expiry is done here with select
+    ticks instead: no data for `idle_timeout` seconds AND nothing in
+    flight raises socket.timeout; a session mid-compile (inflight > 0)
+    just keeps waiting — the kernel still pushes back on writes."""
+
+    _TICK = 1.0
+
+    def __init__(self, conn: socket.socket, idle_timeout: float):
+        self._conn = conn
+        self._idle_timeout = idle_timeout
+        self.inflight_fn: Callable[[], int] = lambda: 0
+
+    def read(self, n: int) -> bytes:
+        buf = bytearray()
+        idle = 0.0
+        while len(buf) < n:
+            try:
+                r, _, _ = select.select(
+                    [self._conn], [], [], self._TICK)
+            except (OSError, ValueError):
+                break               # socket closed under us
+            if not r:
+                idle += self._TICK
+                if (self._idle_timeout > 0
+                        and idle >= self._idle_timeout
+                        and self.inflight_fn() <= 0):
+                    raise socket.timeout("session lane idle expiry")
+                continue
+            chunk = self._conn.recv(n - len(buf))
+            if not chunk:
+                break               # EOF: recv_frame maps short reads
+            idle = 0.0
+            buf += chunk
+        return bytes(buf)
+
+    def close(self) -> None:
+        """recv_frame never closes; the lane owns the socket."""
+
+
+# -- the raw TCP session lane ----------------------------------------------
+
+
+class SessionLane:
+    """Threaded TCP acceptor speaking wire.py frames (protocol at module
+    top).  SO_REUSEPORT-capable so N front-door processes can share one
+    lane port (`serve --front-doors N`)."""
+
+    def __init__(self, broker: SessionBroker, host: str, port: int, *,
+                 reuse_port: bool = False, front_door: str = ""):
+        self.broker = broker
+        self.host = host
+        self.port = port
+        self.reuse_port = reuse_port
+        self.front_door = front_door    # door index label, "" standalone
+        self._sock: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._conns: set = set()
+        self._lock = threading.Lock()
+        self._stopping = False
+
+    def start(self) -> None:
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        if self.reuse_port and hasattr(socket, "SO_REUSEPORT"):
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        s.bind((self.host, self.port))
+        s.listen(128)
+        self.port = s.getsockname()[1]
+        self._sock = s
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="keto-session-lane", daemon=True)
+        self._accept_thread.start()
+        if self.front_door:
+            self.broker.r.metrics().gauge(
+                "keto_front_door_up", 1.0,
+                help="front-door process liveness", door=self.front_door,
+            )
+
+    def stop(self) -> None:
+        self._stopping = True
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        with self._lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=5)
+
+    @property
+    def address(self):
+        return (self.host, self.port)
+
+    def _accept_loop(self) -> None:
+        while not self._stopping:
+            try:
+                conn, _addr = self._sock.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._lock:
+                self._conns.add(conn)
+            threading.Thread(
+                target=self._serve_conn, args=(conn,),
+                name="keto-session-conn", daemon=True,
+            ).start()
+
+    # -- per-connection protocol --------------------------------------
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        broker = self.broker
+        if self.front_door:
+            broker.r.metrics().counter(
+                "keto_front_door_conns_total", 1.0,
+                help="session-lane connections accepted per front door",
+                door=self.front_door,
+            )
+        wlock = threading.Lock()
+
+        def send(meta: dict, arrays: Optional[dict] = None) -> bool:
+            with wlock:
+                try:
+                    wire.send_frame(conn, meta, arrays)
+                    return True
+                except OSError:
+                    return False
+
+        session: Optional[Session] = None
+        rfile = _LaneReader(conn, broker.idle_timeout_ms / 1000.0)
+        try:
+            got = wire.recv_frame(
+                rfile, max_meta=_LANE_MAX_META, max_bin=_LANE_MAX_BIN)
+            if got is None:
+                return
+            hello, _arrays, _nb = got
+            if hello.get("op") != "hello":
+                send({"op": "error", "error": "expected hello frame",
+                      "status": 400})
+                return
+            md = {str(k).lower(): str(v)
+                  for k, v in (hello.get("metadata") or {}).items()}
+            try:
+                session = broker.open(
+                    md,
+                    units=int(hello.get("units", 0)),
+                    snaptoken=str(hello.get("snaptoken", "") or ""),
+                    latest=bool(hello.get("latest", False)),
+                    max_depth=int(hello.get("max_depth", 0)),
+                    transport="lane",
+                )
+            except SessionRefused as e:
+                send({"op": "hello", "ok": False, "error": str(e),
+                      "status": e.status, "retry_after": e.retry_after})
+                return
+            rfile.inflight_fn = lambda: session.inflight
+            send({
+                "op": "hello", "ok": True, "session": session.sid,
+                "credits": session.credits,
+                "max_block_rows": session.max_block_rows,
+            })
+
+            def done(seq, allowed, n, errs, exc):
+                if session.closed:
+                    return
+                if exc is not None:
+                    send({
+                        "op": "error", "seq": int(seq), "error": str(exc),
+                        "status": int(
+                            getattr(exc, "status_code", None) or 500),
+                    })
+                    return
+                send(
+                    {
+                        "op": "verdicts", "seq": int(seq), "n": int(n),
+                        "errs": [
+                            [int(i), str(m), int(c)]
+                            for i, (m, c) in sorted(errs.items())
+                        ],
+                        "snaptoken": broker.snaptoken(session),
+                    },
+                    {"ok": np.asarray(allowed, dtype=np.uint8)},
+                )
+
+            while True:
+                got = wire.recv_frame(
+                    rfile, max_meta=_LANE_MAX_META, max_bin=_LANE_MAX_BIN)
+                if got is None:
+                    return              # client vanished mid-stream
+                meta, arrays, _nb = got
+                op = meta.get("op")
+                if op == "ping":
+                    send({"op": "pong", "session": session.sid})
+                    continue
+                if op == "end":
+                    session.drain()
+                    send({"op": "bye", "blocks": session.blocks,
+                          "rows": session.rows})
+                    return
+                if op != "block":
+                    send({"op": "error",
+                          "error": f"unknown op {op!r}", "status": 400})
+                    continue
+                seq = int(meta.get("seq", -1))
+                n = int(meta.get("n", 0))
+                if seq < 0 or seq in session.seqs:
+                    send({"op": "error", "seq": seq,
+                          "error": "bad or duplicate seq", "status": 400})
+                    continue
+                if n <= 0 or n > session.max_block_rows:
+                    send({"op": "error", "seq": seq,
+                          "error": (f"block of {n} rows exceeds "
+                                    f"max_block_rows="
+                                    f"{session.max_block_rows}"),
+                          "status": 400})
+                    continue
+                session.seqs.add(seq)
+                # blocks past the credit window park HERE (submit_cols
+                # acquires a credit before returning) — the lane stops
+                # reading and the kernel pushes back on the client
+                broker.submit_cols(session, seq, meta, arrays, done)
+        except (wire.WireError, socket.timeout, ValueError):
+            # desync/truncation/oversize or idle expiry: the connection
+            # is unrecoverable — drop it (the client replays unacked
+            # blocks on a fresh session)
+            return
+        except OSError:
+            return
+        finally:
+            if session is not None:
+                broker.close(session)
+            try:
+                rfile.close()
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+            with self._lock:
+                self._conns.discard(conn)

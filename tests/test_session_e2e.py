@@ -1,0 +1,204 @@
+"""Multi-front-door e2e (ISSUE 19, slow tier): ``serve --front-doors 2
+--workers 2`` as real subprocesses over a shared sqlite store — N
+accept/decode children share ONE session-lane port via SO_REUSEPORT
+behind one device owner.  Plus the chaos leg: SIGKILL one front door
+mid-stream; sessions on the surviving door are unaffected and clients of
+the dead door resume (reconnect lands on a live door, the SDK replays
+unacked blocks on a fresh session).
+"""
+
+import json
+import os
+import pathlib
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from ketotpu.api.types import RelationTuple
+from ketotpu.driver import Provider, Registry
+from ketotpu.sdk import KetoClient
+
+FIXTURES = pathlib.Path(__file__).parent / "fixtures"
+
+SEED_TUPLES = [
+    "Group:admin#members@alice",
+    "Group:dev#members@bob",
+    "Folder:keto#viewers@Group:dev#members",
+    "File:keto/README.md#parents@Folder:keto",
+]
+
+CASES = [
+    ("Group:dev#members@bob", True),
+    ("File:keto/README.md#view@bob", True),
+    ("File:keto/README.md#view@alice", False),
+    ("File:keto/README.md#view@eve", False),
+]
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _http(method, url, timeout=30.0):
+    req = urllib.request.Request(url, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def _front_door_children(parent_pid):
+    """(pid, door-label) for every live child of ``parent_pid`` whose
+    environment carries KETO_FRONT_DOOR (linux /proc scan — the test
+    runs where the CI does)."""
+    out = []
+    for entry in os.listdir("/proc"):
+        if not entry.isdigit():
+            continue
+        try:
+            with open(f"/proc/{entry}/stat") as fh:
+                ppid = int(fh.read().split()[3])
+            if ppid != parent_pid:
+                continue
+            with open(f"/proc/{entry}/environ", "rb") as fh:
+                env = fh.read().split(b"\0")
+        except OSError:
+            continue
+        for kv in env:
+            if kv.startswith(b"KETO_FRONT_DOOR="):
+                out.append((int(entry), kv.split(b"=", 1)[1].decode()))
+    return out
+
+
+@pytest.mark.slow
+def test_front_doors_e2e_and_chaos(tmp_path):
+    db = tmp_path / "doors.db"
+    seed = Registry(Provider({"dsn": f"sqlite://{db}"}))
+    seed.store().migrate_up()
+    seed.store().write_relation_tuples(
+        *[RelationTuple.from_string(s) for s in SEED_TUPLES]
+    )
+
+    ports = {n: _free_port() for n in ("read", "write", "metrics", "opl")}
+    session_port = _free_port()
+    config = {
+        "dsn": f"sqlite://{db}",
+        "serve": {
+            n: {"host": "127.0.0.1", "port": p} for n, p in ports.items()
+        },
+        "namespaces": {
+            "location": str(FIXTURES / "rewrites_namespaces.keto.ts")
+        },
+        "engine": {"kind": "tpu", "frontier": 512, "arena": 2048,
+                   "max_batch": 128, "mesh_devices": 0,
+                   "mesh_axis": "shard"},
+        # pinned: every front door binds THIS port via SO_REUSEPORT
+        "session": {"host": "127.0.0.1", "port": session_port},
+        # the first wave shape compiles slowly on XLA:CPU
+        "limit": {"request_timeout_ms": 300000},
+        "log": {"request_log": False},
+    }
+    cfg_path = tmp_path / "doors.json"
+    cfg_path.write_text(json.dumps(config))
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ketotpu.cli", "serve",
+         "-c", str(cfg_path), "--front-doors", "2", "--workers", "2"],
+        env=env, cwd=str(pathlib.Path(__file__).parent.parent),
+    )
+    read_url = f"http://127.0.0.1:{ports['read']}"
+    metrics = f"http://127.0.0.1:{ports['metrics']}"
+    lane = ("127.0.0.1", session_port)
+    try:
+        ready_by = time.monotonic() + 180.0
+        while True:
+            assert proc.poll() is None, "serve --front-doors died at boot"
+            try:
+                status, _ = _http("GET", f"{metrics}/health/ready",
+                                  timeout=2.0)
+                if status == 200:
+                    break
+            except OSError:
+                pass
+            assert time.monotonic() < ready_by, "topology never ready"
+            time.sleep(0.5)
+
+        doors = _front_door_children(proc.pid)
+        assert sorted(d for _, d in doors) == ["0", "1"], doors
+
+        # warm the wave cache through the lane (first compile is slow)
+        client = KetoClient(read_url, timeout=330.0)
+        with client.check_session(lane) as sess:
+            assert list(sess.stream([[c for c, _ in CASES]])) == [
+                [w for _, w in CASES]
+            ]
+
+        # several live sessions: the kernel spreads them over both doors
+        sessions = [
+            KetoClient(read_url, timeout=60.0, max_retries=4)
+            .check_session(lane)
+            for _ in range(6)
+        ]
+        try:
+            for sess in sessions:
+                seq = sess.submit([c for c, _ in CASES])
+                assert sess.wait(seq) == ([w for _, w in CASES], {})
+
+            # chaos: SIGKILL one front door mid-stream.  Sessions on the
+            # other door keep serving untouched; clients of the dead
+            # door reconnect through the shared port (landing on a live
+            # door) and replay anything unacked.
+            victims = [pid for pid, d in doors if d == "0"]
+            assert victims
+            os.kill(victims[0], signal.SIGKILL)
+
+            for sess in sessions:
+                seq = sess.submit(
+                    ["Group:dev#members@bob", "Group:dev#members@eve"]
+                )
+                assert sess.wait(seq) == ([True, False], {})
+
+            # the front-door metric vocabulary is live on the scrape
+            # (SO_REUSEPORT: any one child answers; every child exports
+            # its own door label)
+            status, body = _http(
+                "GET", f"{metrics}/metrics/prometheus", timeout=30.0
+            )
+            assert status == 200
+            assert "keto_front_door_up" in body
+        finally:
+            for sess in sessions:
+                try:
+                    sess.close()
+                except Exception:  # noqa: BLE001 - teardown best effort
+                    pass
+
+        # the supervisor respawns the killed door: both labels come back
+        healed_by = time.monotonic() + 120.0
+        while True:
+            live = sorted(d for _, d in _front_door_children(proc.pid))
+            if live == ["0", "1"]:
+                break
+            assert time.monotonic() < healed_by, \
+                f"killed front door never respawned (live={live})"
+            time.sleep(0.5)
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=60.0)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=30.0)
